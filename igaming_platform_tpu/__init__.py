@@ -1,0 +1,31 @@
+"""igaming_platform_tpu — a TPU-native iGaming platform framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+formeo/igaming-platform (Go microservices: Wallet / Bonus / Risk-ML):
+
+- ``core``      typed primitives: money, the 30-dim fraud feature schema,
+                domain enums, config.
+- ``parallel``  device mesh, named shardings, and the collective vocabulary
+                (psum / all-gather / all-to-all / ppermute) — the framework's
+                NCCL-equivalent, emitted by XLA over ICI/DCN.
+- ``ops``       numeric building blocks incl. Pallas TPU kernels.
+- ``models``    fraud MLP, GBDT-as-tensors, vectorized rule scorer, ensemble,
+                LTV, bonus-abuse sequence model (ring / Ulysses SP).
+- ``serve``     continuous batcher, feature store, risk.v1 gRPC server,
+                event backbone bridge.
+- ``train``     DP-sharded multi-task training, Orbax checkpoints, hot-swap.
+- ``platform``  Wallet / Bonus host-side services (ledger, idempotency,
+                optimistic locking, YAML bonus DSL).
+- ``obs``       Prometheus-style metrics and profiling hooks.
+"""
+
+__version__ = "0.1.0"
+
+import os as _os
+import sys as _sys
+
+# Generated protobuf modules (risk.v1, wallet.v1) import each other by their
+# proto package path, so the proto_gen root joins sys.path once here.
+_proto_gen = _os.path.join(_os.path.dirname(__file__), "proto_gen")
+if _proto_gen not in _sys.path:
+    _sys.path.append(_proto_gen)

@@ -1,0 +1,192 @@
+"""Exact integer money for the host-side platform layer.
+
+The reference keeps money as arbitrary-precision decimals
+(/root/reference/pkg/money/money.go:16-19) but the wire contract and the
+database schema are integer cents (wallet.proto:58-63, init-db.sql:13-26).
+This framework standardises on int64 cents everywhere — exact, hashable, and
+directly usable as device arrays (TPU has no decimal type) — with the same
+checked semantics: negative construction rejected, currency-mismatch and
+insufficient-funds errors on arithmetic (money.go:49-142).
+
+Python ints are unbounded, so ``Money`` validates the int64 range explicitly
+to preserve database/wire compatibility.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Union
+
+INT64_MIN = -(2**63)
+INT64_MAX = 2**63 - 1
+
+
+class Currency(str, enum.Enum):
+    USD = "USD"
+    EUR = "EUR"
+    GBP = "GBP"
+    RUB = "RUB"
+    BTC = "BTC"
+    ETH = "ETH"
+
+
+class MoneyError(ValueError):
+    pass
+
+
+class NegativeAmountError(MoneyError):
+    pass
+
+
+class InsufficientFundsError(MoneyError):
+    pass
+
+
+class CurrencyMismatchError(MoneyError):
+    pass
+
+
+class InvalidAmountError(MoneyError):
+    pass
+
+
+def _check_int64(cents: int) -> int:
+    if not (INT64_MIN <= cents <= INT64_MAX):
+        raise InvalidAmountError(f"amount out of int64 range: {cents}")
+    return cents
+
+
+@dataclass(frozen=True, slots=True)
+class Money:
+    """Immutable monetary value: integer cents + currency."""
+
+    cents: int
+    currency: Currency = Currency.USD
+
+    def __post_init__(self) -> None:
+        if not isinstance(self.cents, int) or isinstance(self.cents, bool):
+            raise InvalidAmountError(f"cents must be int, got {type(self.cents).__name__}")
+        _check_int64(self.cents)
+        if self.cents < 0:
+            raise NegativeAmountError(f"amount cannot be negative: {self.cents}")
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def zero(cls, currency: Currency = Currency.USD) -> "Money":
+        return cls(0, currency)
+
+    @classmethod
+    def from_cents(cls, cents: int, currency: Currency = Currency.USD) -> "Money":
+        return cls(int(cents), currency)
+
+    @classmethod
+    def parse(cls, value: str, currency: Currency = Currency.USD) -> "Money":
+        """Parse a decimal string like '12.34' into exact cents."""
+        text = value.strip()
+        negative = text.startswith("-")
+        if negative:
+            raise NegativeAmountError(f"amount cannot be negative: {value}")
+        if text.startswith("+"):
+            text = text[1:]
+        whole, _, frac = text.partition(".")
+        if whole == "" and frac == "":
+            raise InvalidAmountError(f"invalid amount format: {value!r}")
+        try:
+            whole_cents = int(whole or "0") * 100
+            if frac:
+                if len(frac) > 2 and any(c != "0" for c in frac[2:]):
+                    raise InvalidAmountError(f"sub-cent precision not representable: {value!r}")
+                frac = (frac + "00")[:2]
+                whole_cents += int(frac)
+        except ValueError as exc:
+            raise InvalidAmountError(f"invalid amount format: {value!r}") from exc
+        return cls(whole_cents, currency)
+
+    # -- predicates ---------------------------------------------------------
+
+    def is_zero(self) -> bool:
+        return self.cents == 0
+
+    def is_positive(self) -> bool:
+        return self.cents > 0
+
+    # -- arithmetic (checked) ----------------------------------------------
+
+    def _require_same_currency(self, other: "Money") -> None:
+        if self.currency != other.currency:
+            raise CurrencyMismatchError(f"{self.currency.value} != {other.currency.value}")
+
+    def add(self, other: "Money") -> "Money":
+        self._require_same_currency(other)
+        return Money(_check_int64(self.cents + other.cents), self.currency)
+
+    def sub(self, other: "Money") -> "Money":
+        """Checked subtraction; going below zero is insufficient funds."""
+        self._require_same_currency(other)
+        result = self.cents - other.cents
+        if result < 0:
+            raise InsufficientFundsError(f"{self} - {other}")
+        return Money(result, self.currency)
+
+    def mul_int(self, factor: int) -> "Money":
+        return Money(_check_int64(self.cents * factor), self.currency)
+
+    def percent(self, percent: int) -> "Money":
+        """percent% of the amount, truncated to whole cents (int64 math,
+        matching the bonus engine's `amount * pct / 100` truncation at
+        bonus_engine.go:467)."""
+        return Money(_check_int64(self.cents * percent // 100), self.currency)
+
+    def floordiv(self, divisor: int) -> "Money":
+        if divisor <= 0:
+            raise InvalidAmountError(f"divisor must be positive: {divisor}")
+        return Money(self.cents // divisor, self.currency)
+
+    def __add__(self, other: "Money") -> "Money":
+        return self.add(other)
+
+    def __sub__(self, other: "Money") -> "Money":
+        return self.sub(other)
+
+    # -- comparison ---------------------------------------------------------
+
+    def __lt__(self, other: "Money") -> bool:
+        self._require_same_currency(other)
+        return self.cents < other.cents
+
+    def __le__(self, other: "Money") -> bool:
+        self._require_same_currency(other)
+        return self.cents <= other.cents
+
+    def __gt__(self, other: "Money") -> bool:
+        self._require_same_currency(other)
+        return self.cents > other.cents
+
+    def __ge__(self, other: "Money") -> bool:
+        self._require_same_currency(other)
+        return self.cents >= other.cents
+
+    # -- formatting ---------------------------------------------------------
+
+    def __str__(self) -> str:
+        return f"{self.cents // 100}.{self.cents % 100:02d} {self.currency.value}"
+
+    def to_json(self) -> dict:
+        return {"value": f"{self.cents // 100}.{self.cents % 100:02d}", "currency": self.currency.value}
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "Money":
+        return cls.parse(obj["value"], Currency(obj["currency"]))
+
+
+def money_min(a: Money, b: Money) -> Money:
+    return a if a < b else b
+
+
+def money_max(a: Money, b: Money) -> Money:
+    return a if a > b else b
+
+
+MoneyLike = Union[Money, int]

@@ -1,0 +1,91 @@
+"""Feature schema + normalization tests (reference: ml/onnx_model.go:86-205)."""
+
+import numpy as np
+
+from igaming_platform_tpu.core.features import (
+    FEATURE_NAMES,
+    NUM_FEATURES,
+    F,
+    FeatureVector,
+    batch_from_vectors,
+    derive_tx_avg,
+    normalize,
+)
+
+
+def test_schema_order_matches_reference():
+    # Exact ONNX input ordering: onnx_model.go:133-166.
+    assert NUM_FEATURES == 30
+    assert FEATURE_NAMES[0] == "tx_count_1m"
+    assert FEATURE_NAMES[4] == "tx_avg_1h"
+    assert FEATURE_NAMES[9] == "account_age_days"
+    assert FEATURE_NAMES[18] == "win_rate"
+    assert FEATURE_NAMES[19] == "is_vpn"
+    assert FEATURE_NAMES[25] == "bonus_only_player"
+    assert FEATURE_NAMES[26] == "tx_amount"
+    assert FEATURE_NAMES[29] == "tx_type_bet"
+
+
+def test_to_from_array_roundtrip():
+    v = FeatureVector(tx_count_1m=3, total_deposits=5000, is_vpn=1, tx_amount=250)
+    arr = v.to_array()
+    assert arr.shape == (30,)
+    assert arr[F.TX_COUNT_1M] == 3
+    assert arr[F.TOTAL_DEPOSITS] == 5000
+    assert arr[F.IS_VPN] == 1
+    assert FeatureVector.from_array(arr) == v
+
+
+def test_minmax_scaling_matches_reference_bounds():
+    # minMaxScale clamps below->0, above->1, else linear (onnx_model.go:197-205).
+    v = FeatureVector(tx_count_1m=10, tx_count_5m=100, unique_devices_24h=5, account_age_days=730)
+    out = np.asarray(normalize(v.to_array()))
+    assert out[F.TX_COUNT_1M] == 0.5  # 10/20
+    assert out[F.TX_COUNT_5M] == 1.0  # clamped
+    assert out[F.UNIQUE_DEVICES_24H] == 0.5  # 5/10
+    assert out[F.ACCOUNT_AGE_DAYS] == 1.0  # clamped at 365
+
+
+def test_ref_compat_log_is_identity():
+    # The reference stubs log1p to identity (onnx_model.go:193-195).
+    v = FeatureVector(tx_sum_1h=50_000, total_deposits=1_000, tx_amount=-5)
+    out = np.asarray(normalize(v.to_array(), ref_compat=True))
+    assert out[F.TX_SUM_1H] == 50_000
+    assert out[F.TOTAL_DEPOSITS] == 1_000
+    assert out[F.TX_AMOUNT] == 0.0  # <=0 -> 0
+
+
+def test_real_log1p_applied_by_default():
+    v = FeatureVector(tx_sum_1h=np.e - 1)
+    out = np.asarray(normalize(v.to_array()))
+    np.testing.assert_allclose(out[F.TX_SUM_1H], 1.0, rtol=1e-4)
+
+
+def test_normalize_batched():
+    batch = np.zeros((4, 30), dtype=np.float32)
+    batch[:, F.TX_COUNT_1M] = [0, 5, 10, 40]
+    out = np.asarray(normalize(batch))
+    np.testing.assert_allclose(out[:, F.TX_COUNT_1M], [0, 0.25, 0.5, 1.0])
+
+
+def test_with_tx_context_one_hot():
+    v = FeatureVector().with_tx_context(5000, "withdraw")
+    assert v.tx_amount == 5000
+    assert (v.tx_type_deposit, v.tx_type_withdraw, v.tx_type_bet) == (0, 1, 0)
+
+
+def test_derive_tx_avg():
+    batch = np.zeros((2, 30), dtype=np.float32)
+    batch[0, F.TX_COUNT_1H] = 4
+    batch[0, F.TX_SUM_1H] = 1000
+    derive_tx_avg(batch)
+    assert batch[0, F.TX_AVG_1H] == 250
+    assert batch[1, F.TX_AVG_1H] == 0
+
+
+def test_batch_from_vectors():
+    vs = [FeatureVector(tx_count_1m=i) for i in range(3)]
+    b = batch_from_vectors(vs)
+    assert b.shape == (3, 30)
+    np.testing.assert_allclose(b[:, F.TX_COUNT_1M], [0, 1, 2])
+    assert batch_from_vectors([]).shape == (0, 30)

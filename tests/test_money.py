@@ -1,0 +1,93 @@
+"""Money semantics tests (reference: pkg/money/money.go:49-203)."""
+
+import pytest
+
+from igaming_platform_tpu.core.money import (
+    Currency,
+    CurrencyMismatchError,
+    InsufficientFundsError,
+    InvalidAmountError,
+    Money,
+    NegativeAmountError,
+    money_max,
+    money_min,
+)
+
+
+def test_construct_and_cents():
+    m = Money.from_cents(12345, Currency.USD)
+    assert m.cents == 12345
+    assert str(m) == "123.45 USD"
+
+
+def test_negative_rejected():
+    with pytest.raises(NegativeAmountError):
+        Money(-1)
+    with pytest.raises(NegativeAmountError):
+        Money.parse("-5.00")
+
+
+def test_parse_exact():
+    assert Money.parse("10.50").cents == 1050
+    assert Money.parse("0.05").cents == 5
+    assert Money.parse("7").cents == 700
+    assert Money.parse("7.5").cents == 750
+    assert Money.parse("7.500").cents == 750
+
+
+def test_parse_invalid():
+    with pytest.raises(InvalidAmountError):
+        Money.parse("abc")
+    with pytest.raises(InvalidAmountError):
+        Money.parse("1.005")  # sub-cent precision
+    with pytest.raises(InvalidAmountError):
+        Money.parse("")
+
+
+def test_add_sub_checked():
+    a = Money.from_cents(1000)
+    b = Money.from_cents(300)
+    assert (a + b).cents == 1300
+    assert (a - b).cents == 700
+    with pytest.raises(InsufficientFundsError):
+        _ = b - a
+
+
+def test_currency_mismatch():
+    usd = Money.from_cents(100, Currency.USD)
+    eur = Money.from_cents(100, Currency.EUR)
+    with pytest.raises(CurrencyMismatchError):
+        _ = usd + eur
+    with pytest.raises(CurrencyMismatchError):
+        _ = usd < eur
+
+
+def test_percent_truncates_like_int64_math():
+    # 33% of $0.50 = 16.5 cents -> truncated to 16 (Go int64 division).
+    assert Money.from_cents(50).percent(33).cents == 16
+    assert Money.from_cents(100_000).percent(100).cents == 100_000
+    assert Money.from_cents(333).percent(200).cents == 666
+
+
+def test_min_max_compare():
+    a, b = Money.from_cents(1), Money.from_cents(2)
+    assert money_min(a, b) == a
+    assert money_max(a, b) == b
+    assert a <= a and a >= a and a < b and b > a
+
+
+def test_int64_bounds():
+    Money(2**63 - 1)
+    with pytest.raises(InvalidAmountError):
+        Money(2**63)
+
+
+def test_json_roundtrip():
+    m = Money.from_cents(1050, Currency.EUR)
+    assert Money.from_json(m.to_json()) == m
+
+
+def test_zero():
+    z = Money.zero()
+    assert z.is_zero() and not z.is_positive()
+    assert Money.from_cents(1).is_positive()
