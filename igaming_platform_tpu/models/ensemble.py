@@ -1,0 +1,123 @@
+"""The fused fraud-scoring graph: normalize → ML → rules → ensemble → action.
+
+Reference pipeline: /root/reference/services/risk/internal/scoring/engine.go:262-323
+— rule pass (:273), ML predict (:277-288), ensemble
+``int(0.4*rule + 0.6*ml*100)`` capped at 100 (:290-299), thresholds to
+action (:301-310). The reference crosses the CGo boundary per sample; here
+the entire pipeline is ONE jittable function over a [B, 30] batch — the
+goroutine fan-out of engine.go:331-409 becomes XLA fusion.
+
+Expert routing note (SURVEY.md §2.3 EP): the ensemble members (rule scorer,
+mock/MLP/GBDT) are the framework's "experts". At this model scale all
+experts run on every row (dense routing — cheaper than all-to-all for
+30-dim features); the `expert` mesh axis becomes load-bearing for the
+sequence-model ensemble in models/sequence.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from igaming_platform_tpu.core.config import ScoringConfig
+from igaming_platform_tpu.core.enums import ACTION_APPROVE, ACTION_BLOCK, ACTION_REVIEW
+from igaming_platform_tpu.core.features import normalize
+from igaming_platform_tpu.models import gbdt as gbdt_mod
+from igaming_platform_tpu.models import mlp as mlp_mod
+from igaming_platform_tpu.models.mock_model import mock_predict
+from igaming_platform_tpu.models.rules import apply_rules
+
+# Bit index of ML_HIGH_RISK in the reason mask (REASON_BIT_ORDER[8]).
+ML_HIGH_RISK_BIT = 8
+
+# Guards against float32 sitting an ulp below the float64 value Go computes
+# before its int() truncation.
+_TRUNC_EPS = 1e-4
+
+
+def combine(
+    rule_score: jnp.ndarray,
+    ml_score: jnp.ndarray,
+    reason_mask: jnp.ndarray,
+    cfg: ScoringConfig,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Ensemble + action decision (engine.go:285-310).
+
+    Returns (final_score [B] i32, action [B] i32, reason_mask [B] i32).
+    """
+    final = jnp.floor(
+        cfg.rule_weight * rule_score.astype(jnp.float32)
+        + cfg.ml_weight * ml_score * 100.0
+        + _TRUNC_EPS
+    ).astype(jnp.int32)
+    final = jnp.minimum(final, 100)
+
+    # ML_HIGH_RISK appended when ml > 0.7 (engine.go:285-287).
+    reason_mask = reason_mask | jnp.where(ml_score > 0.7, 1 << ML_HIGH_RISK_BIT, 0)
+
+    action = jnp.where(
+        final >= cfg.block_threshold,
+        ACTION_BLOCK,
+        jnp.where(final >= cfg.review_threshold, ACTION_REVIEW, ACTION_APPROVE),
+    ).astype(jnp.int32)
+    return final, action, reason_mask
+
+
+def make_score_fn(
+    cfg: ScoringConfig,
+    ml_backend: str = "mock",
+) -> Callable[..., dict[str, jnp.ndarray]]:
+    """Build the jittable scoring step for a given ML backend.
+
+    Backends:
+      - "mock":  reference-parity deterministic scorer (no params)
+      - "mlp":   trained fraud MLP
+      - "gbdt":  oblivious-forest GBDT
+      - "mlp+gbdt": mean of MLP and GBDT probabilities
+
+    The returned fn has signature ``f(params, x_raw, blacklisted)`` with
+    ``x_raw`` a [B, 30] float32 raw feature batch and returns a dict of
+    per-row arrays: score, action, rule_score, ml_score, reason_mask.
+
+    The mock backend normalizes in ref-compat mode (identity log1p) because
+    that is the data distribution its thresholds were written against; the
+    trained backends use real log1p.
+    """
+    ref_compat = ml_backend == "mock"
+
+    def score_fn(params: Any, x_raw: jnp.ndarray, blacklisted: jnp.ndarray) -> dict[str, jnp.ndarray]:
+        x_raw = jnp.asarray(x_raw, jnp.float32)
+        xn = normalize(x_raw, ref_compat=ref_compat)
+
+        if ml_backend == "mock":
+            ml = mock_predict(xn)
+        elif ml_backend == "mlp":
+            ml = mlp_mod.mlp_predict(params["mlp"], xn)
+        elif ml_backend == "gbdt":
+            ml = gbdt_mod.gbdt_predict(params["gbdt"], xn)
+        elif ml_backend == "mlp+gbdt":
+            ml = 0.5 * (mlp_mod.mlp_predict(params["mlp"], xn) + gbdt_mod.gbdt_predict(params["gbdt"], xn))
+        else:
+            raise ValueError(f"unknown ml backend: {ml_backend}")
+
+        rule_score, mask = apply_rules(x_raw, blacklisted, cfg)
+        final, action, mask = combine(rule_score, ml, mask, cfg)
+        return {
+            "score": final,
+            "action": action,
+            "rule_score": rule_score,
+            "ml_score": ml,
+            "reason_mask": mask,
+        }
+
+    return score_fn
+
+
+def jit_score_fn(cfg: ScoringConfig, ml_backend: str = "mock", donate_batch: bool = False):
+    """Jit the scoring step; optionally donate the input batch buffer."""
+    fn = make_score_fn(cfg, ml_backend)
+    donate = (1,) if donate_batch else ()
+    return jax.jit(fn, donate_argnums=donate)

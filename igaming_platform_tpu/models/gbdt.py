@@ -1,0 +1,98 @@
+"""GBDT as tensors — oblivious decision trees executed on the MXU/VPU.
+
+The reference's fraud ensemble assumes a GBDT/MLP graph behind ONNX Runtime
+(SURVEY.md §2.2); tree traversal is branch-heavy and hostile to TPUs, so
+this module uses the *oblivious* (symmetric) formulation — every node at
+depth d of a tree tests the same (feature, threshold) pair, so a tree of
+depth D is exactly:
+
+    bits[b, t, d] = x[b, feat[t, d]] > thr[t, d]
+    leaf[b, t]    = sum_d bits[b, t, d] << d
+    out[b]        = sum_t leaves[t, leaf[b, t]]
+
+i.e. a gather, a compare, and a small matvec — fully vectorized, static
+shapes, no data-dependent control flow (cf. Hummingbird / "A Tensor
+Compiler for Unified ML Prediction Serving", PAPERS.md). A Pallas kernel
+variant lives in ops/pallas/gbdt_kernel.py for the fused one-pass version.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from igaming_platform_tpu.core.features import NUM_FEATURES
+
+Params = dict[str, Any]
+
+
+def init_gbdt(
+    key: jax.Array,
+    n_trees: int = 64,
+    depth: int = 4,
+    in_dim: int = NUM_FEATURES,
+    leaf_scale: float = 0.1,
+) -> Params:
+    """Random oblivious forest (pre-training / distillation starting point).
+
+    Thresholds start in [0, 1] because model inputs are normalized counts /
+    log-scaled magnitudes (core.features.normalize).
+    """
+    k1, k2, k3 = jax.random.split(key, 3)
+    feat = jax.random.randint(k1, (n_trees, depth), 0, in_dim, dtype=jnp.int32)
+    thr = jax.random.uniform(k2, (n_trees, depth), jnp.float32)
+    leaves = jax.random.normal(k3, (n_trees, 2**depth), jnp.float32) * leaf_scale
+    return {"feat": feat, "thr": thr, "leaves": leaves, "bias": jnp.zeros((), jnp.float32)}
+
+
+def gbdt_raw(params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """[B, F] -> [B] raw margin (sum of leaf values + bias)."""
+    x = jnp.asarray(x, jnp.float32)
+    feat = params["feat"]  # [T, D] int32
+    thr = params["thr"]  # [T, D]
+    leaves = params["leaves"]  # [T, 2^D]
+    depth = feat.shape[1]
+
+    gathered = x[:, feat.reshape(-1)].reshape(x.shape[0], *feat.shape)  # [B, T, D]
+    bits = (gathered > thr[None]).astype(jnp.int32)
+    pows = jnp.asarray(1 << np.arange(depth), jnp.int32)
+    leaf_idx = jnp.sum(bits * pows, axis=-1)  # [B, T]
+
+    vals = jnp.take_along_axis(leaves[None], leaf_idx[:, :, None], axis=2)[..., 0]
+    return jnp.sum(vals, axis=-1) + params["bias"]
+
+
+def gbdt_predict(params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """[B, F] normalized features -> [B] probability in [0, 1]."""
+    return jax.nn.sigmoid(gbdt_raw(params, x))
+
+
+def soft_gbdt_raw(params: Params, x: jnp.ndarray, temperature: float = 50.0) -> jnp.ndarray:
+    """Differentiable relaxation: sigmoid splits instead of hard compares.
+
+    Used to train/distil the forest with gradients; at temperature -> inf it
+    converges to ``gbdt_raw``. Leaf selection becomes a product of per-depth
+    branch probabilities.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    feat, thr, leaves = params["feat"], params["thr"], params["leaves"]
+    n_trees, depth = feat.shape
+
+    gathered = x[:, feat.reshape(-1)].reshape(x.shape[0], n_trees, depth)
+    p_right = jax.nn.sigmoid((gathered - thr[None]) * temperature)  # [B, T, D]
+
+    # P(leaf) = prod_d (bit_d ? p_right : 1 - p_right) for each leaf's bits.
+    leaf_bits = ((np.arange(2**depth)[:, None] >> np.arange(depth)[None]) & 1).astype(np.float32)
+    leaf_bits = jnp.asarray(leaf_bits)  # [2^D, D]
+    probs = p_right[:, :, None, :] * leaf_bits[None, None] + (1.0 - p_right[:, :, None, :]) * (
+        1.0 - leaf_bits[None, None]
+    )  # [B, T, 2^D, D]
+    leaf_prob = jnp.prod(probs, axis=-1)  # [B, T, 2^D]
+    return jnp.sum(leaf_prob * leaves[None], axis=(1, 2)) + params["bias"]
+
+
+def soft_gbdt_predict(params: Params, x: jnp.ndarray, temperature: float = 50.0) -> jnp.ndarray:
+    return jax.nn.sigmoid(soft_gbdt_raw(params, x, temperature))

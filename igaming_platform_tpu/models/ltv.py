@@ -1,0 +1,217 @@
+"""Vectorized LTV prediction — the batch analytics path, one fused pass.
+
+Reference: /root/reference/services/risk/internal/prediction/ltv.go. The Go
+predictor loops accounts sequentially (BatchPredict, ltv.go:385-398 — "the
+scaling gap" per SURVEY.md §3.4); here every formula — LTV projection
+(:155-178), engagement (:181-225), churn (:228-262), segmentation
+(:265-281), survival (:284-297), next-best-action (:300-343), confidence
+(:346-382) — is branchless jnp.where arithmetic over a [B, NL] feature
+matrix, so a whole player table scores in one sharded device pass. This is
+the heuristic baseline; models/multitask.py learns the same heads.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class L(enum.IntEnum):
+    """LTV feature column indices (PlayerFeatures, ltv.go:38-78)."""
+
+    DAYS_SINCE_REGISTRATION = 0
+    DAYS_SINCE_LAST_DEPOSIT = 1
+    DAYS_SINCE_LAST_BET = 2
+    TOTAL_ACTIVE_DAYS = 3
+    SESSIONS_PER_WEEK = 4
+    AVG_SESSION_DURATION = 5
+    TOTAL_DEPOSITS = 6
+    TOTAL_WITHDRAWALS = 7
+    NET_REVENUE = 8
+    AVG_DEPOSIT_AMOUNT = 9
+    DEPOSIT_FREQUENCY = 10
+    LARGEST_DEPOSIT = 11
+    TOTAL_BETS = 12
+    TOTAL_WINS = 13
+    BET_COUNT = 14
+    WIN_RATE = 15
+    AVG_BET_SIZE = 16
+    GAMES_PLAYED = 17
+    BONUSES_CLAIMED = 18
+    BONUS_WAGERING_COMPLETED = 19
+    BONUS_CONVERSION_RATE = 20
+    PUSH_ENABLED = 21
+    EMAIL_OPT_IN = 22
+    HAS_VIP_MANAGER = 23
+    SUPPORT_TICKETS = 24
+
+
+NUM_LTV_FEATURES = 25
+LTV_FEATURE_NAMES = tuple(f.name.lower() for f in L)
+
+# Segment codes aligned with risk.v1 Segment enum.
+SEG_VIP, SEG_HIGH, SEG_MEDIUM, SEG_LOW, SEG_CHURNING = 1, 2, 3, 4, 5
+
+# Next-best-action codes (decision tree of ltv.go:300-343).
+ACTIONS = (
+    "NO_ACTION",
+    "SEND_WINBACK_BONUS",
+    "SEND_ENGAGEMENT_EMAIL",
+    "VIP_MANAGER_CALL",
+    "EXCLUSIVE_EVENT_INVITE",
+    "ASSIGN_VIP_MANAGER",
+    "RETENTION_BONUS",
+    "LOYALTY_REWARD",
+    "SUGGEST_BONUS",
+    "RECOMMEND_NEW_GAMES",
+    "STANDARD_PROMOTION",
+    "ONBOARDING_GUIDE",
+    "SMALL_DEPOSIT_BONUS",
+)
+ACTION_CODES = {name: i for i, name in enumerate(ACTIONS)}
+
+# Segment thresholds in dollars (ltv.go:105-108).
+VIP_THRESHOLD = 10_000.0
+HIGH_THRESHOLD = 1_000.0
+MEDIUM_THRESHOLD = 100.0
+
+
+def engagement_score(f: jnp.ndarray) -> jnp.ndarray:
+    """0-1 engagement (ltv.go:181-225)."""
+    dslb = f[:, L.DAYS_SINCE_LAST_BET]
+    spw = f[:, L.SESSIONS_PER_WEEK]
+    dfreq = f[:, L.DEPOSIT_FREQUENCY]
+
+    s = jnp.where(dslb < 3, 0.3, jnp.where(dslb < 7, 0.2, jnp.where(dslb < 14, 0.1, 0.0)))
+    s = s + jnp.where(spw >= 5, 0.2, jnp.where(spw >= 3, 0.15, jnp.where(spw >= 1, 0.1, 0.0)))
+    s = s + jnp.where(dfreq >= 4, 0.2, jnp.where(dfreq >= 2, 0.15, jnp.where(dfreq >= 1, 0.1, 0.0)))
+    s = s + jnp.where(f[:, L.PUSH_ENABLED] > 0, 0.1, 0.0)
+    s = s + jnp.where(f[:, L.EMAIL_OPT_IN] > 0, 0.1, 0.0)
+    s = s + jnp.where(f[:, L.HAS_VIP_MANAGER] > 0, 0.1, 0.0)
+    return jnp.minimum(s, 1.0)
+
+
+def churn_risk(f: jnp.ndarray) -> jnp.ndarray:
+    """0-1 churn probability (ltv.go:228-262)."""
+    dslb = f[:, L.DAYS_SINCE_LAST_BET]
+    r = jnp.where(dslb > 30, 0.5, jnp.where(dslb > 14, 0.3, jnp.where(dslb > 7, 0.15, 0.0)))
+    r = r + jnp.where((f[:, L.SESSIONS_PER_WEEK] < 1) & (f[:, L.DAYS_SINCE_REGISTRATION] > 30), 0.2, 0.0)
+    r = r + jnp.where(f[:, L.DAYS_SINCE_LAST_DEPOSIT] > 30, 0.2, 0.0)
+    r = r + jnp.where(f[:, L.SUPPORT_TICKETS] > 3, 0.1, 0.0)
+    r = r + jnp.where(f[:, L.TOTAL_WITHDRAWALS] > f[:, L.TOTAL_DEPOSITS], 0.1, 0.0)
+    return jnp.minimum(r, 1.0)
+
+
+def base_ltv(f: jnp.ndarray) -> jnp.ndarray:
+    """Projected lifetime value in dollars (ltv.go:155-178)."""
+    dsr = f[:, L.DAYS_SINCE_REGISTRATION]
+    net = f[:, L.NET_REVENUE]
+
+    # New players (< 30 days): project 12 months from current run-rate.
+    monthly_new = net / jnp.maximum(dsr, 1.0) * 30.0
+    new_value = monthly_new * 12.0
+
+    # Established: realized + engagement-scaled remaining months.
+    monthly_est = net / jnp.maximum(dsr, 1.0) * 30.0
+    remaining_months = 12.0 * engagement_score(f)
+    est_value = net + monthly_est * remaining_months
+
+    return jnp.where(dsr < 30, new_value, est_value)
+
+
+def determine_segment(ltv: jnp.ndarray, churn: jnp.ndarray) -> jnp.ndarray:
+    """Segment codes; churn > 0.7 overrides value tiers (ltv.go:265-281)."""
+    seg = jnp.where(
+        ltv >= VIP_THRESHOLD,
+        SEG_VIP,
+        jnp.where(ltv >= HIGH_THRESHOLD, SEG_HIGH, jnp.where(ltv >= MEDIUM_THRESHOLD, SEG_MEDIUM, SEG_LOW)),
+    )
+    return jnp.where(churn > 0.7, SEG_CHURNING, seg).astype(jnp.int32)
+
+
+def predict_survival(f: jnp.ndarray, churn: jnp.ndarray) -> jnp.ndarray:
+    """Remaining active days (ltv.go:284-297)."""
+    days = 90.0 * (1.0 + engagement_score(f)) * (1.0 - churn)
+    return jnp.maximum(days, 0.0).astype(jnp.int32)
+
+
+def confidence(f: jnp.ndarray) -> jnp.ndarray:
+    """Data-quality confidence (ltv.go:346-382)."""
+    dsr = f[:, L.DAYS_SINCE_REGISTRATION]
+    bets = f[:, L.BET_COUNT]
+    dfreq = f[:, L.DEPOSIT_FREQUENCY]
+    dslb = f[:, L.DAYS_SINCE_LAST_BET]
+
+    c = jnp.where(dsr > 90, 0.3, jnp.where(dsr > 30, 0.2, 0.1))
+    c = c + jnp.where(bets > 100, 0.3, jnp.where(bets > 20, 0.2, 0.1))
+    c = c + jnp.where(dfreq > 2, 0.2, jnp.where(dfreq > 0, 0.1, 0.0))
+    c = c + jnp.where(dslb < 7, 0.2, jnp.where(dslb < 30, 0.1, 0.0))
+    return jnp.minimum(c, 1.0)
+
+
+def next_best_action(seg: jnp.ndarray, f: jnp.ndarray, churn: jnp.ndarray) -> jnp.ndarray:
+    """Action codes per segment decision tree (ltv.go:300-343)."""
+    a = ACTION_CODES
+
+    churning = jnp.where(
+        f[:, L.NET_REVENUE] > 0, a["SEND_WINBACK_BONUS"], a["SEND_ENGAGEMENT_EMAIL"]
+    )
+    vip = jnp.where(
+        f[:, L.DAYS_SINCE_LAST_DEPOSIT] > 7, a["VIP_MANAGER_CALL"], a["EXCLUSIVE_EVENT_INVITE"]
+    )
+    high = jnp.where(
+        f[:, L.HAS_VIP_MANAGER] <= 0,
+        a["ASSIGN_VIP_MANAGER"],
+        jnp.where(churn > 0.3, a["RETENTION_BONUS"], a["LOYALTY_REWARD"]),
+    )
+    medium = jnp.where(
+        f[:, L.BONUSES_CLAIMED] < 3,
+        a["SUGGEST_BONUS"],
+        jnp.where(f[:, L.GAMES_PLAYED] < 5, a["RECOMMEND_NEW_GAMES"], a["STANDARD_PROMOTION"]),
+    )
+    low = jnp.where(
+        f[:, L.DAYS_SINCE_REGISTRATION] < 7,
+        a["ONBOARDING_GUIDE"],
+        jnp.where(f[:, L.BONUS_CONVERSION_RATE] > 0.8, a["NO_ACTION"], a["SMALL_DEPOSIT_BONUS"]),
+    )
+
+    out = jnp.where(
+        seg == SEG_CHURNING,
+        churning,
+        jnp.where(
+            seg == SEG_VIP,
+            vip,
+            jnp.where(seg == SEG_HIGH, high, jnp.where(seg == SEG_MEDIUM, medium, low)),
+        ),
+    )
+    return out.astype(jnp.int32)
+
+
+def predict_batch(f: jnp.ndarray) -> dict[str, jnp.ndarray]:
+    """Full LTV pipeline over [B, 25] features (Predict, ltv.go:113-151)."""
+    f = jnp.asarray(f, jnp.float32)
+    ltv = base_ltv(f)
+    churn = churn_risk(f)
+    adjusted = ltv * (1.0 - churn * 0.5)
+    seg = determine_segment(adjusted, churn)
+    return {
+        "ltv": adjusted,
+        "churn_risk": churn,
+        "segment": seg,
+        "survival_days": predict_survival(f, churn),
+        "confidence": confidence(f),
+        "action": next_best_action(seg, f, churn),
+        "engagement": engagement_score(f),
+    }
+
+
+predict_batch_jit = jax.jit(predict_batch)
+
+
+def segment_players(f: jnp.ndarray) -> dict[int, np.ndarray]:
+    """Group row indices by segment code (SegmentPlayers, ltv.go:401-414)."""
+    seg = np.asarray(predict_batch_jit(f)["segment"])
+    return {int(code): np.nonzero(seg == code)[0] for code in np.unique(seg)}

@@ -1,0 +1,78 @@
+"""Fraud MLP — the learned replacement for the ONNX Runtime session.
+
+The reference runs a [1, 30] -> [1, 1] fraud net one sample at a time
+through ONNX Runtime with per-call tensor churn
+(/root/reference/services/risk/internal/ml/onnx_model.go:208-255). Here the
+model is a plain JAX pytree applied to whole [B, 30] batches; matmuls run
+in bfloat16 with float32 accumulation so XLA tiles them onto the MXU, and
+the whole forward fuses with normalization/rules/ensemble in one program.
+
+Pure-pytree (no framework Module) so params shard/donate trivially under
+pjit and hot-swap atomically in the server.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from igaming_platform_tpu.core.features import NUM_FEATURES
+
+Params = dict[str, Any]
+
+DEFAULT_HIDDEN = (128, 128)
+
+
+def init_mlp(
+    key: jax.Array,
+    hidden: Sequence[int] = DEFAULT_HIDDEN,
+    in_dim: int = NUM_FEATURES,
+    out_dim: int = 1,
+) -> Params:
+    """He-initialised MLP params: in -> hidden... -> out (fraud logit)."""
+    dims = (in_dim, *hidden, out_dim)
+    layers = []
+    for i, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
+        key, sub = jax.random.split(key)
+        w = jax.random.normal(sub, (d_in, d_out), jnp.float32) * jnp.sqrt(2.0 / d_in)
+        layers.append({"w": w, "b": jnp.zeros((d_out,), jnp.float32)})
+    return {"layers": layers}
+
+
+def mlp_features(params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Hidden representation after the last ReLU (shared-trunk use)."""
+    h = jnp.asarray(x, jnp.float32)
+    for layer in params["layers"][:-1]:
+        h = _dense(h, layer)
+        h = jax.nn.relu(h)
+    return h
+
+
+def mlp_logits(params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    h = mlp_features(params, x)
+    return _dense(h, params["layers"][-1])
+
+
+def mlp_predict(params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """[B, 30] normalized features -> [B] fraud probability in [0, 1]."""
+    logits = mlp_logits(params, x)
+    return jax.nn.sigmoid(logits[..., 0])
+
+
+def _dense(h: jnp.ndarray, layer: Params) -> jnp.ndarray:
+    # bf16 operands + f32 accumulation: MXU-friendly without precision loss
+    # in the output.
+    w = layer["w"].astype(jnp.bfloat16)
+    out = jax.lax.dot_general(
+        h.astype(jnp.bfloat16),
+        w,
+        (((h.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return out + layer["b"]
+
+
+def num_params(params: Params) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))

@@ -1,0 +1,89 @@
+"""Deterministic fallback fraud scorer — vectorized reference mock.
+
+The reference degrades to a hand-written scorer when no trained model file
+exists (/root/reference/services/risk/internal/ml/onnx_model.go:51-59,
+:258-308); it is also the de-facto test double for inference. This module
+is the same decision function as branchless [B, 30] tensor arithmetic so it
+(a) serves as the bit-exact golden target for parity tests and (b) acts as
+the serving fallback before a trained checkpoint is loaded — at full batch
+throughput, unlike the reference's single-sample path.
+
+Input must be normalized with ``ref_compat=True`` (the reference normalizes
+with its stubbed identity log1p before calling mockPredict,
+onnx_model.go:213-217).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from igaming_platform_tpu.core.features import F
+
+
+def _gt_threshold(c: float) -> np.float32:
+    """float32 constant t such that (x > t) in float32 == (float64(x) > c).
+
+    Go promotes float32 features to float64 before comparing against float64
+    literals (e.g. `f.UniqueDevices24h > 0.3`); for non-dyadic c the naive
+    float32 constant flips boundary cases (3 devices/10 == 0.30000001f IS
+    > 0.3 in Go). t = largest float32 <= c.
+    """
+    t = np.float32(c)
+    if float(t) > c:
+        t = np.nextafter(t, np.float32(-np.inf))
+    return t
+
+
+def _lt_threshold(c: float) -> np.float32:
+    """float32 constant s such that (x < s) in float32 == (float64(x) < c).
+    s = smallest float32 >= c."""
+    s = np.float32(c)
+    if float(s) < c:
+        s = np.nextafter(s, np.float32(np.inf))
+    return s
+
+
+_GT_03 = _gt_threshold(0.3)
+_GT_025 = _gt_threshold(0.25)
+_GT_05 = _gt_threshold(0.5)
+_LT_002 = _lt_threshold(0.02)
+_LT_001 = _lt_threshold(0.01)
+_GT_08_FACTOR = np.float32(0.8)
+
+
+def mock_predict(xn: jnp.ndarray) -> jnp.ndarray:
+    """Score a normalized [B, 30] batch -> [B] float32 in [0, 1].
+
+    Decision table = onnx_model.go:258-308 (thresholds on *normalized*
+    features; comments give the raw-space meaning).
+    """
+    xn = jnp.asarray(xn, jnp.float32)
+    zero = jnp.zeros(xn.shape[:-1], jnp.float32)
+
+    def add(score, cond, w):
+        return score + jnp.where(cond, jnp.float32(w), 0.0)
+
+    s = zero
+    # Velocity: > 10 tx/min, > 100 tx/hour.
+    s = add(s, xn[..., F.TX_COUNT_1M] > _GT_05, 0.2)
+    s = add(s, xn[..., F.TX_COUNT_1H] > _GT_05, 0.15)
+    # Device churn: > 3 devices, > 5 IPs in 24h.
+    s = add(s, xn[..., F.UNIQUE_DEVICES_24H] > _GT_03, 0.15)
+    s = add(s, xn[..., F.UNIQUE_IPS_24H] > _GT_025, 0.1)
+    # Anonymisation.
+    s = add(s, (xn[..., F.IS_VPN] > 0) | (xn[..., F.IS_PROXY] > 0), 0.15)
+    s = add(s, xn[..., F.IS_TOR] > 0, 0.25)
+    # New account (< ~7 days) + large tx.
+    s = add(s, (xn[..., F.ACCOUNT_AGE_DAYS] < _LT_002) & (xn[..., F.TX_AMOUNT] > _GT_05), 0.2)
+    # Bonus-only player.
+    s = add(s, xn[..., F.BONUS_ONLY_PLAYER] > 0, 0.15)
+    # Rapid deposit->withdraw cycle.
+    rapid = (
+        (xn[..., F.TIME_SINCE_LAST_TX] < _LT_001)
+        & (xn[..., F.TX_TYPE_WITHDRAW] > 0)
+        & (xn[..., F.TOTAL_WITHDRAWALS] > xn[..., F.TOTAL_DEPOSITS] * _GT_08_FACTOR)
+    )
+    s = add(s, rapid, 0.2)
+
+    return jnp.minimum(s, 1.0)

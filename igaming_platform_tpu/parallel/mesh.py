@@ -1,0 +1,103 @@
+"""Device mesh construction — the frame every parallel strategy hangs off.
+
+The reference scales by stateless service replicas + goroutine fan-out and
+has no tensor/model parallelism (SURVEY.md §2.3); here all parallelism is
+expressed as axes of one `jax.sharding.Mesh`:
+
+- ``data``   batch sharding (DP) for serving batches and training
+- ``model``  tensor parallelism (TP) for wide layers / tree banks
+- ``seq``    sequence/context parallelism (SP/CP: ring attention, Ulysses)
+- ``expert`` expert parallelism (EP) for the ensemble's expert routing
+
+XLA lowers collectives over these axes onto ICI within a slice and DCN
+across hosts — the framework never issues raw NCCL/MPI-style calls.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_SEQ = "seq"
+AXIS_EXPERT = "expert"
+
+MESH_AXES = (AXIS_DATA, AXIS_MODEL, AXIS_SEQ, AXIS_EXPERT)
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Requested axis sizes; ``data=-1`` absorbs all remaining devices."""
+
+    data: int = -1
+    model: int = 1
+    seq: int = 1
+    expert: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
+        fixed = self.model * self.seq * self.expert
+        if fixed <= 0:
+            raise ValueError(f"axis sizes must be positive: {self}")
+        data = self.data
+        if data == -1:
+            if n_devices % fixed != 0:
+                raise ValueError(f"{n_devices} devices not divisible by model*seq*expert={fixed}")
+            data = n_devices // fixed
+        total = data * fixed
+        if total != n_devices:
+            raise ValueError(f"mesh {data}x{self.model}x{self.seq}x{self.expert}={total} != {n_devices} devices")
+        return (data, self.model, self.seq, self.expert)
+
+
+def create_mesh(spec: MeshSpec = MeshSpec(), devices=None) -> Mesh:
+    """Build the 4-axis mesh over ``devices`` (default: all local devices).
+
+    Devices are laid out row-major so neighbouring ``data`` coordinates are
+    physically adjacent — on a v5e slice that keeps DP gradient psums and
+    ring ppermutes on nearest-neighbour ICI links.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    shape = spec.resolve(len(devices))
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def single_device_mesh(device=None) -> Mesh:
+    """A 1x1x1x1 mesh — lets the same pjit'd programs run on one chip."""
+    device = device or jax.devices()[0]
+    return create_mesh(MeshSpec(data=1), devices=[device])
+
+
+def best_effort_mesh(model: int = 1, seq: int = 1, expert: int = 1) -> Mesh:
+    """Mesh over all visible devices with the given non-data axis sizes,
+    falling back to pure DP if the device count doesn't divide."""
+    n = len(jax.devices())
+    fixed = model * seq * expert
+    if n % fixed != 0:
+        return create_mesh(MeshSpec(data=-1))
+    return create_mesh(MeshSpec(data=-1, model=model, seq=seq, expert=expert))
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return int(mesh.shape[axis])
+
+
+def validate_batch_for_mesh(batch_size: int, mesh: Mesh) -> None:
+    """Fixed-shape discipline: device batches must divide evenly over DP."""
+    dp = mesh_axis_size(mesh, AXIS_DATA)
+    if batch_size % dp != 0:
+        raise ValueError(f"batch {batch_size} not divisible by data axis {dp}")
+
+
+def pow2_floor(n: int) -> int:
+    return 1 << (n.bit_length() - 1) if n > 0 else 1
+
+
+def auto_spec(n_devices: int | None = None) -> MeshSpec:
+    """Heuristic default: all devices on ``data`` (serving + DP training)."""
+    return MeshSpec(data=-1)

@@ -1,0 +1,186 @@
+"""LTV parity vs a Python oracle of the reference predictor (ltv.go)."""
+
+import numpy as np
+
+from igaming_platform_tpu.models.ltv import (
+    ACTIONS,
+    L,
+    NUM_LTV_FEATURES,
+    predict_batch_jit,
+    segment_players,
+)
+
+SEG_NAMES = {1: "vip", 2: "high", 3: "medium", 4: "low", 5: "churning"}
+
+
+# -- oracle (ltv.go:113-382, straight-line) ---------------------------------
+
+
+def oracle_engagement(f):
+    s = 0.0
+    if f[L.DAYS_SINCE_LAST_BET] < 3:
+        s += 0.3
+    elif f[L.DAYS_SINCE_LAST_BET] < 7:
+        s += 0.2
+    elif f[L.DAYS_SINCE_LAST_BET] < 14:
+        s += 0.1
+    if f[L.SESSIONS_PER_WEEK] >= 5:
+        s += 0.2
+    elif f[L.SESSIONS_PER_WEEK] >= 3:
+        s += 0.15
+    elif f[L.SESSIONS_PER_WEEK] >= 1:
+        s += 0.1
+    if f[L.DEPOSIT_FREQUENCY] >= 4:
+        s += 0.2
+    elif f[L.DEPOSIT_FREQUENCY] >= 2:
+        s += 0.15
+    elif f[L.DEPOSIT_FREQUENCY] >= 1:
+        s += 0.1
+    if f[L.PUSH_ENABLED] > 0:
+        s += 0.1
+    if f[L.EMAIL_OPT_IN] > 0:
+        s += 0.1
+    if f[L.HAS_VIP_MANAGER] > 0:
+        s += 0.1
+    return min(s, 1.0)
+
+
+def oracle_churn(f):
+    r = 0.0
+    if f[L.DAYS_SINCE_LAST_BET] > 30:
+        r += 0.5
+    elif f[L.DAYS_SINCE_LAST_BET] > 14:
+        r += 0.3
+    elif f[L.DAYS_SINCE_LAST_BET] > 7:
+        r += 0.15
+    if f[L.SESSIONS_PER_WEEK] < 1 and f[L.DAYS_SINCE_REGISTRATION] > 30:
+        r += 0.2
+    if f[L.DAYS_SINCE_LAST_DEPOSIT] > 30:
+        r += 0.2
+    if f[L.SUPPORT_TICKETS] > 3:
+        r += 0.1
+    if f[L.TOTAL_WITHDRAWALS] > f[L.TOTAL_DEPOSITS]:
+        r += 0.1
+    return min(r, 1.0)
+
+
+def oracle_ltv(f):
+    dsr = f[L.DAYS_SINCE_REGISTRATION]
+    net = f[L.NET_REVENUE]
+    if dsr < 30:
+        return net / max(dsr, 1) * 30 * 12
+    monthly = net / dsr * 30
+    return net + monthly * 12.0 * oracle_engagement(f)
+
+
+def oracle_predict(f):
+    ltv = oracle_ltv(f)
+    churn = oracle_churn(f)
+    adjusted = ltv * (1 - churn * 0.5)
+    if churn > 0.7:
+        seg = "churning"
+    elif adjusted >= 10000:
+        seg = "vip"
+    elif adjusted >= 1000:
+        seg = "high"
+    elif adjusted >= 100:
+        seg = "medium"
+    else:
+        seg = "low"
+    survival = int(max(90 * (1 + oracle_engagement(f)) * (1 - churn), 0))
+    return adjusted, churn, seg, survival
+
+
+def oracle_action(f, seg, churn):
+    if seg == "churning":
+        return "SEND_WINBACK_BONUS" if f[L.NET_REVENUE] > 0 else "SEND_ENGAGEMENT_EMAIL"
+    if seg == "vip":
+        return "VIP_MANAGER_CALL" if f[L.DAYS_SINCE_LAST_DEPOSIT] > 7 else "EXCLUSIVE_EVENT_INVITE"
+    if seg == "high":
+        if f[L.HAS_VIP_MANAGER] <= 0:
+            return "ASSIGN_VIP_MANAGER"
+        return "RETENTION_BONUS" if churn > 0.3 else "LOYALTY_REWARD"
+    if seg == "medium":
+        if f[L.BONUSES_CLAIMED] < 3:
+            return "SUGGEST_BONUS"
+        return "RECOMMEND_NEW_GAMES" if f[L.GAMES_PLAYED] < 5 else "STANDARD_PROMOTION"
+    if f[L.DAYS_SINCE_REGISTRATION] < 7:
+        return "ONBOARDING_GUIDE"
+    return "NO_ACTION" if f[L.BONUS_CONVERSION_RATE] > 0.8 else "SMALL_DEPOSIT_BONUS"
+
+
+def random_ltv_batch(rng, n):
+    f = np.zeros((n, NUM_LTV_FEATURES), dtype=np.float32)
+    f[:, L.DAYS_SINCE_REGISTRATION] = rng.integers(1, 720, n)
+    f[:, L.DAYS_SINCE_LAST_DEPOSIT] = rng.integers(0, 90, n)
+    f[:, L.DAYS_SINCE_LAST_BET] = rng.integers(0, 90, n)
+    f[:, L.SESSIONS_PER_WEEK] = rng.integers(0, 10, n)
+    f[:, L.DEPOSIT_FREQUENCY] = rng.integers(0, 8, n)
+    f[:, L.NET_REVENUE] = rng.integers(-5000, 50_000, n)
+    f[:, L.TOTAL_DEPOSITS] = rng.integers(0, 100_000, n)
+    f[:, L.TOTAL_WITHDRAWALS] = rng.integers(0, 100_000, n)
+    f[:, L.SUPPORT_TICKETS] = rng.integers(0, 8, n)
+    f[:, L.PUSH_ENABLED] = rng.integers(0, 2, n)
+    f[:, L.EMAIL_OPT_IN] = rng.integers(0, 2, n)
+    f[:, L.HAS_VIP_MANAGER] = rng.integers(0, 2, n)
+    f[:, L.BET_COUNT] = rng.integers(0, 500, n)
+    f[:, L.GAMES_PLAYED] = rng.integers(0, 30, n)
+    f[:, L.BONUSES_CLAIMED] = rng.integers(0, 10, n)
+    f[:, L.BONUS_CONVERSION_RATE] = rng.random(n)
+    return f
+
+
+def test_ltv_parity():
+    rng = np.random.default_rng(7)
+    f = random_ltv_batch(rng, 512)
+    out = predict_batch_jit(f)
+    ltv = np.asarray(out["ltv"])
+    churn = np.asarray(out["churn_risk"])
+    seg = np.asarray(out["segment"])
+    surv = np.asarray(out["survival_days"])
+    act = np.asarray(out["action"])
+
+    for i in range(f.shape[0]):
+        exp_ltv, exp_churn, exp_seg, exp_surv = oracle_predict(f[i].astype(np.float64))
+        np.testing.assert_allclose(churn[i], exp_churn, atol=1e-6, err_msg=f"row {i}")
+        np.testing.assert_allclose(ltv[i], exp_ltv, rtol=2e-5, atol=1e-3, err_msg=f"row {i}")
+        if abs(exp_churn - 0.7) < 1e-6 or abs(exp_churn - 0.3) < 1e-6:
+            # float32 vs float64 at the exact churn decision boundary —
+            # segment/action may legitimately flip; skip the discrete checks.
+            continue
+        assert SEG_NAMES[int(seg[i])] == exp_seg, f"row {i}: ltv={exp_ltv} churn={exp_churn}"
+        assert abs(int(surv[i]) - exp_surv) <= 1, f"row {i}"
+        exp_action = oracle_action(f[i].astype(np.float64), exp_seg, exp_churn)
+        assert ACTIONS[int(act[i])] == exp_action, f"row {i}"
+
+
+def test_new_player_projection():
+    # < 30 days: project 12 months of the current run-rate (ltv.go:160-166).
+    f = np.zeros((1, NUM_LTV_FEATURES), dtype=np.float32)
+    f[0, L.DAYS_SINCE_REGISTRATION] = 10
+    f[0, L.NET_REVENUE] = 100.0
+    f[0, L.DAYS_SINCE_LAST_BET] = 1
+    out = predict_batch_jit(f)
+    # monthly = 100/10*30 = 300; projected = 3600; churn 0 -> no adjustment
+    np.testing.assert_allclose(np.asarray(out["ltv"])[0], 3600.0, rtol=1e-5)
+    assert int(np.asarray(out["segment"])[0]) == 2  # high
+
+
+def test_churn_override_segments():
+    f = np.zeros((1, NUM_LTV_FEATURES), dtype=np.float32)
+    f[0, L.DAYS_SINCE_REGISTRATION] = 200
+    f[0, L.NET_REVENUE] = 50_000.0
+    f[0, L.DAYS_SINCE_LAST_BET] = 40  # 0.5
+    f[0, L.DAYS_SINCE_LAST_DEPOSIT] = 40  # +0.2
+    f[0, L.SESSIONS_PER_WEEK] = 0  # +0.2 (dsr > 30)
+    out = predict_batch_jit(f)
+    assert np.asarray(out["churn_risk"])[0] > 0.7
+    assert int(np.asarray(out["segment"])[0]) == 5  # churning overrides vip
+
+
+def test_segment_players_groups():
+    rng = np.random.default_rng(1)
+    f = random_ltv_batch(rng, 64)
+    groups = segment_players(f)
+    total = sum(len(v) for v in groups.values())
+    assert total == 64

@@ -1,0 +1,83 @@
+"""MLP / GBDT model tests: shapes, ranges, soft-hard consistency."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from igaming_platform_tpu.core.features import NUM_FEATURES
+from igaming_platform_tpu.models.gbdt import (
+    gbdt_predict,
+    gbdt_raw,
+    init_gbdt,
+    soft_gbdt_raw,
+)
+from igaming_platform_tpu.models.mlp import init_mlp, mlp_predict, num_params
+
+
+def test_mlp_shapes_and_range():
+    params = init_mlp(jax.random.key(0))
+    x = np.random.default_rng(0).random((16, NUM_FEATURES)).astype(np.float32)
+    p = mlp_predict(params, x)
+    assert p.shape == (16,)
+    assert np.all((np.asarray(p) >= 0) & (np.asarray(p) <= 1))
+    assert num_params(params) > NUM_FEATURES * 64
+
+
+def test_mlp_deterministic():
+    params = init_mlp(jax.random.key(1))
+    x = np.ones((4, NUM_FEATURES), dtype=np.float32)
+    np.testing.assert_array_equal(np.asarray(mlp_predict(params, x)), np.asarray(mlp_predict(params, x)))
+
+
+def test_gbdt_shapes_and_range():
+    params = init_gbdt(jax.random.key(0), n_trees=32, depth=3)
+    x = np.random.default_rng(0).random((8, NUM_FEATURES)).astype(np.float32)
+    p = gbdt_predict(params, x)
+    assert p.shape == (8,)
+    assert np.all((np.asarray(p) >= 0) & (np.asarray(p) <= 1))
+
+
+def test_gbdt_leaf_selection_manual():
+    # One tree, depth 2: features 0 and 1 with thresholds 0.5.
+    params = {
+        "feat": jnp.array([[0, 1]], jnp.int32),
+        "thr": jnp.array([[0.5, 0.5]], jnp.float32),
+        "leaves": jnp.array([[10.0, 20.0, 30.0, 40.0]], jnp.float32),
+        "bias": jnp.zeros((), jnp.float32),
+    }
+    x = np.zeros((4, NUM_FEATURES), dtype=np.float32)
+    x[1, 0] = 1.0  # bit0 -> leaf 1
+    x[2, 1] = 1.0  # bit1 -> leaf 2
+    x[3, 0] = 1.0
+    x[3, 1] = 1.0  # leaf 3
+    out = np.asarray(gbdt_raw(params, x))
+    np.testing.assert_allclose(out, [10.0, 20.0, 30.0, 40.0])
+
+
+def test_soft_gbdt_converges_to_hard():
+    params = init_gbdt(jax.random.key(3), n_trees=16, depth=3)
+    x = np.random.default_rng(1).random((32, NUM_FEATURES)).astype(np.float32)
+    hard = np.asarray(gbdt_raw(params, x))
+    soft = np.asarray(soft_gbdt_raw(params, x, temperature=5000.0))
+
+    # Rows where some feature sits within sigmoid reach of a threshold are
+    # legitimately blended by the relaxation; compare the rest exactly.
+    feat = np.asarray(params["feat"]).reshape(-1)
+    thr = np.asarray(params["thr"]).reshape(-1)
+    dist = np.abs(x[:, feat] - thr[None, :]).min(axis=1)
+    clear = dist > 5e-3
+    assert clear.sum() > 16
+    np.testing.assert_allclose(soft[clear], hard[clear], atol=1e-2)
+
+
+def test_soft_gbdt_is_differentiable():
+    params = init_gbdt(jax.random.key(4), n_trees=8, depth=2)
+    x = jnp.ones((4, NUM_FEATURES)) * 0.5
+
+    def loss(leaves, thr):
+        p = {"feat": params["feat"], "thr": thr, "leaves": leaves, "bias": params["bias"]}
+        return jnp.mean(soft_gbdt_raw(p, x, temperature=5.0) ** 2)
+
+    g_leaves, g_thr = jax.grad(loss, argnums=(0, 1))(params["leaves"], params["thr"])
+    assert float(jnp.sum(jnp.abs(g_leaves))) > 0
+    assert float(jnp.sum(jnp.abs(g_thr))) > 0

@@ -1,0 +1,256 @@
+"""Golden parity: vectorized scorer vs a straight-line Python oracle of the
+reference Go engine (engine.go:262-323 + onnx_model.go:169-308).
+
+The oracle mirrors Go's numerics: float32 feature storage/normalization,
+float64 comparisons and ensemble math, truncating int conversion. The
+device path runs in float32; the ensemble combine may differ by 1 point
+when the float64 value sits within float32 epsilon of an integer — the test
+asserts exactness except at those provable boundaries.
+"""
+
+import numpy as np
+import pytest
+
+from igaming_platform_tpu.core.config import ScoringConfig
+from igaming_platform_tpu.core.enums import REASON_BIT_ORDER, ReasonCode, decode_reason_mask
+from igaming_platform_tpu.core.features import F, NUM_FEATURES
+from igaming_platform_tpu.models.ensemble import jit_score_fn
+from igaming_platform_tpu.models.rules import RULE_WEIGHTS
+
+# ---------------------------------------------------------------------------
+# Reference oracle (Go semantics, per-row)
+# ---------------------------------------------------------------------------
+
+_MINMAX = {
+    F.TX_COUNT_1M: 20.0,
+    F.TX_COUNT_5M: 50.0,
+    F.TX_COUNT_1H: 200.0,
+    F.UNIQUE_DEVICES_24H: 10.0,
+    F.UNIQUE_IPS_24H: 20.0,
+    F.ACCOUNT_AGE_DAYS: 365.0,
+    F.TIME_SINCE_LAST_TX: 86400.0,
+}
+_LOG = (F.TX_SUM_1H, F.TOTAL_DEPOSITS, F.TOTAL_WITHDRAWALS, F.TX_AMOUNT)
+
+
+def oracle_normalize(row):
+    """onnx_model.go:169-205 with the stubbed identity log1p, float32 math."""
+    out = row.astype(np.float32).copy()
+    for i in _LOG:
+        out[i] = np.float32(0.0) if out[i] <= 0 else out[i]
+    for i, hi in _MINMAX.items():
+        x = out[i]
+        if x < 0:
+            out[i] = np.float32(0.0)
+        elif x > hi:
+            out[i] = np.float32(1.0)
+        else:
+            out[i] = np.float32(x / np.float32(hi))
+    return out
+
+
+def oracle_mock_predict(xn):
+    """onnx_model.go:258-308; float32 features, float64 accumulation."""
+    s = 0.0
+    if float(xn[F.TX_COUNT_1M]) > 0.5:
+        s += 0.2
+    if float(xn[F.TX_COUNT_1H]) > 0.5:
+        s += 0.15
+    if float(xn[F.UNIQUE_DEVICES_24H]) > 0.3:
+        s += 0.15
+    if float(xn[F.UNIQUE_IPS_24H]) > 0.25:
+        s += 0.1
+    if xn[F.IS_VPN] > 0 or xn[F.IS_PROXY] > 0:
+        s += 0.15
+    if xn[F.IS_TOR] > 0:
+        s += 0.25
+    if float(xn[F.ACCOUNT_AGE_DAYS]) < 0.02 and float(xn[F.TX_AMOUNT]) > 0.5:
+        s += 0.2
+    if xn[F.BONUS_ONLY_PLAYER] > 0:
+        s += 0.15
+    if (
+        float(xn[F.TIME_SINCE_LAST_TX]) < 0.01
+        and xn[F.TX_TYPE_WITHDRAW] > 0
+        and float(xn[F.TOTAL_WITHDRAWALS]) > float(xn[F.TOTAL_DEPOSITS]) * 0.8
+    ):
+        s += 0.2
+    return min(s, 1.0)
+
+
+def oracle_rules(row, blacklisted, cfg):
+    """engine.go:420-483; raw features, int64 math for rule 6."""
+    score = 0
+    reasons = []
+
+    def hit(code):
+        nonlocal score
+        score += RULE_WEIGHTS[code]
+        reasons.append(code)
+
+    if row[F.TX_COUNT_1M] > cfg.max_tx_per_minute:
+        hit(ReasonCode.HIGH_VELOCITY)
+    if row[F.ACCOUNT_AGE_DAYS] < cfg.new_account_days and row[F.TX_AMOUNT] > cfg.large_deposit_amount:
+        hit(ReasonCode.NEW_ACCOUNT_LARGE_TX)
+    if row[F.UNIQUE_DEVICES_24H] > cfg.max_devices_per_day:
+        hit(ReasonCode.MULTIPLE_DEVICES)
+    if row[F.UNIQUE_IPS_24H] > cfg.max_ips_per_day:
+        hit(ReasonCode.IP_COUNTRY_MISMATCH)
+    if row[F.IS_VPN] > 0 or row[F.IS_PROXY] > 0 or row[F.IS_TOR] > 0:
+        hit(ReasonCode.VPN_DETECTED)
+    if row[F.TIME_SINCE_LAST_TX] < 300 and row[F.TX_TYPE_WITHDRAW] > 0:
+        if row[F.DEPOSIT_COUNT] > 0 and int(row[F.TOTAL_WITHDRAWALS]) > int(row[F.TOTAL_DEPOSITS]) * 80 // 100:
+            hit(ReasonCode.RAPID_DEPOSIT_WITHDRAW)
+    if row[F.BONUS_ONLY_PLAYER] > 0:
+        hit(ReasonCode.BONUS_ABUSE)
+    if blacklisted:
+        hit(ReasonCode.KNOWN_FRAUDSTER)
+
+    return min(score, 100), reasons
+
+
+def oracle_score(row, blacklisted, cfg):
+    """Full Score pipeline (engine.go:262-323)."""
+    rule_score, reasons = oracle_rules(row, blacklisted, cfg)
+    ml = oracle_mock_predict(oracle_normalize(row))
+    if ml > 0.7:
+        reasons = reasons + [ReasonCode.ML_HIGH_RISK]
+    final = int(cfg.rule_weight * float(rule_score) + cfg.ml_weight * (ml * 100.0))
+    final = min(final, 100)
+    if final >= cfg.block_threshold:
+        action = "block"
+    elif final >= cfg.review_threshold:
+        action = "review"
+    else:
+        action = "approve"
+    return final, action, rule_score, ml, reasons
+
+
+# ---------------------------------------------------------------------------
+# Random feature generation over realistic ranges
+# ---------------------------------------------------------------------------
+
+
+def random_batch(rng, n):
+    x = np.zeros((n, NUM_FEATURES), dtype=np.float32)
+    x[:, F.TX_COUNT_1M] = rng.integers(0, 25, n)
+    x[:, F.TX_COUNT_5M] = rng.integers(0, 60, n)
+    x[:, F.TX_COUNT_1H] = rng.integers(0, 250, n)
+    x[:, F.TX_SUM_1H] = rng.integers(0, 500_000, n)
+    x[:, F.UNIQUE_DEVICES_24H] = rng.integers(0, 8, n)
+    x[:, F.UNIQUE_IPS_24H] = rng.integers(0, 12, n)
+    x[:, F.IP_COUNTRY_CHANGES] = rng.integers(0, 4, n)
+    x[:, F.DEVICE_AGE_DAYS] = rng.integers(0, 400, n)
+    x[:, F.ACCOUNT_AGE_DAYS] = rng.integers(0, 400, n)
+    x[:, F.TOTAL_DEPOSITS] = rng.integers(0, 2_000_000, n)
+    x[:, F.TOTAL_WITHDRAWALS] = rng.integers(0, 2_000_000, n)
+    x[:, F.NET_DEPOSIT] = x[:, F.TOTAL_DEPOSITS] - x[:, F.TOTAL_WITHDRAWALS]
+    x[:, F.DEPOSIT_COUNT] = rng.integers(0, 50, n)
+    x[:, F.WITHDRAW_COUNT] = rng.integers(0, 30, n)
+    x[:, F.TIME_SINCE_LAST_TX] = rng.integers(0, 100_000, n)
+    x[:, F.SESSION_DURATION] = rng.integers(0, 20_000, n)
+    x[:, F.AVG_BET_SIZE] = rng.uniform(0, 10_000, n)
+    x[:, F.WIN_RATE] = rng.uniform(0, 1, n)
+    x[:, F.IS_VPN] = rng.integers(0, 2, n)
+    x[:, F.IS_PROXY] = rng.integers(0, 2, n)
+    x[:, F.IS_TOR] = (rng.random(n) < 0.1).astype(np.float32)
+    x[:, F.DISPOSABLE_EMAIL] = rng.integers(0, 2, n)
+    x[:, F.BONUS_CLAIM_COUNT] = rng.integers(0, 10, n)
+    x[:, F.BONUS_WAGER_RATE] = rng.uniform(0, 1, n)
+    x[:, F.BONUS_ONLY_PLAYER] = (rng.random(n) < 0.2).astype(np.float32)
+    x[:, F.TX_AMOUNT] = rng.integers(1, 300_000, n)
+    tx_type = rng.integers(0, 3, n)
+    x[:, F.TX_TYPE_DEPOSIT] = tx_type == 0
+    x[:, F.TX_TYPE_WITHDRAW] = tx_type == 1
+    x[:, F.TX_TYPE_BET] = tx_type == 2
+    # derive tx_avg like the engine does (engine.go:412-414)
+    cnt = x[:, F.TX_COUNT_1H]
+    x[:, F.TX_AVG_1H] = np.where(cnt > 0, x[:, F.TX_SUM_1H] / np.maximum(cnt, 1), 0.0)
+    return x
+
+
+CFG = ScoringConfig()
+
+
+def test_full_pipeline_parity():
+    rng = np.random.default_rng(42)
+    x = random_batch(rng, 1024)
+    blacklisted = rng.random(1024) < 0.05
+
+    fn = jit_score_fn(CFG, "mock")
+    out = fn(None, x, blacklisted)
+    scores = np.asarray(out["score"])
+    actions = np.asarray(out["action"])
+    rule_scores = np.asarray(out["rule_score"])
+    ml_scores = np.asarray(out["ml_score"])
+    masks = np.asarray(out["reason_mask"])
+
+    action_names = {1: "approve", 2: "review", 3: "block"}
+    mismatches = 0
+    for i in range(x.shape[0]):
+        exp_final, exp_action, exp_rule, exp_ml, exp_reasons = oracle_score(x[i], bool(blacklisted[i]), CFG)
+        assert rule_scores[i] == exp_rule, f"row {i}: rule {rule_scores[i]} != {exp_rule}"
+        np.testing.assert_allclose(ml_scores[i], exp_ml, atol=1e-6, err_msg=f"row {i}")
+
+        got_reasons = decode_reason_mask(int(masks[i]))
+        if got_reasons != exp_reasons:
+            # Sole tolerated difference: ML_HIGH_RISK at the exact 0.7
+            # boundary, where Go's float64 sum of decimal literals lands an
+            # ulp away from the float32 sum (both are "0.7").
+            only_ml = set(got_reasons) ^ set(exp_reasons) == {ReasonCode.ML_HIGH_RISK}
+            assert only_ml and abs(exp_ml - 0.7) < 2e-6, f"row {i}: {got_reasons} != {exp_reasons}"
+            mismatches += 1
+
+        if scores[i] != exp_final:
+            # Allowed only at float32/float64 ensemble boundaries (<= 1 pt).
+            f64 = CFG.rule_weight * exp_rule + CFG.ml_weight * exp_ml * 100.0
+            assert abs(scores[i] - exp_final) <= 1 and abs(f64 - round(f64)) < 1e-3, (
+                f"row {i}: {scores[i]} != {exp_final} (f64 ensemble {f64})"
+            )
+            mismatches += 1
+        else:
+            assert action_names[actions[i]] == exp_action, f"row {i}"
+
+    # Boundary mismatches must be rare (0.7-boundary rows count twice:
+    # once for the reason bit, once for the 1-point score delta).
+    assert mismatches <= x.shape[0] * 0.025, mismatches
+
+
+def test_devices_exactly_3_triggers_mock_rule():
+    """Go promotes float32 to float64: 3 devices / 10 = 0.30000001f > 0.3."""
+    x = np.zeros((1, NUM_FEATURES), dtype=np.float32)
+    x[0, F.UNIQUE_DEVICES_24H] = 3
+    xn = oracle_normalize(x[0])
+    assert oracle_mock_predict(xn) == pytest.approx(0.15)
+
+    fn = jit_score_fn(CFG, "mock")
+    out = fn(None, x, np.zeros(1, bool))
+    np.testing.assert_allclose(np.asarray(out["ml_score"])[0], 0.15, atol=1e-6)
+
+
+def test_blacklist_plus_velocity_blocks():
+    x = np.zeros((1, NUM_FEATURES), dtype=np.float32)
+    x[0, F.TX_COUNT_1M] = 15
+    x[0, F.TX_COUNT_1H] = 150
+    x[0, F.IS_TOR] = 1
+    x[0, F.TX_AMOUNT] = 1
+    fn = jit_score_fn(CFG, "mock")
+    out = fn(None, x, np.ones(1, bool))
+    # rules: velocity 20 + vpn 15 + blacklist 50 = 85
+    # mock ml: velocity .2 + .15, tor .25, new-account+amount .2 = .8
+    assert int(np.asarray(out["rule_score"])[0]) == 85
+    assert int(np.asarray(out["score"])[0]) == int(0.4 * 85 + 0.6 * 80)
+    assert int(np.asarray(out["action"])[0]) == 3  # block
+    reasons = decode_reason_mask(int(np.asarray(out["reason_mask"])[0]))
+    assert ReasonCode.KNOWN_FRAUDSTER in reasons and ReasonCode.HIGH_VELOCITY in reasons
+
+
+def test_clean_transaction_approves():
+    x = np.zeros((1, NUM_FEATURES), dtype=np.float32)
+    x[0, F.ACCOUNT_AGE_DAYS] = 200
+    x[0, F.TX_AMOUNT] = 5_000
+    x[0, F.TX_TYPE_DEPOSIT] = 1
+    fn = jit_score_fn(CFG, "mock")
+    out = fn(None, x, np.zeros(1, bool))
+    assert int(np.asarray(out["score"])[0]) == 0
+    assert int(np.asarray(out["action"])[0]) == 1  # approve
+    assert int(np.asarray(out["reason_mask"])[0]) == 0
