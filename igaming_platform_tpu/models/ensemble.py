@@ -43,8 +43,14 @@ def combine(
     ml_score: jnp.ndarray,
     reason_mask: jnp.ndarray,
     cfg: ScoringConfig,
+    thresholds: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Ensemble + action decision (engine.go:285-310).
+
+    ``thresholds`` is an optional dynamic [2] int32 array (block, review) —
+    the runtime-tunable thresholds of engine.go:498-504 / risk.proto
+    UpdateThresholds enter the graph as data, so tuning them never triggers
+    recompilation. Falls back to the static config values.
 
     Returns (final_score [B] i32, action [B] i32, reason_mask [B] i32).
     """
@@ -58,10 +64,15 @@ def combine(
     # ML_HIGH_RISK appended when ml > 0.7 (engine.go:285-287).
     reason_mask = reason_mask | jnp.where(ml_score > 0.7, 1 << ML_HIGH_RISK_BIT, 0)
 
+    if thresholds is None:
+        block, review = cfg.block_threshold, cfg.review_threshold
+    else:
+        block, review = thresholds[0], thresholds[1]
+
     action = jnp.where(
-        final >= cfg.block_threshold,
+        final >= block,
         ACTION_BLOCK,
-        jnp.where(final >= cfg.review_threshold, ACTION_REVIEW, ACTION_APPROVE),
+        jnp.where(final >= review, ACTION_REVIEW, ACTION_APPROVE),
     ).astype(jnp.int32)
     return final, action, reason_mask
 
@@ -88,7 +99,12 @@ def make_score_fn(
     """
     ref_compat = ml_backend == "mock"
 
-    def score_fn(params: Any, x_raw: jnp.ndarray, blacklisted: jnp.ndarray) -> dict[str, jnp.ndarray]:
+    def score_fn(
+        params: Any,
+        x_raw: jnp.ndarray,
+        blacklisted: jnp.ndarray,
+        thresholds: jnp.ndarray | None = None,
+    ) -> dict[str, jnp.ndarray]:
         x_raw = jnp.asarray(x_raw, jnp.float32)
         xn = normalize(x_raw, ref_compat=ref_compat)
 
@@ -104,7 +120,7 @@ def make_score_fn(
             raise ValueError(f"unknown ml backend: {ml_backend}")
 
         rule_score, mask = apply_rules(x_raw, blacklisted, cfg)
-        final, action, mask = combine(rule_score, ml, mask, cfg)
+        final, action, mask = combine(rule_score, ml, mask, cfg, thresholds)
         return {
             "score": final,
             "action": action,

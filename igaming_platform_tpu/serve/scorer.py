@@ -1,0 +1,194 @@
+"""TPUScoringEngine — the risk service's brain, hot path on the device.
+
+Equivalent of the reference ScoringEngine (engine.go:179-323) re-built for
+TPU serving:
+
+- feature gather is a host-side dictionary stage (serve/feature_store.py)
+  replacing the 3-goroutine Redis/ClickHouse/IP-intel fan-out;
+- everything from normalization through rules, ML, ensemble and action
+  decision is ONE compiled XLA program over a fixed [B, 30] batch
+  (models/ensemble.py), AOT-warmed at startup before health flips to
+  SERVING (SURVEY.md §3.5);
+- single-request Score calls ride the continuous batcher; ScoreBatch and
+  the event-stream bridge call the batch path directly;
+- thresholds are runtime-tunable without recompilation (dynamic inputs);
+- params hot-swap atomically (train/ hands over new checkpoints).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import numpy as np
+
+from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
+from igaming_platform_tpu.core.enums import ReasonCode, action_from_code, decode_reason_mask
+from igaming_platform_tpu.core.features import NUM_FEATURES, FeatureVector
+from igaming_platform_tpu.models.ensemble import make_score_fn
+from igaming_platform_tpu.parallel.mesh import AXIS_DATA, validate_batch_for_mesh
+from igaming_platform_tpu.serve.batcher import ContinuousBatcher, pad_batch
+from igaming_platform_tpu.serve.feature_store import InMemoryFeatureStore, TransactionEvent
+
+
+@dataclass
+class ScoreRequest:
+    """Mirror of scoring.ScoreRequest (engine.go:40-53)."""
+
+    account_id: str
+    amount: int = 0
+    tx_type: str = "deposit"
+    player_id: str = ""
+    currency: str = "USD"
+    game_id: str = ""
+    ip: str = ""
+    device_id: str = ""
+    fingerprint: str = ""
+    user_agent: str = ""
+    session_id: str = ""
+    ip_flags: tuple[int, int, int] | None = None  # (vpn, proxy, tor) when known
+
+
+@dataclass
+class ScoreResponse:
+    """Mirror of scoring.ScoreResponse (engine.go:56-64)."""
+
+    score: int
+    action: str
+    reason_codes: list[ReasonCode]
+    rule_score: int
+    ml_score: float
+    response_time_ms: float
+    features: FeatureVector
+
+
+class TPUScoringEngine:
+    def __init__(
+        self,
+        config: ScoringConfig | None = None,
+        *,
+        ml_backend: str = "mock",
+        params: Any = None,
+        mesh=None,
+        batcher_config: BatcherConfig | None = None,
+        feature_store: InMemoryFeatureStore | None = None,
+        warmup: bool = True,
+    ):
+        self.config = config or ScoringConfig()
+        self.ml_backend = ml_backend
+        self._params = params
+        self._params_lock = threading.Lock()
+        self.features = feature_store or InMemoryFeatureStore()
+        self.batch_size = (batcher_config or BatcherConfig()).batch_size
+        self._thresholds = np.array(
+            [self.config.block_threshold, self.config.review_threshold], dtype=np.int32
+        )
+        self._mesh = mesh
+
+        fn = make_score_fn(self.config, ml_backend)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            validate_batch_for_mesh(self.batch_size, mesh)
+            row = NamedSharding(mesh, P(AXIS_DATA, None))
+            vec = NamedSharding(mesh, P(AXIS_DATA))
+            repl = NamedSharding(mesh, P())
+            self._fn = jax.jit(
+                fn, in_shardings=(None, row, vec, repl), out_shardings=vec
+            )
+        else:
+            self._fn = jax.jit(fn)
+
+        self._batcher = ContinuousBatcher(self._run_requests, batcher_config)
+        if warmup:
+            self.warmup()
+        self._batcher.start()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def warmup(self) -> None:
+        """AOT-compile the serving shape before accepting traffic."""
+        x = np.zeros((self.batch_size, NUM_FEATURES), dtype=np.float32)
+        bl = np.zeros((self.batch_size,), dtype=bool)
+        jax.block_until_ready(self._fn(self._params, x, bl, self._thresholds))
+
+    def close(self) -> None:
+        self._batcher.stop()
+
+    # -- params / thresholds -------------------------------------------------
+
+    def swap_params(self, params: Any) -> None:
+        """Atomically install new model parameters (hot-swap from train/)."""
+        with self._params_lock:
+            self._params = params
+
+    def get_thresholds(self) -> tuple[int, int]:
+        t = self._thresholds
+        return int(t[0]), int(t[1])
+
+    def set_thresholds(self, block: int, review: int) -> None:
+        """Runtime threshold tuning (engine.go:498-504) — no recompile."""
+        self._thresholds = np.array([block, review], dtype=np.int32)
+
+    # -- scoring -------------------------------------------------------------
+
+    def score(self, req: ScoreRequest, timeout: float = 30.0) -> ScoreResponse:
+        """Single-transaction scoring via the continuous batcher."""
+        start = time.monotonic()
+        resp: ScoreResponse = self._batcher.score_sync(req, timeout=timeout)
+        resp.response_time_ms = (time.monotonic() - start) * 1000.0
+        return resp
+
+    def score_batch(self, reqs: list[ScoreRequest]) -> list[ScoreResponse]:
+        """Direct batch path (ScoreBatch RPC / event-stream replay)."""
+        start = time.monotonic()
+        responses = self._run_requests(reqs)
+        elapsed_ms = (time.monotonic() - start) * 1000.0
+        for r in responses:
+            r.response_time_ms = elapsed_ms
+        return responses
+
+    def update_features(self, event: TransactionEvent) -> None:
+        """Post-transaction write-back (engine.go:486-488)."""
+        self.features.update(event)
+
+    # -- internals -----------------------------------------------------------
+
+    def _run_requests(self, reqs: list[ScoreRequest]) -> list[ScoreResponse]:
+        x, bl = self.features.gather_batch(reqs)
+        out, n = self._run_device(x, bl)
+        return [self._row_response(out, x, i) for i in range(n)]
+
+    def _run_device(self, x: np.ndarray, bl: np.ndarray):
+        n = x.shape[0]
+        xp, _ = pad_batch(x, self.batch_size)
+        blp, _ = pad_batch(bl, self.batch_size)
+        with self._params_lock:
+            params = self._params
+        out = self._fn(params, xp, blp, self._thresholds)
+        return jax.device_get(out), n
+
+    def _row_response(self, out: dict, x: np.ndarray, i: int) -> ScoreResponse:
+        return ScoreResponse(
+            score=int(out["score"][i]),
+            action=action_from_code(int(out["action"][i])).value,
+            reason_codes=decode_reason_mask(int(out["reason_mask"][i])),
+            rule_score=int(out["rule_score"][i]),
+            ml_score=float(out["ml_score"][i]),
+            response_time_ms=0.0,
+            features=FeatureVector.from_array(x[i]),
+        )
+
+    # -- raw array path (bench / replay) -------------------------------------
+
+    def score_arrays(self, x: np.ndarray, blacklisted: np.ndarray | None = None) -> dict:
+        """Score a pre-gathered [N, 30] batch; N must equal the compiled
+        batch size (bench/replay path, zero padding overhead)."""
+        if blacklisted is None:
+            blacklisted = np.zeros((x.shape[0],), dtype=bool)
+        with self._params_lock:
+            params = self._params
+        return self._fn(params, x, blacklisted, self._thresholds)

@@ -1,0 +1,99 @@
+"""Event backbone tests: topic routing, ack/nack discipline, typed events."""
+
+import json
+
+from igaming_platform_tpu.core.enums import (
+    EXCHANGE_RISK,
+    EXCHANGE_WALLET,
+    QUEUE_ANALYTICS,
+    QUEUE_BONUS_PROCESSOR,
+    QUEUE_RISK_SCORING,
+)
+from igaming_platform_tpu.serve.events import (
+    Consumer,
+    Event,
+    InMemoryBroker,
+    Publisher,
+    default_broker,
+    new_risk_event,
+    new_transaction_event,
+    topic_matches,
+)
+
+
+def test_topic_matching():
+    assert topic_matches("#", "transaction.completed")
+    assert topic_matches("transaction.*", "transaction.completed")
+    assert not topic_matches("transaction.*", "bonus.awarded")
+    assert topic_matches("*.completed", "transaction.completed")
+    assert not topic_matches("*.completed", "a.b.completed")
+    assert topic_matches("a.#", "a.b.c")
+    assert topic_matches("a.#.c", "a.c")
+    assert not topic_matches("a.b", "a")
+
+
+def test_event_json_roundtrip():
+    e = Event(type="transaction.completed", source="wallet", aggregate_id="acct", data={"amount": 100})
+    e2 = Event.from_json(e.to_json())
+    assert e2.type == e.type and e2.data == e.data and e2.id == e.id
+
+
+def test_default_topology_routing():
+    b = default_broker()
+    pub = Publisher(b)
+    pub.publish(EXCHANGE_WALLET, new_transaction_event("transaction.completed", {"account_id": "a", "amount": 5}))
+    assert b.queue_depth(QUEUE_RISK_SCORING) == 1
+    assert b.queue_depth(QUEUE_BONUS_PROCESSOR) == 1
+    assert b.queue_depth(QUEUE_ANALYTICS) == 1
+
+    pub.publish(EXCHANGE_RISK, new_risk_event("fraud.detected", {"account_id": "a", "score": 95}))
+    assert b.queue_depth(QUEUE_ANALYTICS) == 2
+    assert b.queue_depth(QUEUE_RISK_SCORING) == 1  # risk events don't loop back
+
+
+def test_consumer_ack_and_poison():
+    b = InMemoryBroker()
+    b.declare_exchange("x")
+    b.bind("q", "x", "#")
+
+    seen = []
+    c = Consumer(b)
+    c.subscribe("q", lambda e: seen.append(e.type))
+
+    pub = Publisher(b)
+    pub.publish("x", Event(type="ok.event"))
+    b.publish_raw("x", "bad", "{not json")
+    processed = c.drain("q")
+    assert processed == 2
+    assert seen == ["ok.event"]
+    assert len(b.dead_letters) == 1  # malformed rejected, not requeued
+
+
+def test_consumer_nack_requeue_bounded():
+    b = InMemoryBroker()
+    b.declare_exchange("x")
+    b.bind("q", "x", "#")
+    attempts = []
+
+    def failing(e):
+        attempts.append(e.id)
+        raise RuntimeError("boom")
+
+    c = Consumer(b, max_redelivery=3)
+    c.subscribe("q", failing)
+    Publisher(b).publish("x", Event(type="t"))
+
+    # Drain repeatedly: each attempt fails and requeues until the bound.
+    total = 0
+    for _ in range(10):
+        total += c.drain("q")
+    assert len(attempts) == 4  # 1 initial + 3 redeliveries
+    assert len(b.dead_letters) == 1
+
+
+def test_typed_event_payloads():
+    e = new_transaction_event("bet.placed", {"id": "t1", "account_id": "a1", "type": "bet", "amount": 500})
+    assert e.source == "wallet-service"
+    assert e.aggregate_id == "a1"
+    payload = json.loads(e.to_json())
+    assert payload["data"]["amount"] == 500
