@@ -1,0 +1,85 @@
+"""Benchmark harness — fraud-scoring throughput on the live device.
+
+Runs the flagship serving graph (normalize -> multitask fraud head ->
+vectorized rules -> ensemble -> action, one XLA program) over streamed
+[B, 30] batches, including host->device transfer per batch, and prints ONE
+JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline: the reference publishes no throughput (BASELINE.md) — its path is
+single-sample ONNX-CPU behind CGo. ``vs_baseline`` is measured against the
+north-star target of 100,000 fraud-scored txns/sec (BASELINE.json), so
+vs_baseline >= 1.0 means the target is met.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+TARGET_TXNS_PER_SEC = 100_000.0
+
+
+def main() -> None:
+    import jax
+
+    from igaming_platform_tpu.core.config import ScoringConfig
+    from igaming_platform_tpu.models.ensemble import make_score_fn
+    from igaming_platform_tpu.models.multitask import init_multitask
+    from igaming_platform_tpu.train.data import sample_features
+
+    batch_size = int(os.environ.get("BENCH_BATCH", 16384))
+    warmup_iters = int(os.environ.get("BENCH_WARMUP", 5))
+    iters = int(os.environ.get("BENCH_ITERS", 50))
+
+    cfg = ScoringConfig()
+    fn = jax.jit(make_score_fn(cfg, ml_backend="multitask"), donate_argnums=(1,))
+    params = {"multitask": init_multitask(jax.random.key(0))}
+    thresholds = np.array([cfg.block_threshold, cfg.review_threshold], dtype=np.int32)
+
+    rng = np.random.default_rng(0)
+    pool = [sample_features(rng, batch_size) for _ in range(4)]
+    blacklisted = np.zeros((batch_size,), dtype=bool)
+
+    # Warm-up: compile + stabilise clocks.
+    for i in range(warmup_iters):
+        out = fn(params, pool[i % len(pool)].copy(), blacklisted, thresholds)
+    jax.block_until_ready(out)
+
+    # Steady state: per-iteration wall time includes the host->device copy
+    # of the feature batch (the serving-relevant cost), with device work
+    # from the previous iteration overlapping the next copy via async
+    # dispatch; the final block_until_ready closes the pipeline.
+    lat = []
+    start = time.perf_counter()
+    for i in range(iters):
+        t0 = time.perf_counter()
+        out = fn(params, pool[i % len(pool)].copy(), blacklisted, thresholds)
+        out["score"].block_until_ready()
+        lat.append((time.perf_counter() - t0) * 1000.0)
+    total = time.perf_counter() - start
+
+    txns_per_sec = batch_size * iters / total
+    lat = np.array(lat)
+    result = {
+        "metric": "fraud_score_txns_per_sec",
+        "value": round(float(txns_per_sec), 1),
+        "unit": "txns/s",
+        "vs_baseline": round(float(txns_per_sec / TARGET_TXNS_PER_SEC), 3),
+        "batch_size": batch_size,
+        "iters": iters,
+        "p50_batch_ms": round(float(np.percentile(lat, 50)), 3),
+        "p99_batch_ms": round(float(np.percentile(lat, 99)), 3),
+        "device": str(jax.devices()[0]),
+        "backend": "multitask-ensemble",
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -88,6 +88,7 @@ def make_score_fn(
       - "mlp":   trained fraud MLP
       - "gbdt":  oblivious-forest GBDT
       - "mlp+gbdt": mean of MLP and GBDT probabilities
+      - "multitask": fraud head of the joint fraud+LTV multi-task net
 
     The returned fn has signature ``f(params, x_raw, blacklisted)`` with
     ``x_raw`` a [B, 30] float32 raw feature batch and returns a dict of
@@ -116,6 +117,10 @@ def make_score_fn(
             ml = gbdt_mod.gbdt_predict(params["gbdt"], xn)
         elif ml_backend == "mlp+gbdt":
             ml = 0.5 * (mlp_mod.mlp_predict(params["mlp"], xn) + gbdt_mod.gbdt_predict(params["gbdt"], xn))
+        elif ml_backend == "multitask":
+            from igaming_platform_tpu.models.multitask import fraud_predict
+
+            ml = fraud_predict(params["multitask"], xn)
         else:
             raise ValueError(f"unknown ml backend: {ml_backend}")
 

@@ -1,0 +1,104 @@
+"""Joint fraud + LTV multi-task MLP — the trained replacement for both the
+ONNX fraud net and the heuristic LTV formulas.
+
+BASELINE.json config 5: "Joint fraud+LTV multi-task MLP, DP-sharded JAX
+training on v5e-8". One shared trunk over the 30-dim fraud feature schema
+with three heads:
+
+- fraud:  P(fraud) logit            (replaces onnx_model.go Predict)
+- ltv:    predicted dollar value    (replaces ltv.go calculateLTV)
+- churn:  P(churn) logit            (replaces ltv.go calculateChurnRisk)
+
+Pure pytree like models/mlp.py; trunk hidden layers carry the TP sharding
+rules of parallel/sharding.mlp_param_specs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from igaming_platform_tpu.core.features import NUM_FEATURES
+from igaming_platform_tpu.models.mlp import _dense
+
+Params = dict[str, Any]
+
+DEFAULT_TRUNK = (256, 256)
+
+
+def init_multitask(
+    key: jax.Array,
+    trunk: Sequence[int] = DEFAULT_TRUNK,
+    in_dim: int = NUM_FEATURES,
+) -> Params:
+    dims = (in_dim, *trunk)
+    keys = jax.random.split(key, len(trunk) + 3)
+    layers = []
+    for i, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
+        w = jax.random.normal(keys[i], (d_in, d_out), jnp.float32) * jnp.sqrt(2.0 / d_in)
+        layers.append({"w": w, "b": jnp.zeros((d_out,), jnp.float32)})
+    d = dims[-1]
+
+    def head(k, scale=1.0):
+        return {
+            "w": jax.random.normal(k, (d, 1), jnp.float32) * jnp.sqrt(1.0 / d) * scale,
+            "b": jnp.zeros((1,), jnp.float32),
+        }
+
+    return {
+        "trunk": {"layers": layers},
+        "fraud_head": head(keys[-3]),
+        "ltv_head": head(keys[-2]),
+        "churn_head": head(keys[-1]),
+    }
+
+
+def trunk_features(params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    h = jnp.asarray(x, jnp.float32)
+    for layer in params["trunk"]["layers"]:
+        h = jax.nn.relu(_dense(h, layer))
+    return h
+
+
+def multitask_forward(params: Params, x: jnp.ndarray) -> dict[str, jnp.ndarray]:
+    """[B, 30] normalized features -> {"fraud", "ltv", "churn"} ([B] each)."""
+    h = trunk_features(params, x)
+    fraud_logit = _dense(h, params["fraud_head"])[..., 0]
+    ltv = _dense(h, params["ltv_head"])[..., 0]
+    churn_logit = _dense(h, params["churn_head"])[..., 0]
+    return {
+        "fraud": jax.nn.sigmoid(fraud_logit),
+        "fraud_logit": fraud_logit,
+        "ltv": ltv,
+        "churn": jax.nn.sigmoid(churn_logit),
+        "churn_logit": churn_logit,
+    }
+
+
+def fraud_predict(params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """MLModel.Predict-compatible view: [B, 30] -> [B] fraud probability."""
+    return multitask_forward(params, x)["fraud"]
+
+
+def param_specs(params: Params):
+    """TP sharding rules for the multitask pytree (heads replicated)."""
+    from jax.sharding import PartitionSpec as P
+
+    from igaming_platform_tpu.parallel.mesh import AXIS_MODEL
+
+    trunk_layers = params["trunk"]["layers"]
+    specs = []
+    for i in range(len(trunk_layers)):
+        if i % 2 == 0:
+            specs.append({"w": P(None, AXIS_MODEL), "b": P(AXIS_MODEL)})
+        else:
+            specs.append({"w": P(AXIS_MODEL, None), "b": P(None)})
+    head_spec = {"w": P(None, None), "b": P(None)}
+    return {
+        "trunk": {"layers": specs},
+        "fraud_head": head_spec,
+        "ltv_head": head_spec,
+        "churn_head": head_spec,
+    }

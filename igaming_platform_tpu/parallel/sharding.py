@@ -1,0 +1,76 @@
+"""Sharding rules: how params and batches lay out over the mesh.
+
+Centralises the NamedSharding policy (SURVEY.md §7 layer 2) so models and
+trainers request layouts by intent, not by hand-written PartitionSpecs:
+
+- activations/batches: leading dim on ``data`` (DP);
+- MLP params: alternating hidden-dim sharding over ``model`` (TP) — layer i
+  splits its output features, layer i+1 its input features, so XLA inserts
+  one all-reduce per pair instead of resharding every layer;
+- GBDT forests: tree dim over ``expert`` (EP) — each expert-shard owns a
+  slice of the ensemble's trees, margins psum-combined;
+- sequence activations: sequence dim over ``seq`` (SP/CP).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from igaming_platform_tpu.parallel.mesh import AXIS_DATA, AXIS_EXPERT, AXIS_MODEL, AXIS_SEQ
+
+
+def batch_spec(ndim: int) -> P:
+    return P(AXIS_DATA, *([None] * (ndim - 1)))
+
+
+def mlp_param_specs(params: dict) -> dict:
+    """Alternating TP layout for models.mlp-style pytrees
+    ({"layers": [{"w","b"}, ...]})."""
+    specs = []
+    layers = params["layers"]
+    n = len(layers)
+    for i in range(n):
+        if i == n - 1:
+            # Output head stays replicated (tiny).
+            specs.append({"w": P(None, None), "b": P(None)})
+        elif i % 2 == 0:
+            specs.append({"w": P(None, AXIS_MODEL), "b": P(AXIS_MODEL)})
+        else:
+            specs.append({"w": P(AXIS_MODEL, None), "b": P(None)})
+    return {"layers": specs}
+
+
+def gbdt_param_specs() -> dict:
+    """EP layout: the forest's tree dimension sharded over ``expert``."""
+    return {
+        "feat": P(AXIS_EXPERT, None),
+        "thr": P(AXIS_EXPERT, None),
+        "leaves": P(AXIS_EXPERT, None),
+        "bias": P(),
+    }
+
+
+def seq_activation_spec(ndim: int = 3) -> P:
+    """[B, S, ...] with batch on data and sequence on seq."""
+    return P(AXIS_DATA, AXIS_SEQ, *([None] * (ndim - 2)))
+
+
+def tree_shardings(mesh: Mesh, spec_tree: Any) -> Any:
+    """Map a pytree of PartitionSpecs to NamedShardings on ``mesh``."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(mesh: Mesh, params: Any, spec_tree: Any) -> Any:
+    """Place a params pytree onto the mesh per the spec tree."""
+    return jax.device_put(params, tree_shardings(mesh, spec_tree))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

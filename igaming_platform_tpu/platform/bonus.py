@@ -1,0 +1,412 @@
+"""Bonus engine — the YAML-DSL rule engine for promotions.
+
+Semantics mirror
+/root/reference/services/bonus/internal/service/bonus_engine.go: 5 bonus
+types, rule schema with match %, caps, wagering multipliers, per-game
+weights, schedules and eligibility conditions (:39-99); eligibility scan
+(:207-242); award pipeline with abuse gate + one-time check and
+wagering = amount x multiplier (:245-326); wagering progress with
+game-weight contribution (:338-378, :485-514); max-bet enforcement under
+active bonus (:389-418); expiry sweep (:421-442); forfeiture (:445-460).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+from typing import Protocol
+
+import yaml
+
+from igaming_platform_tpu.core.enums import BonusStatus, BonusType
+from igaming_platform_tpu.platform.domain import new_id
+
+
+@dataclass
+class Schedule:
+    days_of_week: list[str] = field(default_factory=list)
+    start_time: str = ""
+    end_time: str = ""
+    start_date: str = ""
+    end_date: str = ""
+
+
+@dataclass
+class Conditions:
+    min_deposits_lifetime: int = 0
+    min_account_age_days: int = 0
+    max_account_age_days: int = 0
+    required_segment: str = ""
+    excluded_segments: list[str] = field(default_factory=list)
+    countries: list[str] = field(default_factory=list)
+    excluded_countries: list[str] = field(default_factory=list)
+
+
+@dataclass
+class BonusRule:
+    id: str
+    name: str = ""
+    type: BonusType = BonusType.DEPOSIT_MATCH
+    description: str = ""
+
+    match_percent: int = 0
+    max_bonus: int = 0
+    min_deposit: int = 0
+    fixed_amount: int = 0
+    free_spins_count: int = 0
+    cashback_percent: int = 0
+
+    wagering_multiplier: int = 0
+    max_bet_percent: int = 0
+    max_bet_absolute: int = 0
+
+    eligible_games: list[str] = field(default_factory=list)
+    excluded_games: list[str] = field(default_factory=list)
+    game_weights: dict[str, int] = field(default_factory=dict)
+
+    expiry_days: int = 30
+    schedule: Schedule | None = None
+    conditions: Conditions | None = None
+
+    active: bool = True
+    one_time: bool = False
+    promo_code: str = ""
+
+
+@dataclass
+class PlayerBonus:
+    id: str
+    account_id: str
+    rule_id: str
+    type: BonusType
+    status: BonusStatus
+    bonus_amount: int
+    wagering_required: int
+    wagering_progress: int = 0
+    free_spins_total: int = 0
+    free_spins_used: int = 0
+    awarded_at: float = field(default_factory=time.time)
+    expires_at: float = 0.0
+    completed_at: float | None = None
+    trigger_tx_id: str | None = None
+    promo_code: str | None = None
+
+
+@dataclass
+class PlayerInfo:
+    account_id: str
+    account_age_days: int = 0
+    total_deposits: int = 0  # lifetime deposit COUNT (bonus_engine.go:152)
+    segment: str = ""
+    country: str = ""
+    total_bonus_claims: int = 0
+
+
+class BonusRepository(Protocol):
+    def create(self, bonus: PlayerBonus) -> None: ...
+    def get_by_id(self, bonus_id: str) -> PlayerBonus | None: ...
+    def get_active_by_account(self, account_id: str) -> list[PlayerBonus]: ...
+    def update(self, bonus: PlayerBonus) -> None: ...
+    def count_by_rule_and_account(self, rule_id: str, account_id: str) -> int: ...
+    def get_expired(self, now: float) -> list[PlayerBonus]: ...
+
+
+class InMemoryBonusRepository:
+    def __init__(self):
+        self._bonuses: dict[str, PlayerBonus] = {}
+
+    def create(self, bonus: PlayerBonus) -> None:
+        self._bonuses[bonus.id] = bonus
+
+    def get_by_id(self, bonus_id: str) -> PlayerBonus | None:
+        return self._bonuses.get(bonus_id)
+
+    def get_active_by_account(self, account_id: str) -> list[PlayerBonus]:
+        return [
+            b for b in self._bonuses.values()
+            if b.account_id == account_id and b.status == BonusStatus.ACTIVE
+        ]
+
+    def update(self, bonus: PlayerBonus) -> None:
+        self._bonuses[bonus.id] = bonus
+
+    def count_by_rule_and_account(self, rule_id: str, account_id: str) -> int:
+        return sum(
+            1 for b in self._bonuses.values()
+            if b.rule_id == rule_id and b.account_id == account_id
+        )
+
+    def get_expired(self, now: float) -> list[PlayerBonus]:
+        return [
+            b for b in self._bonuses.values()
+            if b.status == BonusStatus.ACTIVE and b.expires_at and b.expires_at < now
+        ]
+
+
+class BonusAbuseError(Exception):
+    pass
+
+
+class NotEligibleError(Exception):
+    pass
+
+
+class MaxBetExceededError(Exception):
+    pass
+
+
+def load_rules(config_path: str) -> list[BonusRule]:
+    """Parse the YAML DSL (bonus_engine.go:171-204 / NewBonusEngine)."""
+    with open(config_path) as f:
+        raw = yaml.safe_load(f)
+    rules = []
+    for entry in raw.get("bonus_rules", []):
+        sched = entry.get("schedule")
+        cond = entry.get("conditions")
+        rules.append(BonusRule(
+            id=entry["id"],
+            name=entry.get("name", ""),
+            type=BonusType(entry.get("type", "deposit_match")),
+            description=entry.get("description", ""),
+            match_percent=entry.get("match_percent", 0),
+            max_bonus=entry.get("max_bonus", 0),
+            min_deposit=entry.get("min_deposit", 0),
+            fixed_amount=entry.get("fixed_amount", 0),
+            free_spins_count=entry.get("free_spins_count", 0),
+            cashback_percent=entry.get("cashback_percent", 0),
+            wagering_multiplier=entry.get("wagering_multiplier", 0),
+            max_bet_percent=entry.get("max_bet_percent", 0),
+            max_bet_absolute=entry.get("max_bet_absolute", 0),
+            eligible_games=entry.get("eligible_games", []) or [],
+            excluded_games=entry.get("excluded_games", []) or [],
+            game_weights=entry.get("game_weights", {}) or {},
+            expiry_days=entry.get("expiry_days", 30),
+            schedule=Schedule(**sched) if sched else None,
+            conditions=Conditions(**cond) if cond else None,
+            active=entry.get("active", True),
+            one_time=entry.get("one_time", False),
+            promo_code=entry.get("promo_code", ""),
+        ))
+    return rules
+
+
+class BonusEngine:
+    def __init__(
+        self,
+        rules: list[BonusRule] | str,
+        repo: BonusRepository | None = None,
+        risk_checker=None,  # callable(account_id) -> bool (is_abuser)
+        player_data=None,  # callable(account_id) -> PlayerInfo
+        now_fn=time.time,
+    ):
+        if isinstance(rules, str):
+            rules = load_rules(rules)
+        self.rules = rules
+        self.rules_by_id = {r.id: r for r in rules}
+        self.repo = repo or InMemoryBonusRepository()
+        self.risk_checker = risk_checker
+        self.player_data = player_data
+        self.now_fn = now_fn
+
+    # -- eligibility (bonus_engine.go:207-242) -------------------------------
+
+    def get_eligible_bonuses(self, account_id: str) -> list[BonusRule]:
+        player = self.player_data(account_id) if self.player_data else PlayerInfo(account_id)
+        eligible = []
+        for rule in self.rules:
+            if not rule.active:
+                continue
+            if rule.one_time and self.repo.count_by_rule_and_account(rule.id, account_id) > 0:
+                continue
+            if not self._check_conditions(rule, player):
+                continue
+            if not self._check_schedule(rule):
+                continue
+            eligible.append(rule)
+        return eligible
+
+    # -- award (bonus_engine.go:245-326) -------------------------------------
+
+    def award_bonus(
+        self,
+        account_id: str,
+        rule_id: str,
+        deposit_amount: int = 0,
+        trigger_tx_id: str | None = None,
+        promo_code: str | None = None,
+    ) -> PlayerBonus:
+        rule = self.rules_by_id.get(rule_id)
+        if rule is None:
+            raise KeyError(f"bonus rule not found: {rule_id}")
+        if not rule.active:
+            raise NotEligibleError("bonus rule is not active")
+
+        player = self.player_data(account_id) if self.player_data else PlayerInfo(account_id)
+        if not self._check_conditions(rule, player):
+            raise NotEligibleError("player not eligible for this bonus")
+
+        # Abuse gate: fail-open on checker error (bonus_engine.go:268-275).
+        if self.risk_checker is not None:
+            try:
+                if self.risk_checker(account_id):
+                    raise BonusAbuseError("bonus blocked: suspected abuse")
+            except BonusAbuseError:
+                raise
+            except Exception:
+                pass
+
+        if rule.one_time and self.repo.count_by_rule_and_account(rule.id, account_id) > 0:
+            raise NotEligibleError("bonus already claimed")
+
+        amount = self._calculate_bonus_amount(rule, deposit_amount)
+        if amount == 0:
+            raise NotEligibleError("calculated bonus amount is zero")
+
+        now = self.now_fn()
+        bonus = PlayerBonus(
+            id=new_id(),
+            account_id=account_id,
+            rule_id=rule.id,
+            type=rule.type,
+            status=BonusStatus.ACTIVE,
+            bonus_amount=amount,
+            wagering_required=amount * rule.wagering_multiplier,
+            free_spins_total=rule.free_spins_count,
+            awarded_at=now,
+            expires_at=now + rule.expiry_days * 86400,
+            trigger_tx_id=trigger_tx_id,
+            promo_code=promo_code,
+        )
+        self.repo.create(bonus)
+        return bonus
+
+    # -- wagering (bonus_engine.go:338-378) ----------------------------------
+
+    def process_wager(self, account_id: str, bet_amount: int, game_category: str = "") -> list[PlayerBonus]:
+        """Apply a bet's contribution to every active bonus; returns the
+        bonuses that completed their wagering on this wager."""
+        completed = []
+        for bonus in self.repo.get_active_by_account(account_id):
+            rule = self.rules_by_id.get(bonus.rule_id)
+            if rule is None:
+                continue
+            contribution = self._wager_contribution(rule, game_category, bet_amount)
+            if contribution == 0:
+                continue
+            bonus.wagering_progress += contribution
+            if bonus.wagering_progress >= bonus.wagering_required:
+                bonus.status = BonusStatus.COMPLETED
+                bonus.completed_at = self.now_fn()
+                completed.append(bonus)
+            self.repo.update(bonus)
+        return completed
+
+    # -- max bet (bonus_engine.go:389-418) -----------------------------------
+
+    def check_max_bet(self, account_id: str, bet_amount: int) -> None:
+        for bonus in self.repo.get_active_by_account(account_id):
+            rule = self.rules_by_id.get(bonus.rule_id)
+            if rule is None:
+                continue
+            if rule.max_bet_percent > 0:
+                max_bet = bonus.bonus_amount * rule.max_bet_percent // 100
+                if bet_amount > max_bet:
+                    raise MaxBetExceededError(
+                        f"bet exceeds max bet limit: {bet_amount} > {max_bet}"
+                        f" (max {rule.max_bet_percent}% of bonus)"
+                    )
+            if rule.max_bet_absolute > 0 and bet_amount > rule.max_bet_absolute:
+                raise MaxBetExceededError(
+                    f"bet exceeds absolute max bet: {bet_amount} > {rule.max_bet_absolute}"
+                )
+
+    # -- lifecycle (bonus_engine.go:421-460) ---------------------------------
+
+    def expire_old_bonuses(self) -> int:
+        count = 0
+        for bonus in self.repo.get_expired(self.now_fn()):
+            bonus.status = BonusStatus.EXPIRED
+            self.repo.update(bonus)
+            count += 1
+        return count
+
+    def forfeit_bonuses(self, account_id: str) -> int:
+        count = 0
+        for bonus in self.repo.get_active_by_account(account_id):
+            bonus.status = BonusStatus.FORFEITED
+            self.repo.update(bonus)
+            count += 1
+        return count
+
+    def get_rule(self, rule_id: str) -> BonusRule | None:
+        return self.rules_by_id.get(rule_id)
+
+    def get_all_rules(self) -> list[BonusRule]:
+        return [r for r in self.rules if r.active]
+
+    # -- helpers (bonus_engine.go:464-604) -----------------------------------
+
+    def _calculate_bonus_amount(self, rule: BonusRule, deposit_amount: int) -> int:
+        if rule.type == BonusType.DEPOSIT_MATCH:
+            bonus = deposit_amount * rule.match_percent // 100
+            return min(bonus, rule.max_bonus) if rule.max_bonus else bonus
+        if rule.type in (BonusType.NO_DEPOSIT, BonusType.FREEBET):
+            return rule.fixed_amount
+        if rule.type == BonusType.CASHBACK:
+            return 0  # computed on losses by the cashback job
+        return rule.fixed_amount
+
+    def calculate_cashback(self, rule: BonusRule, weekly_losses: int) -> int:
+        """Cashback = pct of losses, capped (the job the reference defers)."""
+        if rule.type != BonusType.CASHBACK or weekly_losses <= 0:
+            return 0
+        amount = weekly_losses * rule.cashback_percent // 100
+        return min(amount, rule.max_bonus) if rule.max_bonus else amount
+
+    def _wager_contribution(self, rule: BonusRule, game_category: str, bet_amount: int) -> int:
+        if game_category in rule.excluded_games:
+            return 0
+        if rule.eligible_games and game_category not in rule.eligible_games:
+            return 0
+        weight = rule.game_weights.get(game_category, 100)
+        return bet_amount * weight // 100
+
+    def _check_conditions(self, rule: BonusRule, player: PlayerInfo) -> bool:
+        c = rule.conditions
+        if c is None:
+            return True
+        if c.min_deposits_lifetime > 0 and player.total_deposits < c.min_deposits_lifetime:
+            return False
+        if c.min_account_age_days > 0 and player.account_age_days < c.min_account_age_days:
+            return False
+        if c.max_account_age_days > 0 and player.account_age_days > c.max_account_age_days:
+            return False
+        if c.required_segment and player.segment != c.required_segment:
+            return False
+        if player.segment in c.excluded_segments:
+            return False
+        if c.countries and player.country not in c.countries:
+            return False
+        if player.country in c.excluded_countries:
+            return False
+        return True
+
+    def _check_schedule(self, rule: BonusRule) -> bool:
+        s = rule.schedule
+        if s is None:
+            return True
+        now = datetime.fromtimestamp(self.now_fn(), tz=timezone.utc)
+        if s.start_date:
+            start = datetime.strptime(s.start_date, "%Y-%m-%d").replace(tzinfo=timezone.utc)
+            if now < start:
+                return False
+        if s.end_date:
+            end = datetime.strptime(s.end_date, "%Y-%m-%d").replace(tzinfo=timezone.utc)
+            if now > end:
+                return False
+        if s.days_of_week:
+            today = now.strftime("%A")
+            if today not in s.days_of_week:
+                return False
+        return True

@@ -1,0 +1,82 @@
+"""Risk gate adapters: how Wallet/Bonus reach the TPU scoring engine.
+
+The reference wires Wallet -> Risk over gRPC (wallet_service.go:262-279)
+and Bonus -> Risk for abuse checks (bonus_engine.go:268-275). This module
+provides both deployment shapes:
+
+- ``InProcessRiskGate``: single-binary mode — the wallet calls the TPU
+  engine directly (no serialization);
+- ``GrpcRiskGate``: cross-process mode — a risk.v1 client, wire-compatible
+  with either this framework's server or the reference's Go service.
+"""
+
+from __future__ import annotations
+
+from igaming_platform_tpu.serve.scorer import ScoreRequest, TPUScoringEngine
+
+
+class InProcessRiskGate:
+    def __init__(self, engine: TPUScoringEngine):
+        self.engine = engine
+
+    def score_transaction(
+        self, account_id: str, amount: int, tx_type: str,
+        game_id: str = "", ip: str = "", device_id: str = "", fingerprint: str = "",
+    ) -> tuple[int, str, list[str]]:
+        resp = self.engine.score(ScoreRequest(
+            account_id=account_id, amount=amount, tx_type=tx_type,
+            game_id=game_id, ip=ip, device_id=device_id, fingerprint=fingerprint,
+        ))
+        return resp.score, resp.action, [r.value for r in resp.reason_codes]
+
+    def check_bonus_abuse(self, account_id: str) -> bool:
+        """Scalar abuse heuristic matching engine rule 7 semantics; the
+        sequence model upgrade lives in serve/abuse.py."""
+        import numpy as np
+
+        from igaming_platform_tpu.core.features import F, NUM_FEATURES
+
+        row = np.zeros(NUM_FEATURES, dtype=np.float32)
+        self.engine.features.fill_row(row, account_id, 0, "bet")
+        return bool(row[F.BONUS_ONLY_PLAYER] > 0)
+
+
+class GrpcRiskGate:
+    """risk.v1 ScoreTransaction client (lazy channel)."""
+
+    def __init__(self, address: str, timeout: float = 5.0):
+        self.address = address
+        self.timeout = timeout
+        self._stub = None
+
+    def _ensure_stub(self):
+        if self._stub is None:
+            import grpc
+
+            from igaming_platform_tpu.serve.grpc_server import make_risk_stub
+
+            channel = grpc.insecure_channel(self.address)
+            self._stub = make_risk_stub(channel)
+        return self._stub
+
+    def score_transaction(
+        self, account_id: str, amount: int, tx_type: str,
+        game_id: str = "", ip: str = "", device_id: str = "", fingerprint: str = "",
+    ) -> tuple[int, str, list[str]]:
+        from risk.v1 import risk_pb2
+
+        stub = self._ensure_stub()
+        resp = stub.ScoreTransaction(
+            risk_pb2.ScoreTransactionRequest(
+                account_id=account_id,
+                amount=amount,
+                transaction_type=tx_type,
+                game_id=game_id,
+                ip_address=ip,
+                device_id=device_id,
+                fingerprint=fingerprint,
+            ),
+            timeout=self.timeout,
+        )
+        action = {1: "approve", 2: "review", 3: "block"}.get(resp.action, "approve")
+        return resp.score, action, list(resp.reason_codes)

@@ -1,0 +1,393 @@
+"""WalletService — deposits, bets, wins, withdrawals over the ledger.
+
+Business semantics mirror
+/root/reference/services/wallet/internal/service/wallet_service.go, with
+every money-moving op running the same pipeline (SURVEY.md §3.1):
+
+  idempotency replay -> account fetch + status check -> risk gate ->
+  pending tx row -> optimistic-lock balance update -> ledger entry ->
+  complete -> event publish
+
+and the reference's deliberate risk asymmetry preserved:
+- deposits/bets FAIL OPEN when risk is down (wallet_service.go:271,
+  :388-389) and block at the block threshold;
+- withdrawals FAIL CLOSED (:605-608) and use the stricter *review*
+  threshold (:610-614);
+- bets consume bonus before real money (:398-408); wins credit real
+  balance only (:497); withdrawals exclude bonus (:589-593).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Protocol
+
+from igaming_platform_tpu.core.enums import (
+    EXCHANGE_WALLET,
+    AccountStatus,
+    EventType,
+    LedgerEntryType,
+    TxStatus,
+    TxType,
+)
+from igaming_platform_tpu.platform.domain import (
+    Account,
+    AccountSuspendedError,
+    ConcurrentUpdateError,
+    InsufficientBalanceError,
+    InvalidAmountError,
+    LedgerEntry,
+    RiskBlockedError,
+    RiskReviewError,
+    RiskUnavailableError,
+    Transaction,
+    new_id,
+)
+from igaming_platform_tpu.serve.events import Event, Publisher, new_transaction_event
+
+
+class RiskGate(Protocol):
+    """Risk check seam (wallet_service.go:40-42). Implementations: the
+    in-process TPU engine adapter or a risk.v1 gRPC client."""
+
+    def score_transaction(
+        self, account_id: str, amount: int, tx_type: str,
+        game_id: str = "", ip: str = "", device_id: str = "", fingerprint: str = "",
+    ) -> tuple[int, str, list[str]]:
+        """Returns (score, action, reason_codes); raises on unavailability."""
+        ...
+
+
+@dataclass
+class WalletConfig:
+    risk_threshold_block: int = 80
+    risk_threshold_review: int = 50
+
+
+@dataclass
+class OpResult:
+    transaction: Transaction
+    new_balance: int  # total (real + bonus) after the op
+    risk_score: int | None = None
+    real_deducted: int = 0
+    bonus_deducted: int = 0
+
+
+class WalletService:
+    def __init__(
+        self,
+        accounts,
+        transactions,
+        ledger,
+        events: Publisher | None = None,
+        risk: RiskGate | None = None,
+        config: WalletConfig | None = None,
+    ):
+        self.accounts = accounts
+        self.transactions = transactions
+        self.ledger = ledger
+        self.events = events
+        self.risk = risk
+        self.config = config or WalletConfig()
+
+    # -- account management --------------------------------------------------
+
+    def create_account(self, player_id: str, currency: str = "USD") -> Account:
+        existing = self.accounts.get_by_player_id(player_id)
+        if existing is not None:
+            return existing  # idempotent (wallet_service.go:191-194)
+        account = Account(id=new_id(), player_id=player_id, currency=currency)
+        self.accounts.create(account)
+        self._publish(Event(
+            type=EventType.ACCOUNT_CREATED.value,
+            source="wallet-service",
+            aggregate_id=account.id,
+            data={"account_id": account.id, "player_id": player_id, "currency": currency},
+        ))
+        return account
+
+    def get_balance(self, account_id: str) -> Account:
+        return self.accounts.get_by_id(account_id)
+
+    def get_transaction_history(self, account_id: str, limit: int = 50, offset: int = 0):
+        return self.transactions.list_by_account(account_id, limit, offset)
+
+    # -- money movement -------------------------------------------------------
+
+    def deposit(
+        self, account_id: str, amount: int, idempotency_key: str,
+        payment_method: str = "", reference: str = "",
+        ip: str = "", device_id: str = "", fingerprint: str = "",
+    ) -> OpResult:
+        self._check_amount(amount)
+        replay = self._replay(account_id, idempotency_key)
+        if replay is not None:
+            return replay
+
+        account = self._active_account(account_id)
+        risk_score = self._risk_gate_open(
+            account_id, amount, "deposit", ip=ip, device_id=device_id, fingerprint=fingerprint
+        )
+
+        tx = self._pending_tx(account, idempotency_key, TxType.DEPOSIT, amount, reference)
+        new_balance = account.balance + amount
+        self._commit(account, tx, new_balance, account.bonus, "Deposit", risk_score)
+        return OpResult(tx, new_balance + account.bonus, risk_score)
+
+    def bet(
+        self, account_id: str, amount: int, idempotency_key: str,
+        game_id: str = "", round_id: str = "", game_category: str = "",
+        ip: str = "", device_id: str = "", fingerprint: str = "",
+        max_bet_check=None,
+    ) -> OpResult:
+        self._check_amount(amount)
+        replay = self._replay(account_id, idempotency_key)
+        if replay is not None:
+            return replay
+
+        account = self._active_account(account_id)
+
+        # Sufficient total balance: real + bonus (wallet_service.go:371-375).
+        total = account.balance + account.bonus
+        if total < amount:
+            raise InsufficientBalanceError(f"available={total}, required={amount}")
+
+        # Bonus max-bet gate (the coupling the reference documents but never
+        # wires — SURVEY.md §3.2): raises BonusRestrictionError.
+        if max_bet_check is not None:
+            max_bet_check(account_id, amount)
+
+        risk_score = self._risk_gate_open(
+            account_id, amount, "bet", game_id=game_id, ip=ip,
+            device_id=device_id, fingerprint=fingerprint,
+        )
+
+        # Bonus-first deduction split (wallet_service.go:398-408).
+        if account.bonus >= amount:
+            bonus_deducted, real_deducted = amount, 0
+        else:
+            bonus_deducted, real_deducted = account.bonus, amount - account.bonus
+        new_balance = account.balance - real_deducted
+        new_bonus = account.bonus - bonus_deducted
+
+        tx = self._pending_tx(
+            account, idempotency_key, TxType.BET, amount,
+            f"game:{game_id}:round:{round_id}", game_id=game_id, round_id=round_id,
+        )
+        tx.balance_before = total
+        tx.balance_after = new_balance + new_bonus
+        self._commit(account, tx, new_balance, new_bonus, "Bet", risk_score,
+                     event_type=EventType.TRANSACTION_COMPLETED)
+        return OpResult(tx, new_balance + new_bonus, risk_score, real_deducted, bonus_deducted)
+
+    def win(
+        self, account_id: str, amount: int, idempotency_key: str,
+        game_id: str = "", round_id: str = "", bet_tx_id: str = "",
+        win_type: str = "normal",
+    ) -> OpResult:
+        self._check_amount(amount)
+        replay = self._replay(account_id, idempotency_key)
+        if replay is not None:
+            return replay
+
+        # Wins skip the risk gate entirely (SURVEY.md §3.2) and credit the
+        # real balance only (wallet_service.go:497-500).
+        account = self.accounts.get_by_id(account_id)
+        new_balance = account.balance + amount
+        tx = self._pending_tx(
+            account, idempotency_key, TxType.WIN, amount,
+            f"win:game:{game_id}:round:{round_id}:bet:{bet_tx_id}",
+            game_id=game_id, round_id=round_id,
+        )
+        tx.balance_before = account.balance + account.bonus
+        tx.balance_after = new_balance + account.bonus
+        self._commit(account, tx, new_balance, account.bonus, "Win", None)
+        return OpResult(tx, new_balance + account.bonus)
+
+    def withdraw(
+        self, account_id: str, amount: int, idempotency_key: str,
+        payout_method: str = "", ip: str = "", device_id: str = "", fingerprint: str = "",
+    ) -> OpResult:
+        self._check_amount(amount)
+        replay = self._replay(account_id, idempotency_key)
+        if replay is not None:
+            return replay
+
+        account = self._active_account(account_id)
+
+        # Only real balance withdraws (wallet_service.go:589-593).
+        if account.balance < amount:
+            raise InsufficientBalanceError(
+                f"available={account.balance}, required={amount} (bonus excluded)"
+            )
+
+        # Withdrawal risk: fail closed, stricter review threshold
+        # (wallet_service.go:595-615).
+        if self.risk is not None:
+            try:
+                score, _, reasons = self.risk.score_transaction(
+                    account_id, amount, "withdraw", ip=ip, device_id=device_id,
+                    fingerprint=fingerprint,
+                )
+            except Exception as exc:
+                raise RiskUnavailableError("withdrawal pending: risk service unavailable") from exc
+            if score >= self.config.risk_threshold_review:
+                raise RiskReviewError(score, reasons)
+            risk_score = score
+        else:
+            risk_score = None
+
+        new_balance = account.balance - amount
+        tx = self._pending_tx(
+            account, idempotency_key, TxType.WITHDRAW, amount, f"payout:{payout_method}"
+        )
+        tx.balance_before = account.balance + account.bonus
+        tx.balance_after = new_balance + account.bonus
+        self._commit(account, tx, new_balance, account.bonus, "Withdrawal", risk_score,
+                     event_type=EventType.WITHDRAWAL_COMPLETED)
+        return OpResult(tx, new_balance + account.bonus, risk_score)
+
+    def refund(self, account_id: str, original_tx_id: str, idempotency_key: str, reason: str = "") -> OpResult:
+        replay = self._replay(account_id, idempotency_key)
+        if replay is not None:
+            return replay
+        original = self.transactions.get_by_id(original_tx_id)
+        if original is None or original.account_id != account_id:
+            raise InvalidAmountError(f"original transaction not found: {original_tx_id}")
+        account = self._active_account(account_id)
+        amount = original.amount
+        new_balance = account.balance + amount
+        tx = self._pending_tx(
+            account, idempotency_key, TxType.REFUND, amount, f"refund:{original_tx_id}:{reason}"
+        )
+        tx.balance_before = account.balance + account.bonus
+        tx.balance_after = new_balance + account.bonus
+        self._commit(account, tx, new_balance, account.bonus, "Refund", None)
+        return OpResult(tx, new_balance + account.bonus)
+
+    # -- bonus credit path (used by the bonus engine) -------------------------
+
+    def grant_bonus(self, account_id: str, amount: int, idempotency_key: str, rule_id: str = "") -> OpResult:
+        self._check_amount(amount)
+        replay = self._replay(account_id, idempotency_key)
+        if replay is not None:
+            return replay
+        account = self._active_account(account_id)
+        new_bonus = account.bonus + amount
+        tx = self._pending_tx(
+            account, idempotency_key, TxType.BONUS_GRANT, amount, f"bonus:{rule_id}"
+        )
+        tx.balance_before = account.balance + account.bonus
+        tx.balance_after = account.balance + new_bonus
+        self._commit(account, tx, account.balance, new_bonus, "Bonus grant", None,
+                     event_type=EventType.BONUS_AWARDED)
+        return OpResult(tx, account.balance + new_bonus)
+
+    def forfeit_bonus_balance(self, account_id: str) -> int:
+        """Zero the bonus balance (early-withdrawal forfeiture support)."""
+        account = self.accounts.get_by_id(account_id)
+        forfeited = account.bonus
+        if forfeited:
+            self.accounts.update_balance(account.id, account.balance, 0, account.version)
+        return forfeited
+
+    # -- internals ------------------------------------------------------------
+
+    def _check_amount(self, amount: int) -> None:
+        if amount <= 0:
+            raise InvalidAmountError(f"amount must be positive: {amount}")
+
+    def _replay(self, account_id: str, idempotency_key: str) -> OpResult | None:
+        """Idempotency replay (wallet_service.go:242-248)."""
+        existing = self.transactions.get_by_idempotency_key(account_id, idempotency_key)
+        if existing is None:
+            return None
+        return OpResult(existing, existing.balance_after)
+
+    def _active_account(self, account_id: str) -> Account:
+        account = self.accounts.get_by_id(account_id)
+        if account.status != AccountStatus.ACTIVE:
+            raise AccountSuspendedError(f"account is not active: {account.status.value}")
+        return account
+
+    def _risk_gate_open(
+        self, account_id: str, amount: int, tx_type: str, *,
+        game_id: str = "", ip: str = "", device_id: str = "", fingerprint: str = "",
+    ) -> int | None:
+        """Fail-open risk gate for deposits/bets: log-and-continue when risk
+        is down, block at the block threshold (wallet_service.go:262-279)."""
+        if self.risk is None:
+            return None
+        try:
+            score, _, reasons = self.risk.score_transaction(
+                account_id, amount, tx_type, game_id=game_id, ip=ip,
+                device_id=device_id, fingerprint=fingerprint,
+            )
+        except Exception:
+            return None  # fail open
+        if score >= self.config.risk_threshold_block:
+            raise RiskBlockedError(score, reasons)
+        return score
+
+    def _pending_tx(
+        self, account: Account, idempotency_key: str, tx_type: TxType, amount: int,
+        reference: str, game_id: str | None = None, round_id: str | None = None,
+    ) -> Transaction:
+        tx = Transaction(
+            id=new_id(),
+            account_id=account.id,
+            idempotency_key=idempotency_key,
+            type=tx_type,
+            amount=amount,
+            balance_before=account.balance,
+            balance_after=account.balance + (amount if tx_type.is_credit else -amount),
+            reference=reference,
+            game_id=game_id,
+            round_id=round_id,
+        )
+        return tx
+
+    def _commit(
+        self, account: Account, tx: Transaction, new_balance: int, new_bonus: int,
+        description: str, risk_score: int | None,
+        event_type: EventType = EventType.TRANSACTION_COMPLETED,
+    ) -> None:
+        tx.risk_score = risk_score
+        self.transactions.create(tx)
+        try:
+            self.accounts.update_balance(account.id, new_balance, new_bonus, account.version)
+        except ConcurrentUpdateError:
+            tx.fail()
+            self.transactions.update(tx)
+            raise
+        self._ledger_entry(tx, description)
+        tx.complete()
+        self.transactions.update(tx)
+        self._publish(new_transaction_event(event_type.value, {
+            "id": tx.id, "account_id": tx.account_id, "type": tx.type.value,
+            "amount": tx.amount, "balance_before": tx.balance_before,
+            "balance_after": tx.balance_after, "status": tx.status.value,
+            "game_id": tx.game_id or "", "round_id": tx.round_id or "",
+            "risk_score": risk_score or 0,
+        }))
+
+    def _ledger_entry(self, tx: Transaction, description: str) -> None:
+        """Double-entry record (wallet_service.go:679-704)."""
+        entry_type = LedgerEntryType.CREDIT if tx.type.is_credit else LedgerEntryType.DEBIT
+        self.ledger.create(LedgerEntry(
+            id=new_id(),
+            transaction_id=tx.id,
+            account_id=tx.account_id,
+            entry_type=entry_type,
+            amount=tx.amount,
+            balance_after=tx.balance_after,
+            description=description,
+        ))
+
+    def _publish(self, event: Event) -> None:
+        if self.events is not None:
+            try:
+                self.events.publish(EXCHANGE_WALLET, event)
+            except Exception:  # noqa: BLE001 — events are best-effort
+                pass

@@ -1,0 +1,74 @@
+"""Orbax checkpoint/resume for the trainer + serving hot-swap.
+
+The reference has no ML checkpointing — models are immutable .onnx files
+loaded at boot (risk/cmd/main.go:62-63, SURVEY.md §5). Here training state
+(params + optimizer moments + step, i.e. the data cursor) checkpoints via
+Orbax, and serving restores params directly — the version-keyed hot-swap
+path of SURVEY.md §5 "Checkpoint / resume".
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from igaming_platform_tpu.train.trainer import TrainState, Trainer
+
+
+def save_checkpoint(directory: str, state: TrainState) -> str:
+    """Write step-versioned checkpoint; returns its path."""
+    directory = os.path.abspath(directory)
+    path = os.path.join(directory, f"step_{state.step:08d}")
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(
+            path,
+            {
+                "params": jax.device_get(state.params),
+                "opt_state": jax.device_get(state.opt_state),
+                "step": np.asarray(state.step),
+            },
+        )
+    return path
+
+
+def latest_checkpoint(directory: str) -> str | None:
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        return None
+    steps = sorted(d for d in os.listdir(directory) if d.startswith("step_"))
+    return os.path.join(directory, steps[-1]) if steps else None
+
+
+def restore_checkpoint(path: str, template: dict[str, Any] | None = None) -> dict[str, Any]:
+    with ocp.StandardCheckpointer() as ckptr:
+        if template is not None:
+            return ckptr.restore(path, template)
+        return ckptr.restore(path)
+
+
+def restore_trainer(trainer: Trainer, directory: str) -> bool:
+    """Resume a trainer from the newest checkpoint; True on restore."""
+    path = latest_checkpoint(directory)
+    if path is None:
+        return False
+    template = {
+        "params": jax.device_get(trainer.state.params),
+        "opt_state": jax.device_get(trainer.state.opt_state),
+        "step": np.asarray(trainer.state.step),
+    }
+    restored = restore_checkpoint(path, template)
+    trainer.state = TrainState(
+        params=restored["params"],
+        opt_state=restored["opt_state"],
+        step=int(restored["step"]),
+    )
+    return True
+
+
+def restore_params_for_serving(path: str) -> Any:
+    """Load only params (the serving hot-swap input)."""
+    return restore_checkpoint(path)["params"]

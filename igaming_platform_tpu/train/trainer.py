@@ -1,0 +1,140 @@
+"""Same-pod DP(+TP) training for the multi-task fraud+LTV model.
+
+Replaces the reference's offline train -> ONNX export -> redeploy loop
+(Makefile:215-225, scripts absent) with in-process JAX training on the same
+mesh that serves (BASELINE.json config 5): batch axis sharded over ``data``
+(gradient psum over ICI inserted by XLA), trunk hidden dims optionally
+sharded over ``model`` (TP), parameters handed to the server by reference —
+no serialization format hops (SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Iterator
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from igaming_platform_tpu.core.features import normalize
+from igaming_platform_tpu.models.multitask import init_multitask, multitask_forward, param_specs
+from igaming_platform_tpu.parallel.mesh import AXIS_DATA
+from igaming_platform_tpu.parallel.sharding import tree_shardings
+from igaming_platform_tpu.train.data import Batch, make_stream
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    batch_size: int = 1024
+    learning_rate: float = 3e-4
+    weight_decay: float = 1e-4
+    ltv_scale: float = 1_000.0  # dollars -> unit scale for the MSE head
+    fraud_loss_weight: float = 1.0
+    ltv_loss_weight: float = 0.5
+    churn_loss_weight: float = 0.5
+    trunk: tuple[int, ...] = (256, 256)
+    seed: int = 0
+
+
+@dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: int
+
+
+def make_loss_fn(cfg: TrainConfig):
+    def loss_fn(params, x_raw, fraud_t, ltv_t, churn_t):
+        xn = normalize(x_raw)
+        out = multitask_forward(params, xn)
+        # Soft-target BCE for fraud/churn, scaled Huber for LTV.
+        fraud_loss = jnp.mean(optax.sigmoid_binary_cross_entropy(out["fraud_logit"], fraud_t))
+        churn_loss = jnp.mean(optax.sigmoid_binary_cross_entropy(out["churn_logit"], churn_t))
+        ltv_loss = jnp.mean(optax.huber_loss(out["ltv"], ltv_t / cfg.ltv_scale, delta=10.0))
+        total = (
+            cfg.fraud_loss_weight * fraud_loss
+            + cfg.ltv_loss_weight * ltv_loss
+            + cfg.churn_loss_weight * churn_loss
+        )
+        metrics = {
+            "loss": total,
+            "fraud_loss": fraud_loss,
+            "ltv_loss": ltv_loss,
+            "churn_loss": churn_loss,
+            "fraud_mae": jnp.mean(jnp.abs(out["fraud"] - fraud_t)),
+        }
+        return total, metrics
+
+    return loss_fn
+
+
+class Trainer:
+    """DP(+TP)-sharded trainer with param hot-swap handoff to serving."""
+
+    def __init__(self, cfg: TrainConfig | None = None, mesh: Mesh | None = None):
+        self.cfg = cfg or TrainConfig()
+        self.mesh = mesh
+        self.optimizer = optax.adamw(self.cfg.learning_rate, weight_decay=self.cfg.weight_decay)
+
+        key = jax.random.key(self.cfg.seed)
+        params = init_multitask(key, trunk=self.cfg.trunk)
+        opt_state = self.optimizer.init(params)
+
+        loss_fn = make_loss_fn(self.cfg)
+
+        def train_step(params, opt_state, x, fraud_t, ltv_t, churn_t):
+            (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, x, fraud_t, ltv_t, churn_t
+            )
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, metrics
+
+        if mesh is not None:
+            pspecs = param_specs(params)
+            p_sh = tree_shardings(mesh, pspecs)
+            batch_sh = NamedSharding(mesh, P(AXIS_DATA, None))
+            vec_sh = NamedSharding(mesh, P(AXIS_DATA))
+            params = jax.device_put(params, p_sh)
+            # optax moment buffers mirror the param pytree, so re-initialising
+            # from sharded params inherits the TP layout; jit infers the rest.
+            opt_state = self.optimizer.init(params)
+            self._step_fn = jax.jit(
+                train_step,
+                in_shardings=(p_sh, None, batch_sh, vec_sh, vec_sh, vec_sh),
+                out_shardings=(p_sh, None, None),
+                donate_argnums=(0, 1),
+            )
+        else:
+            self._step_fn = jax.jit(train_step, donate_argnums=(0, 1))
+
+        self.state = TrainState(params=params, opt_state=opt_state, step=0)
+
+    def train_step(self, batch: Batch) -> dict[str, float]:
+        params, opt_state, metrics = self._step_fn(
+            self.state.params, self.state.opt_state, batch.x, batch.fraud, batch.ltv, batch.churn
+        )
+        self.state = TrainState(params=params, opt_state=opt_state, step=self.state.step + 1)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def fit(
+        self,
+        steps: int,
+        data: Iterator[Batch] | None = None,
+        log_every: int = 50,
+        log_fn=None,
+    ) -> dict[str, float]:
+        data = data or make_stream(self.cfg.batch_size, seed=self.cfg.seed)
+        metrics: dict[str, float] = {}
+        for i in range(steps):
+            metrics = self.train_step(next(data))
+            if log_fn is not None and (i + 1) % log_every == 0:
+                log_fn(self.state.step, metrics)
+        return metrics
+
+    def export_params(self):
+        """Hand the live params to the serving engine (zero-copy on the
+        same devices; the engine wraps them in {"mlp"-style} dict itself)."""
+        return self.state.params

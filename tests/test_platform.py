@@ -1,0 +1,413 @@
+"""Platform tests: wallet pipeline semantics, repositories, bonus engine."""
+
+import time
+
+import pytest
+
+from igaming_platform_tpu.core.enums import (
+    EXCHANGE_WALLET,
+    QUEUE_RISK_SCORING,
+    AccountStatus,
+    BonusStatus,
+    TxStatus,
+)
+from igaming_platform_tpu.platform.bonus import (
+    BonusAbuseError,
+    BonusEngine,
+    BonusRule,
+    Conditions,
+    InMemoryBonusRepository,
+    MaxBetExceededError,
+    NotEligibleError,
+    PlayerInfo,
+    Schedule,
+    load_rules,
+)
+from igaming_platform_tpu.platform.domain import (
+    AccountSuspendedError,
+    ConcurrentUpdateError,
+    InsufficientBalanceError,
+    RiskBlockedError,
+    RiskReviewError,
+    RiskUnavailableError,
+)
+from igaming_platform_tpu.platform.repository import (
+    InMemoryAccountRepository,
+    InMemoryLedgerRepository,
+    InMemoryTransactionRepository,
+    SQLiteStore,
+)
+from igaming_platform_tpu.platform.wallet import WalletConfig, WalletService
+from igaming_platform_tpu.serve.events import Publisher, default_broker
+
+RULES_PATH = "igaming_platform_tpu/platform/configs/bonus_rules.yaml"
+
+
+class FakeRisk:
+    def __init__(self, score=0, fail=False):
+        self.score = score
+        self.fail = fail
+        self.calls = []
+
+    def score_transaction(self, account_id, amount, tx_type, **kw):
+        self.calls.append((account_id, amount, tx_type))
+        if self.fail:
+            raise ConnectionError("risk down")
+        return self.score, "approve", ["TEST"]
+
+
+def make_wallet(risk=None, events=None):
+    return WalletService(
+        InMemoryAccountRepository(),
+        InMemoryTransactionRepository(),
+        InMemoryLedgerRepository(),
+        events=events,
+        risk=risk,
+    )
+
+
+# -- wallet pipeline ---------------------------------------------------------
+
+
+def test_deposit_flow_and_ledger():
+    w = make_wallet()
+    acct = w.create_account("p1")
+    res = w.deposit(acct.id, 10_000, "k1")
+    assert res.new_balance == 10_000
+    assert res.transaction.status == TxStatus.COMPLETED
+    assert w.ledger.get_account_balance(acct.id) == 10_000
+    assert w.ledger.verify_balance(acct.id, w.get_balance(acct.id).balance)
+
+
+def test_idempotency_replay():
+    w = make_wallet()
+    acct = w.create_account("p2")
+    r1 = w.deposit(acct.id, 5_000, "same-key")
+    r2 = w.deposit(acct.id, 5_000, "same-key")
+    assert r1.transaction.id == r2.transaction.id
+    assert w.get_balance(acct.id).balance == 5_000  # only once
+
+
+def test_create_account_idempotent():
+    w = make_wallet()
+    a1 = w.create_account("px")
+    a2 = w.create_account("px")
+    assert a1.id == a2.id
+
+
+def test_bet_bonus_first_deduction():
+    w = make_wallet()
+    acct = w.create_account("p3")
+    w.deposit(acct.id, 10_000, "d1")
+    w.grant_bonus(acct.id, 3_000, "b1")
+
+    # bonus covers the full bet
+    res = w.bet(acct.id, 2_000, "bet1")
+    assert res.bonus_deducted == 2_000 and res.real_deducted == 0
+    bal = w.get_balance(acct.id)
+    assert bal.balance == 10_000 and bal.bonus == 1_000
+
+    # bonus zeroed, remainder from real
+    res = w.bet(acct.id, 3_000, "bet2")
+    assert res.bonus_deducted == 1_000 and res.real_deducted == 2_000
+    bal = w.get_balance(acct.id)
+    assert bal.balance == 8_000 and bal.bonus == 0
+
+
+def test_bet_insufficient_total():
+    w = make_wallet()
+    acct = w.create_account("p4")
+    w.deposit(acct.id, 1_000, "d1")
+    with pytest.raises(InsufficientBalanceError):
+        w.bet(acct.id, 2_000, "bet1")
+
+
+def test_win_credits_real_only():
+    w = make_wallet()
+    acct = w.create_account("p5")
+    w.grant_bonus(acct.id, 1_000, "b1")
+    res = w.win(acct.id, 5_000, "w1", game_id="g")
+    bal = w.get_balance(acct.id)
+    assert bal.balance == 5_000 and bal.bonus == 1_000
+    assert res.new_balance == 6_000
+
+
+def test_withdraw_excludes_bonus():
+    w = make_wallet()
+    acct = w.create_account("p6")
+    w.deposit(acct.id, 2_000, "d1")
+    w.grant_bonus(acct.id, 50_000, "b1")
+    with pytest.raises(InsufficientBalanceError):
+        w.withdraw(acct.id, 3_000, "wd1")
+    res = w.withdraw(acct.id, 1_500, "wd2")
+    assert w.get_balance(acct.id).balance == 500
+
+
+def test_risk_fail_open_for_deposit_closed_for_withdraw():
+    risk = FakeRisk(fail=True)
+    w = make_wallet(risk=risk)
+    acct = w.create_account("p7")
+    # deposit proceeds with risk down (fail open)
+    w.deposit(acct.id, 10_000, "d1")
+    assert w.get_balance(acct.id).balance == 10_000
+    # withdrawal fails closed
+    with pytest.raises(RiskUnavailableError):
+        w.withdraw(acct.id, 1_000, "wd1")
+
+
+def test_risk_blocks_deposit_at_block_threshold():
+    w = make_wallet(risk=FakeRisk(score=85))
+    acct = w.create_account("p8")
+    with pytest.raises(RiskBlockedError):
+        w.deposit(acct.id, 10_000, "d1")
+    assert w.get_balance(acct.id).balance == 0
+
+
+def test_withdraw_stricter_review_threshold():
+    # Score 60: allowed for deposit (< 80) but blocks withdrawal (>= 50).
+    w = make_wallet(risk=FakeRisk(score=60))
+    acct = w.create_account("p9")
+    w.deposit(acct.id, 10_000, "d1")
+    with pytest.raises(RiskReviewError):
+        w.withdraw(acct.id, 1_000, "wd1")
+
+
+def test_suspended_account_rejected():
+    w = make_wallet()
+    acct = w.create_account("p10")
+    w.accounts.update_status(acct.id, AccountStatus.SUSPENDED)
+    with pytest.raises(AccountSuspendedError):
+        w.deposit(acct.id, 1_000, "d1")
+
+
+def test_optimistic_lock_conflict_marks_tx_failed():
+    w = make_wallet()
+    acct = w.create_account("p11")
+    w.deposit(acct.id, 1_000, "d1")
+
+    stale = w.accounts.get_by_id(acct.id)
+    # Another writer bumps the version under us.
+    w.accounts.update_balance(acct.id, 2_000, 0, stale.version)
+    with pytest.raises(ConcurrentUpdateError):
+        w.accounts.update_balance(acct.id, 3_000, 0, stale.version)
+
+
+def test_refund_restores_balance():
+    w = make_wallet()
+    acct = w.create_account("p12")
+    w.deposit(acct.id, 5_000, "d1")
+    bet = w.bet(acct.id, 2_000, "bet1")
+    w.refund(acct.id, bet.transaction.id, "r1", reason="void")
+    assert w.get_balance(acct.id).balance == 5_000
+
+
+def test_events_published_to_broker():
+    broker = default_broker()
+    w = make_wallet(events=Publisher(broker))
+    acct = w.create_account("p13")
+    w.deposit(acct.id, 1_000, "d1")
+    # account.created + transaction.completed both land in risk.scoring (#)
+    assert broker.queue_depth(QUEUE_RISK_SCORING) == 2
+
+
+def test_history_pagination():
+    w = make_wallet()
+    acct = w.create_account("p14")
+    for i in range(5):
+        w.deposit(acct.id, 100, f"d{i}")
+    txs = w.get_transaction_history(acct.id, limit=2, offset=1)
+    assert len(txs) == 2
+
+
+# -- sqlite backend ----------------------------------------------------------
+
+
+def test_sqlite_full_wallet_flow():
+    store = SQLiteStore()
+    w = WalletService(store.accounts, store.transactions, store.ledger)
+    acct = w.create_account("sq1")
+    w.deposit(acct.id, 10_000, "d1")
+    w.bet(acct.id, 3_000, "b1", game_id="g1")
+    w.win(acct.id, 1_500, "w1")
+    w.withdraw(acct.id, 2_000, "wd1")
+    bal = w.get_balance(acct.id)
+    assert bal.balance == 10_000 - 3_000 + 1_500 - 2_000
+    assert store.ledger.verify_balance(acct.id, bal.balance)
+    txs = w.get_transaction_history(acct.id)
+    assert len(txs) == 4
+    # Idempotent replay through SQL unique constraint
+    r = w.deposit(acct.id, 10_000, "d1")
+    assert r.transaction.idempotency_key == "d1"
+    assert w.get_balance(acct.id).balance == bal.balance
+    store.close()
+
+
+def test_sqlite_optimistic_lock():
+    store = SQLiteStore()
+    w = WalletService(store.accounts, store.transactions, store.ledger)
+    acct = w.create_account("sq2")
+    stale = store.accounts.get_by_id(acct.id)
+    store.accounts.update_balance(acct.id, 100, 0, stale.version)
+    with pytest.raises(ConcurrentUpdateError):
+        store.accounts.update_balance(acct.id, 200, 0, stale.version)
+    store.close()
+
+
+def test_sqlite_daily_stats_and_outbox():
+    store = SQLiteStore()
+    w = WalletService(store.accounts, store.transactions, store.ledger)
+    acct = w.create_account("sq3")
+    w.deposit(acct.id, 10_000, "d1")
+    w.bet(acct.id, 2_000, "b1")
+    now = time.time()
+    stats = store.transactions.daily_stats(acct.id, now - 3600, now + 3600)
+    assert stats["total_deposits"] == 10_000
+    assert stats["total_bets"] == 2_000
+    assert stats["transaction_count"] == 2
+
+    store.outbox_add("wallet.events", "transaction.completed", "{}")
+    rows = list(store.outbox_drain())
+    assert len(rows) == 1
+    store.outbox_mark_published(rows[0][0])
+    assert list(store.outbox_drain()) == []
+    store.close()
+
+
+# -- bonus engine ------------------------------------------------------------
+
+
+def _match_rule(**kw):
+    defaults = dict(
+        id="r1", match_percent=100, max_bonus=50_000, wagering_multiplier=35,
+        max_bet_percent=10, expiry_days=30,
+        game_weights={"slots": 100, "table_games": 10},
+        excluded_games=["craps"],
+    )
+    defaults.update(kw)
+    return BonusRule(**defaults)
+
+
+def test_load_rules_yaml():
+    rules = load_rules(RULES_PATH)
+    assert len(rules) == 10
+    welcome = next(r for r in rules if r.id == "welcome_bonus_100")
+    assert welcome.match_percent == 100
+    assert welcome.max_bonus == 50_000
+    assert welcome.one_time
+    assert welcome.conditions.max_account_age_days == 7
+    assert welcome.game_weights["video_poker"] == 50
+
+
+def test_award_deposit_match_capped():
+    eng = BonusEngine([_match_rule()])
+    b = eng.award_bonus("a1", "r1", deposit_amount=100_000)  # 100% of $1000
+    assert b.bonus_amount == 50_000  # capped at max_bonus
+    assert b.wagering_required == 50_000 * 35
+    assert b.status == BonusStatus.ACTIVE
+
+
+def test_award_one_time_enforced():
+    eng = BonusEngine([_match_rule(one_time=True)])
+    eng.award_bonus("a1", "r1", deposit_amount=10_000)
+    with pytest.raises(NotEligibleError, match="already claimed"):
+        eng.award_bonus("a1", "r1", deposit_amount=10_000)
+
+
+def test_award_abuse_gate():
+    eng = BonusEngine([_match_rule()], risk_checker=lambda a: True)
+    with pytest.raises(BonusAbuseError):
+        eng.award_bonus("a1", "r1", deposit_amount=10_000)
+
+
+def test_award_conditions():
+    rule = _match_rule(conditions=Conditions(min_deposits_lifetime=3, excluded_segments=["bonus_abuser"]))
+    eng = BonusEngine([rule], player_data=lambda a: PlayerInfo(a, total_deposits=1))
+    with pytest.raises(NotEligibleError):
+        eng.award_bonus("a1", "r1", deposit_amount=10_000)
+
+    eng2 = BonusEngine([rule], player_data=lambda a: PlayerInfo(a, total_deposits=5, segment="bonus_abuser"))
+    with pytest.raises(NotEligibleError):
+        eng2.award_bonus("a1", "r1", deposit_amount=10_000)
+
+    eng3 = BonusEngine([rule], player_data=lambda a: PlayerInfo(a, total_deposits=5))
+    assert eng3.award_bonus("a1", "r1", deposit_amount=10_000).bonus_amount == 10_000
+
+
+def test_wagering_progress_with_game_weights():
+    eng = BonusEngine([_match_rule(wagering_multiplier=2)])
+    b = eng.award_bonus("a1", "r1", deposit_amount=1_000)  # bonus 1000, wager 2000
+    eng.process_wager("a1", 1_000, "slots")  # 100% weight
+    assert eng.repo.get_by_id(b.id).wagering_progress == 1_000
+    eng.process_wager("a1", 1_000, "table_games")  # 10% weight
+    assert eng.repo.get_by_id(b.id).wagering_progress == 1_100
+    eng.process_wager("a1", 1_000, "craps")  # excluded
+    assert eng.repo.get_by_id(b.id).wagering_progress == 1_100
+    completed = eng.process_wager("a1", 900, "slots")
+    assert completed and eng.repo.get_by_id(b.id).status == BonusStatus.COMPLETED
+
+
+def test_max_bet_limits():
+    eng = BonusEngine([_match_rule(max_bet_percent=10, max_bet_absolute=500)])
+    eng.award_bonus("a1", "r1", deposit_amount=10_000)  # bonus 10000
+    eng.check_max_bet("a1", 400)  # ok
+    with pytest.raises(MaxBetExceededError):
+        eng.check_max_bet("a1", 600)  # > absolute 500
+    with pytest.raises(MaxBetExceededError):
+        eng.check_max_bet("a1", 1_100)  # > 10% of bonus
+
+
+def test_expiry_sweep():
+    t = [1000.0]
+    eng = BonusEngine([_match_rule(expiry_days=1)], now_fn=lambda: t[0])
+    eng.award_bonus("a1", "r1", deposit_amount=1_000)
+    assert eng.expire_old_bonuses() == 0
+    t[0] += 2 * 86400
+    assert eng.expire_old_bonuses() == 1
+
+
+def test_forfeiture():
+    eng = BonusEngine([_match_rule()])
+    eng.award_bonus("a1", "r1", deposit_amount=1_000)
+    assert eng.forfeit_bonuses("a1") == 1
+    assert eng.repo.get_active_by_account("a1") == []
+
+
+def test_schedule_day_of_week():
+    # Pin "now" to a known Friday (2026-07-24 12:00 UTC).
+    friday = 1784894400.0
+    rule = _match_rule(schedule=Schedule(days_of_week=["Friday", "Saturday"]))
+    eng = BonusEngine([rule], now_fn=lambda: friday)
+    assert eng._check_schedule(rule)
+    monday = friday + 3 * 86400
+    eng2 = BonusEngine([rule], now_fn=lambda: monday)
+    assert not eng2._check_schedule(rule)
+
+
+def test_cashback_calculation():
+    rule = BonusRule(id="cb", type="cashback", cashback_percent=10, max_bonus=50_000)
+    eng = BonusEngine([rule])
+    assert eng.calculate_cashback(rule, 100_000) == 10_000
+    assert eng.calculate_cashback(rule, 10_000_000) == 50_000  # capped
+    assert eng.calculate_cashback(rule, 0) == 0
+
+
+def test_wallet_bonus_integration_max_bet_gate():
+    w = make_wallet()
+    acct = w.create_account("pi1")
+    w.deposit(acct.id, 10_000, "d1")
+    eng = BonusEngine([_match_rule(max_bet_absolute=500)])
+    eng.award_bonus(acct.id, "r1", deposit_amount=5_000)
+    w.grant_bonus(acct.id, 5_000, "bg1")
+
+    from igaming_platform_tpu.platform.domain import BonusRestrictionError
+
+    def gate(account_id, amount):
+        try:
+            eng.check_max_bet(account_id, amount)
+        except MaxBetExceededError as exc:
+            raise BonusRestrictionError(str(exc)) from exc
+
+    with pytest.raises(BonusRestrictionError):
+        w.bet(acct.id, 1_000, "bet1", max_bet_check=gate)
+    res = w.bet(acct.id, 400, "bet2", max_bet_check=gate)
+    assert res.bonus_deducted == 400
