@@ -1,0 +1,188 @@
+"""Prometheus-style metrics — implementing what the reference stubs.
+
+The reference deploys Prometheus+Grafana but its metrics interceptors are
+TODOs (wallet/cmd/main.go:306-311; risk/cmd/main.go:344-353 lists the
+intended series without recording them). This registry records that exact
+set — request counts, latency histograms, error counts, score distribution
+— plus the BASELINE series (txns/sec, batch occupancy) and renders the
+Prometheus text exposition format for the /metrics sidecar.
+
+Dependency-free: counters/gauges/histograms over a lock, no client lib.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterable
+
+_DEFAULT_BUCKETS = (0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500)
+
+
+def _label_key(labels: dict[str, str]) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def _fmt_labels(key: tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str = ""):
+        self.name = name
+        self.help = help_text
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, value: float = 1.0, **labels: str) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def value(self, **labels: str) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def render(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} counter"
+        for key, v in sorted(self._values.items()):
+            yield f"{self.name}{_fmt_labels(key)} {v}"
+
+
+class Gauge:
+    def __init__(self, name: str, help_text: str = ""):
+        self.name = name
+        self.help = help_text
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = value
+
+    def value(self, **labels: str) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def render(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} gauge"
+        for key, v in sorted(self._values.items()):
+            yield f"{self.name}{_fmt_labels(key)} {v}"
+
+
+class Histogram:
+    def __init__(self, name: str, help_text: str = "", buckets: tuple = _DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_text
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def percentile(self, q: float, **labels: str) -> float:
+        """Approximate percentile from bucket boundaries (upper bound)."""
+        key = _label_key(labels)
+        with self._lock:
+            total = self._totals.get(key, 0)
+            if total == 0:
+                return 0.0
+            target = q * total
+            counts = self._counts[key]
+            for i, bound in enumerate(self.buckets):
+                if counts[i] >= target:
+                    return bound
+            return float("inf")
+
+    def count(self, **labels: str) -> int:
+        return self._totals.get(_label_key(labels), 0)
+
+    def render(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} histogram"
+        for key in sorted(self._totals):
+            counts = self._counts[key]
+            for bound, c in zip(self.buckets, counts):
+                lk = key + (("le", str(bound)),)
+                yield f"{self.name}_bucket{_fmt_labels(tuple(sorted(lk)))} {c}"
+            lk = key + (("le", "+Inf"),)
+            yield f"{self.name}_bucket{_fmt_labels(tuple(sorted(lk)))} {self._totals[key]}"
+            yield f"{self.name}_sum{_fmt_labels(key)} {self._sums[key]}"
+            yield f"{self.name}_count{_fmt_labels(key)} {self._totals[key]}"
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list = []
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        m = Counter(name, help_text)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        m = Gauge(name, help_text)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def histogram(self, name: str, help_text: str = "", buckets: tuple = _DEFAULT_BUCKETS) -> Histogram:
+        m = Histogram(name, help_text, buckets)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def render_text(self) -> str:
+        lines: list[str] = []
+        with self._lock:
+            for m in self._metrics:
+                lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+class ServiceMetrics:
+    """The series the reference's stubs name (risk/cmd/main.go:344-353)."""
+
+    def __init__(self, service: str, registry: Registry | None = None):
+        self.registry = registry or Registry()
+        self.requests_total = self.registry.counter(
+            f"{service}_grpc_requests_total", "gRPC requests by method and code"
+        )
+        self.request_duration_ms = self.registry.histogram(
+            f"{service}_grpc_request_duration_ms", "gRPC request latency (ms)"
+        )
+        self.errors_total = self.registry.counter(
+            f"{service}_grpc_errors_total", "gRPC errors by method"
+        )
+        self.score_distribution = self.registry.histogram(
+            f"{service}_risk_score", "Fraud score distribution",
+            buckets=(10, 20, 30, 40, 50, 60, 70, 80, 90, 100),
+        )
+        self.txns_scored_total = self.registry.counter(
+            f"{service}_txns_scored_total", "Transactions fraud-scored"
+        )
+        self.batch_occupancy = self.registry.histogram(
+            f"{service}_batch_occupancy", "Rows per device batch",
+            buckets=(1, 8, 32, 64, 128, 256, 512, 1024),
+        )
+
+    def observe_rpc(self, method: str, start_time: float, code: str = "OK") -> None:
+        self.requests_total.inc(method=method, code=code)
+        self.request_duration_ms.observe((time.monotonic() - start_time) * 1000.0, method=method)
+        if code != "OK":
+            self.errors_total.inc(method=method)

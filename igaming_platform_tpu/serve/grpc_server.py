@@ -1,0 +1,664 @@
+"""gRPC front: wire-compatible risk.v1 and wallet.v1 servers.
+
+The reference exposes RiskService (risk.proto:10-32) and WalletService
+(wallet.proto:10-26) over grpc-go with a logging -> recovery -> metrics
+interceptor chain and the gRPC health protocol
+(risk/cmd/main.go:133-147, wallet/cmd/main.go:137-151). This module serves
+the same contracts from Python: method handlers are registered generically
+against the protoc-generated message classes (no grpc_tools plugin
+needed), so any reference client — including `grpcurl` and the Go wallet
+service — talks to these servers unchanged.
+
+Interceptor parity: handlers time every RPC into ServiceMetrics (the
+reference's metrics interceptor is an unimplemented TODO — SURVEY.md §5),
+recover from handler panics into INTERNAL (recovery interceptor), and the
+health service flips NOT_SERVING before drain (graceful shutdown).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import logging
+import os
+import threading
+import time
+from concurrent import futures
+from typing import Callable
+
+import grpc
+
+from igaming_platform_tpu.obs.metrics import ServiceMetrics
+
+logger = logging.getLogger(__name__)
+
+
+class RpcAbort(Exception):
+    """Typed abort raised inside handlers; mapped to a status by _rpc.
+
+    grpcio's context.abort raises an opaque Exception that the recovery
+    wrapper cannot distinguish from a crash, so handlers raise this
+    instead."""
+
+    def __init__(self, code, details: str):
+        super().__init__(details)
+        self.code = code
+        self.details = details
+
+_PROTO_GEN = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "proto_gen")
+
+
+def _load_module(name: str, rel_path: str):
+    spec = importlib.util.spec_from_file_location(name, os.path.join(_PROTO_GEN, rel_path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# risk/wallet pb2 are proper packages on sys.path (igaming_platform_tpu
+# appends proto_gen); health_pb2 must NOT be imported as "grpc.health..."
+# (it would shadow grpcio), so it loads by file path.
+from risk.v1 import risk_pb2  # noqa: E402
+from wallet.v1 import wallet_pb2  # noqa: E402
+
+health_pb2 = _load_module("igaming_health_pb2", "grpc/health/v1/health_pb2.py")
+
+SERVING = health_pb2.HealthCheckResponse.SERVING
+NOT_SERVING = health_pb2.HealthCheckResponse.NOT_SERVING
+
+
+class HealthServicer:
+    """Standard grpc.health.v1 implementation (hand-registered)."""
+
+    def __init__(self):
+        self._status: dict[str, int] = {"": SERVING}
+        self._lock = threading.Lock()
+
+    def set(self, service: str, status: int) -> None:
+        with self._lock:
+            self._status[service] = status
+
+    def set_all_not_serving(self) -> None:
+        with self._lock:
+            for k in self._status:
+                self._status[k] = NOT_SERVING
+
+    def check(self, request, context):
+        with self._lock:
+            status = self._status.get(request.service)
+        if status is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "unknown service")
+        return health_pb2.HealthCheckResponse(status=status)
+
+
+def _rpc(metrics: ServiceMetrics, method: str, fn: Callable):
+    """Wrap a handler with metrics + panic recovery (the interceptor chain
+    of wallet/cmd/main.go:274-311 collapsed into one decorator)."""
+
+    def handler(request, context):
+        start = time.monotonic()
+        try:
+            resp = fn(request, context)
+            metrics.observe_rpc(method, start)
+            return resp
+        except RpcAbort as abort:
+            metrics.observe_rpc(method, start, code=abort.code.name)
+            context.abort(abort.code, abort.details)
+        except grpc.RpcError:
+            metrics.observe_rpc(method, start, code="ERROR")
+            raise
+        except Exception as exc:  # noqa: BLE001 — recovery interceptor
+            logger.exception("handler panic in %s", method)
+            metrics.observe_rpc(method, start, code="INTERNAL")
+            context.abort(grpc.StatusCode.INTERNAL, f"internal error: {exc}")
+
+    return handler
+
+
+def _unary(fn, req_cls, resp_cls):
+    return grpc.unary_unary_rpc_method_handler(
+        fn,
+        request_deserializer=req_cls.FromString,
+        response_serializer=resp_cls.SerializeToString,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Risk service
+# ---------------------------------------------------------------------------
+
+
+class RiskGrpcService:
+    """risk.v1.RiskService against the TPU scoring engine + LTV + abuse."""
+
+    def __init__(self, engine, ltv_source=None, abuse_detector=None, metrics: ServiceMetrics | None = None):
+        """
+        engine: serve.scorer.TPUScoringEngine
+        ltv_source: callable(account_id) -> [25]-dim LTV feature row or None
+        abuse_detector: callable(account_id, bonus_id) -> (score, signals, linked)
+        """
+        self.engine = engine
+        self.ltv_source = ltv_source
+        self.abuse_detector = abuse_detector
+        self.metrics = metrics or ServiceMetrics("risk")
+
+    # -- scoring --
+
+    def _score_to_proto(self, resp) -> risk_pb2.ScoreTransactionResponse:
+        f = resp.features
+        return risk_pb2.ScoreTransactionResponse(
+            score=resp.score,
+            action={"approve": 1, "review": 2, "block": 3}[resp.action],
+            reason_codes=[r.value for r in resp.reason_codes],
+            rule_score=resp.rule_score,
+            ml_score=resp.ml_score,
+            response_time_ms=int(resp.response_time_ms),
+            features=risk_pb2.FeatureVector(
+                tx_count_1m=int(f.tx_count_1m),
+                tx_count_5m=int(f.tx_count_5m),
+                tx_count_1h=int(f.tx_count_1h),
+                tx_sum_1h=int(f.tx_sum_1h),
+                tx_avg_1h=f.tx_avg_1h,
+                unique_devices_24h=int(f.unique_devices_24h),
+                unique_ips_24h=int(f.unique_ips_24h),
+                ip_country_changes_7d=int(f.ip_country_changes),
+                device_age_days=int(f.device_age_days),
+                account_age_days=int(f.account_age_days),
+                total_deposits=int(f.total_deposits),
+                total_withdrawals=int(f.total_withdrawals),
+                net_deposit=int(f.net_deposit),
+                deposit_count=int(f.deposit_count),
+                withdraw_count=int(f.withdraw_count),
+                time_since_last_tx_sec=int(f.time_since_last_tx),
+                session_duration_sec=int(f.session_duration),
+                avg_bet_size=f.avg_bet_size,
+                win_rate=f.win_rate,
+                is_vpn=f.is_vpn > 0,
+                is_proxy=f.is_proxy > 0,
+                is_tor=f.is_tor > 0,
+                disposable_email=f.disposable_email > 0,
+                bonus_claim_count=int(f.bonus_claim_count),
+                bonus_wager_completion_rate=f.bonus_wager_rate,
+                bonus_only_player=f.bonus_only_player > 0,
+            ),
+        )
+
+    def _request_from_proto(self, req):
+        from igaming_platform_tpu.serve.scorer import ScoreRequest
+
+        return ScoreRequest(
+            account_id=req.account_id,
+            player_id=req.player_id,
+            amount=req.amount,
+            tx_type=req.transaction_type or "deposit",
+            currency=req.currency or "USD",
+            game_id=req.game_id,
+            ip=req.ip_address,
+            device_id=req.device_id,
+            fingerprint=req.fingerprint,
+            user_agent=req.user_agent,
+            session_id=req.session_id,
+        )
+
+    def ScoreTransaction(self, request, context):
+        resp = self.engine.score(self._request_from_proto(request))
+        self.metrics.score_distribution.observe(resp.score)
+        self.metrics.txns_scored_total.inc()
+        return self._score_to_proto(resp)
+
+    def ScoreBatch(self, request, context):
+        reqs = [self._request_from_proto(t) for t in request.transactions]
+        responses = self.engine.score_batch(reqs)
+        self.metrics.txns_scored_total.inc(len(responses))
+        return risk_pb2.ScoreBatchResponse(results=[self._score_to_proto(r) for r in responses])
+
+    # -- LTV --
+
+    def _ltv_row(self, account_id: str):
+        import numpy as np
+
+        from igaming_platform_tpu.models.ltv import NUM_LTV_FEATURES
+
+        if self.ltv_source is not None:
+            row = self.ltv_source(account_id)
+            if row is not None:
+                return np.asarray(row, dtype=np.float32).reshape(1, NUM_LTV_FEATURES)
+        return np.zeros((1, NUM_LTV_FEATURES), dtype=np.float32)
+
+    def PredictLTV(self, request, context):
+        from google.protobuf.timestamp_pb2 import Timestamp
+
+        from igaming_platform_tpu.models.ltv import ACTIONS, predict_batch_jit
+
+        out = predict_batch_jit(self._ltv_row(request.account_id))
+        ts = Timestamp()
+        ts.GetCurrentTime()
+        return risk_pb2.PredictLTVResponse(
+            account_id=request.account_id,
+            predicted_ltv=float(out["ltv"][0]),
+            segment=int(out["segment"][0]),
+            churn_risk=float(out["churn_risk"][0]),
+            predicted_active_days=int(out["survival_days"][0]),
+            confidence=float(out["confidence"][0]),
+            next_best_action=ACTIONS[int(out["action"][0])],
+            predicted_at=ts,
+        )
+
+    def GetPlayerSegment(self, request, context):
+        from igaming_platform_tpu.models.ltv import ACTIONS, predict_batch_jit
+
+        out = predict_batch_jit(self._ltv_row(request.account_id))
+        return risk_pb2.GetPlayerSegmentResponse(
+            account_id=request.account_id,
+            segment=int(out["segment"][0]),
+            ltv=float(out["ltv"][0]),
+            churn_risk=float(out["churn_risk"][0]),
+            recommended_actions=[ACTIONS[int(out["action"][0])]],
+        )
+
+    # -- bonus abuse --
+
+    def CheckBonusAbuse(self, request, context):
+        if self.abuse_detector is not None:
+            score, signals, linked = self.abuse_detector(request.account_id, request.bonus_id)
+        else:
+            # Scalar fallback: the bonus-only-player heuristic.
+            import numpy as np
+
+            from igaming_platform_tpu.core.features import F, NUM_FEATURES
+
+            row = np.zeros(NUM_FEATURES, dtype=np.float32)
+            self.engine.features.fill_row(row, request.account_id, 0, "bet")
+            score = 0.8 if row[F.BONUS_ONLY_PLAYER] > 0 else 0.1
+            signals = ["BONUS_ONLY_PLAYER"] if score > 0.5 else []
+            linked = []
+        return risk_pb2.CheckBonusAbuseResponse(
+            is_abuser=score >= 0.5,
+            abuse_score=score,
+            signals=signals,
+            linked_accounts=linked,
+        )
+
+    # -- blacklist --
+
+    def AddToBlacklist(self, request, context):
+        try:
+            self.engine.features.add_to_blacklist(request.type, request.value)
+        except ValueError as exc:
+            raise RpcAbort(grpc.StatusCode.INVALID_ARGUMENT, str(exc)) from exc
+        return risk_pb2.AddToBlacklistResponse(success=True, id=f"{request.type}:{request.value}")
+
+    def CheckBlacklist(self, request, context):
+        hit = self.engine.features.check_blacklist(
+            device_id=request.device_id, fingerprint=request.fingerprint, ip=request.ip_address
+        )
+        return risk_pb2.CheckBlacklistResponse(is_blacklisted=hit)
+
+    # -- features / thresholds --
+
+    def GetFeatures(self, request, context):
+        import numpy as np
+
+        from google.protobuf.timestamp_pb2 import Timestamp
+
+        from igaming_platform_tpu.core.features import FeatureVector, NUM_FEATURES
+
+        row = np.zeros(NUM_FEATURES, dtype=np.float32)
+        self.engine.features.fill_row(row, request.account_id, 0, "deposit")
+        f = FeatureVector.from_array(row)
+        ts = Timestamp()
+        ts.GetCurrentTime()
+        return risk_pb2.GetFeaturesResponse(
+            account_id=request.account_id,
+            features=risk_pb2.FeatureVector(
+                tx_count_1m=int(f.tx_count_1m),
+                tx_count_5m=int(f.tx_count_5m),
+                tx_count_1h=int(f.tx_count_1h),
+                tx_sum_1h=int(f.tx_sum_1h),
+                tx_avg_1h=f.tx_avg_1h,
+                unique_devices_24h=int(f.unique_devices_24h),
+                unique_ips_24h=int(f.unique_ips_24h),
+                account_age_days=int(f.account_age_days),
+                total_deposits=int(f.total_deposits),
+                total_withdrawals=int(f.total_withdrawals),
+                net_deposit=int(f.net_deposit),
+                deposit_count=int(f.deposit_count),
+                withdraw_count=int(f.withdraw_count),
+                time_since_last_tx_sec=int(f.time_since_last_tx),
+                session_duration_sec=int(f.session_duration),
+                bonus_claim_count=int(f.bonus_claim_count),
+                bonus_wager_completion_rate=f.bonus_wager_rate,
+                bonus_only_player=f.bonus_only_player > 0,
+            ),
+            computed_at=ts,
+        )
+
+    def UpdateThresholds(self, request, context):
+        self.engine.set_thresholds(request.block_threshold, request.review_threshold)
+        return risk_pb2.UpdateThresholdsResponse(
+            success=True,
+            block_threshold=request.block_threshold,
+            review_threshold=request.review_threshold,
+        )
+
+    def GetThresholds(self, request, context):
+        block, review = self.engine.get_thresholds()
+        return risk_pb2.GetThresholdsResponse(block_threshold=block, review_threshold=review)
+
+
+_RISK_METHODS = {
+    "ScoreTransaction": (risk_pb2.ScoreTransactionRequest, risk_pb2.ScoreTransactionResponse),
+    "ScoreBatch": (risk_pb2.ScoreBatchRequest, risk_pb2.ScoreBatchResponse),
+    "PredictLTV": (risk_pb2.PredictLTVRequest, risk_pb2.PredictLTVResponse),
+    "GetPlayerSegment": (risk_pb2.GetPlayerSegmentRequest, risk_pb2.GetPlayerSegmentResponse),
+    "CheckBonusAbuse": (risk_pb2.CheckBonusAbuseRequest, risk_pb2.CheckBonusAbuseResponse),
+    "AddToBlacklist": (risk_pb2.AddToBlacklistRequest, risk_pb2.AddToBlacklistResponse),
+    "CheckBlacklist": (risk_pb2.CheckBlacklistRequest, risk_pb2.CheckBlacklistResponse),
+    "GetFeatures": (risk_pb2.GetFeaturesRequest, risk_pb2.GetFeaturesResponse),
+    "UpdateThresholds": (risk_pb2.UpdateThresholdsRequest, risk_pb2.UpdateThresholdsResponse),
+    "GetThresholds": (risk_pb2.GetThresholdsRequest, risk_pb2.GetThresholdsResponse),
+}
+
+
+# ---------------------------------------------------------------------------
+# Wallet service
+# ---------------------------------------------------------------------------
+
+
+class WalletGrpcService:
+    """wallet.v1.WalletService against platform.wallet.WalletService."""
+
+    def __init__(self, wallet, metrics: ServiceMetrics | None = None):
+        self.wallet = wallet
+        self.metrics = metrics or ServiceMetrics("wallet")
+
+    def _tx_to_proto(self, tx) -> wallet_pb2.Transaction:
+        from google.protobuf.timestamp_pb2 import Timestamp
+
+        msg = wallet_pb2.Transaction(
+            id=tx.id,
+            account_id=tx.account_id,
+            idempotency_key=tx.idempotency_key,
+            type=tx.type.value,
+            amount=tx.amount,
+            balance_before=tx.balance_before,
+            balance_after=tx.balance_after,
+            status=tx.status.value,
+            reference=tx.reference,
+            game_id=tx.game_id or "",
+            round_id=tx.round_id or "",
+            risk_score=tx.risk_score or 0,
+        )
+        created = Timestamp()
+        created.FromSeconds(int(tx.created_at))
+        msg.created_at.CopyFrom(created)
+        if tx.completed_at:
+            completed = Timestamp()
+            completed.FromSeconds(int(tx.completed_at))
+            msg.completed_at.CopyFrom(completed)
+        return msg
+
+    def _account_to_proto(self, a) -> wallet_pb2.Account:
+        from google.protobuf.timestamp_pb2 import Timestamp
+
+        msg = wallet_pb2.Account(
+            id=a.id, player_id=a.player_id, currency=a.currency,
+            balance=a.balance, bonus=a.bonus, status=a.status.value,
+        )
+        ts = Timestamp()
+        ts.FromSeconds(int(a.created_at))
+        msg.created_at.CopyFrom(ts)
+        ts2 = Timestamp()
+        ts2.FromSeconds(int(a.updated_at))
+        msg.updated_at.CopyFrom(ts2)
+        return msg
+
+    def _domain_error(self, context, exc):
+        from igaming_platform_tpu.platform import domain as d
+
+        code_map = {
+            d.AccountNotFoundError: grpc.StatusCode.NOT_FOUND,
+            d.AccountSuspendedError: grpc.StatusCode.FAILED_PRECONDITION,
+            d.InsufficientBalanceError: grpc.StatusCode.FAILED_PRECONDITION,
+            d.DuplicateTransactionError: grpc.StatusCode.ALREADY_EXISTS,
+            d.InvalidAmountError: grpc.StatusCode.INVALID_ARGUMENT,
+            d.ConcurrentUpdateError: grpc.StatusCode.ABORTED,
+            d.RiskBlockedError: grpc.StatusCode.PERMISSION_DENIED,
+            d.RiskReviewError: grpc.StatusCode.PERMISSION_DENIED,
+            d.RiskUnavailableError: grpc.StatusCode.UNAVAILABLE,
+            d.BonusRestrictionError: grpc.StatusCode.FAILED_PRECONDITION,
+        }
+        code = code_map.get(type(exc), grpc.StatusCode.INTERNAL)
+        raise RpcAbort(code, f"{getattr(exc, 'code', 'WALLET_ERROR')}: {exc}") from exc
+
+    def CreateAccount(self, request, context):
+        acct = self.wallet.create_account(request.player_id, request.currency or "USD")
+        return wallet_pb2.CreateAccountResponse(account=self._account_to_proto(acct))
+
+    def GetAccount(self, request, context):
+        from igaming_platform_tpu.platform.domain import AccountNotFoundError
+
+        try:
+            if request.WhichOneof("identifier") == "player_id":
+                acct = self.wallet.accounts.get_by_player_id(request.player_id)
+                if acct is None:
+                    raise AccountNotFoundError(request.player_id)
+            else:
+                acct = self.wallet.accounts.get_by_id(request.account_id)
+        except AccountNotFoundError as exc:
+            self._domain_error(context, exc)
+        return wallet_pb2.GetAccountResponse(account=self._account_to_proto(acct))
+
+    def GetBalance(self, request, context):
+        from igaming_platform_tpu.platform.domain import AccountNotFoundError
+
+        try:
+            acct = self.wallet.get_balance(request.account_id)
+        except AccountNotFoundError as exc:
+            self._domain_error(context, exc)
+        return wallet_pb2.GetBalanceResponse(
+            account_id=acct.id,
+            balance=acct.balance,
+            bonus=acct.bonus,
+            total=acct.total_balance,
+            withdrawable=acct.available_for_withdraw,
+            currency=acct.currency,
+        )
+
+    def Deposit(self, request, context):
+        from igaming_platform_tpu.platform.domain import WalletError
+
+        try:
+            res = self.wallet.deposit(
+                request.account_id, request.amount, request.idempotency_key,
+                payment_method=request.payment_method, reference=request.reference,
+                ip=request.ip_address, device_id=request.device_id,
+                fingerprint=request.fingerprint,
+            )
+        except WalletError as exc:
+            self._domain_error(context, exc)
+        return wallet_pb2.DepositResponse(
+            transaction=self._tx_to_proto(res.transaction),
+            new_balance=res.new_balance,
+            risk_score=res.risk_score or 0,
+        )
+
+    def Withdraw(self, request, context):
+        from igaming_platform_tpu.platform.domain import WalletError
+
+        try:
+            res = self.wallet.withdraw(
+                request.account_id, request.amount, request.idempotency_key,
+                payout_method=request.payout_method, ip=request.ip_address,
+                device_id=request.device_id,
+            )
+        except WalletError as exc:
+            self._domain_error(context, exc)
+        return wallet_pb2.WithdrawResponse(
+            transaction=self._tx_to_proto(res.transaction),
+            new_balance=res.new_balance,
+            risk_score=res.risk_score or 0,
+            payout_status="completed",
+        )
+
+    def Bet(self, request, context):
+        from igaming_platform_tpu.platform.domain import WalletError
+
+        try:
+            res = self.wallet.bet(
+                request.account_id, request.amount, request.idempotency_key,
+                game_id=request.game_id, round_id=request.round_id,
+                game_category=request.game_category, ip=request.ip_address,
+                device_id=request.device_id,
+            )
+        except WalletError as exc:
+            self._domain_error(context, exc)
+        return wallet_pb2.BetResponse(
+            transaction=self._tx_to_proto(res.transaction),
+            new_balance=res.new_balance,
+            risk_score=res.risk_score or 0,
+            real_deducted=res.real_deducted,
+            bonus_deducted=res.bonus_deducted,
+        )
+
+    def Win(self, request, context):
+        from igaming_platform_tpu.platform.domain import WalletError
+
+        try:
+            res = self.wallet.win(
+                request.account_id, request.amount, request.idempotency_key,
+                game_id=request.game_id, round_id=request.round_id,
+                bet_tx_id=request.bet_transaction_id, win_type=request.win_type or "normal",
+            )
+        except WalletError as exc:
+            self._domain_error(context, exc)
+        return wallet_pb2.WinResponse(
+            transaction=self._tx_to_proto(res.transaction), new_balance=res.new_balance
+        )
+
+    def Refund(self, request, context):
+        from igaming_platform_tpu.platform.domain import WalletError
+
+        try:
+            res = self.wallet.refund(
+                request.account_id, request.original_transaction_id,
+                request.idempotency_key, reason=request.reason,
+            )
+        except WalletError as exc:
+            self._domain_error(context, exc)
+        return wallet_pb2.RefundResponse(
+            transaction=self._tx_to_proto(res.transaction), new_balance=res.new_balance
+        )
+
+    def GetTransaction(self, request, context):
+        tx = self.wallet.transactions.get_by_id(request.transaction_id)
+        if tx is None:
+            raise RpcAbort(grpc.StatusCode.NOT_FOUND, "transaction not found")
+        return wallet_pb2.GetTransactionResponse(transaction=self._tx_to_proto(tx))
+
+    def GetTransactionHistory(self, request, context):
+        limit = min(request.limit or 50, 100)
+        txs = self.wallet.get_transaction_history(request.account_id, limit, request.offset)
+        if request.types:
+            txs = [t for t in txs if t.type.value in request.types]
+        return wallet_pb2.GetTransactionHistoryResponse(
+            transactions=[self._tx_to_proto(t) for t in txs],
+            total=len(txs),
+            has_more=len(txs) == limit,
+        )
+
+
+_WALLET_METHODS = {
+    "CreateAccount": (wallet_pb2.CreateAccountRequest, wallet_pb2.CreateAccountResponse),
+    "GetAccount": (wallet_pb2.GetAccountRequest, wallet_pb2.GetAccountResponse),
+    "GetBalance": (wallet_pb2.GetBalanceRequest, wallet_pb2.GetBalanceResponse),
+    "Deposit": (wallet_pb2.DepositRequest, wallet_pb2.DepositResponse),
+    "Withdraw": (wallet_pb2.WithdrawRequest, wallet_pb2.WithdrawResponse),
+    "Bet": (wallet_pb2.BetRequest, wallet_pb2.BetResponse),
+    "Win": (wallet_pb2.WinRequest, wallet_pb2.WinResponse),
+    "Refund": (wallet_pb2.RefundRequest, wallet_pb2.RefundResponse),
+    "GetTransaction": (wallet_pb2.GetTransactionRequest, wallet_pb2.GetTransactionResponse),
+    "GetTransactionHistory": (
+        wallet_pb2.GetTransactionHistoryRequest,
+        wallet_pb2.GetTransactionHistoryResponse,
+    ),
+}
+
+
+# ---------------------------------------------------------------------------
+# Server / stub assembly
+# ---------------------------------------------------------------------------
+
+
+def _generic_handler(service_name: str, servicer, methods: dict, metrics: ServiceMetrics):
+    handlers = {
+        name: _unary(_rpc(metrics, name, getattr(servicer, name)), req, resp)
+        for name, (req, resp) in methods.items()
+    }
+    return grpc.method_handlers_generic_handler(service_name, handlers)
+
+
+def _health_handler(health: HealthServicer):
+    handlers = {
+        "Check": _unary(health.check, health_pb2.HealthCheckRequest, health_pb2.HealthCheckResponse)
+    }
+    return grpc.method_handlers_generic_handler("grpc.health.v1.Health", handlers)
+
+
+def serve_risk(service: RiskGrpcService, port: int, max_workers: int = 32):
+    """Build + start the risk.v1 server; returns (server, health)."""
+    health = HealthServicer()
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((
+        _generic_handler("risk.v1.RiskService", service, _RISK_METHODS, service.metrics),
+        _health_handler(health),
+    ))
+    bound = server.add_insecure_port(f"[::]:{port}")
+    server.start()
+    return server, health, bound
+
+
+def serve_wallet(service: WalletGrpcService, port: int, max_workers: int = 32):
+    health = HealthServicer()
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((
+        _generic_handler("wallet.v1.WalletService", service, _WALLET_METHODS, service.metrics),
+        _health_handler(health),
+    ))
+    bound = server.add_insecure_port(f"[::]:{port}")
+    server.start()
+    return server, health, bound
+
+
+def graceful_stop(server, health: HealthServicer, grace: float = 30.0) -> None:
+    """NOT_SERVING before drain (risk/cmd/main.go:249)."""
+    health.set_all_not_serving()
+    server.stop(grace).wait()
+
+
+def _make_stub(channel, service_name: str, methods: dict):
+    class _Stub:
+        pass
+
+    stub = _Stub()
+    for name, (req_cls, resp_cls) in methods.items():
+        setattr(stub, name, channel.unary_unary(
+            f"/{service_name}/{name}",
+            request_serializer=req_cls.SerializeToString,
+            response_deserializer=resp_cls.FromString,
+        ))
+    return stub
+
+
+def make_risk_stub(channel):
+    return _make_stub(channel, "risk.v1.RiskService", _RISK_METHODS)
+
+
+def make_wallet_stub(channel):
+    return _make_stub(channel, "wallet.v1.WalletService", _WALLET_METHODS)
+
+
+def make_health_stub(channel):
+    return _make_stub(
+        channel, "grpc.health.v1.Health",
+        {"Check": (health_pb2.HealthCheckRequest, health_pb2.HealthCheckResponse)},
+    )

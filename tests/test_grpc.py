@@ -1,0 +1,216 @@
+"""End-to-end gRPC tests: wire-compatible risk.v1 + wallet.v1 over localhost."""
+
+import grpc
+import numpy as np
+import pytest
+
+from igaming_platform_tpu.core.config import BatcherConfig
+from igaming_platform_tpu.platform.repository import (
+    InMemoryAccountRepository,
+    InMemoryLedgerRepository,
+    InMemoryTransactionRepository,
+)
+from igaming_platform_tpu.platform.risk_adapter import InProcessRiskGate
+from igaming_platform_tpu.platform.wallet import WalletService
+from igaming_platform_tpu.serve.feature_store import TransactionEvent
+from igaming_platform_tpu.serve.grpc_server import (
+    NOT_SERVING,
+    SERVING,
+    RiskGrpcService,
+    WalletGrpcService,
+    graceful_stop,
+    make_health_stub,
+    make_risk_stub,
+    make_wallet_stub,
+    serve_risk,
+    serve_wallet,
+)
+from igaming_platform_tpu.serve.scorer import TPUScoringEngine
+
+from risk.v1 import risk_pb2
+from wallet.v1 import wallet_pb2
+
+
+@pytest.fixture(scope="module")
+def risk_server():
+    engine = TPUScoringEngine(batcher_config=BatcherConfig(batch_size=32, max_wait_ms=1))
+    service = RiskGrpcService(engine)
+    server, health, port = serve_risk(service, 0)
+    channel = grpc.insecure_channel(f"localhost:{port}")
+    yield engine, make_risk_stub(channel), make_health_stub(channel), health, server
+    channel.close()
+    server.stop(0)
+    engine.close()
+
+
+def test_health_check(risk_server):
+    _, _, health_stub, health, _ = risk_server
+    resp = health_stub.Check(__import__("igaming_platform_tpu.serve.grpc_server", fromlist=["health_pb2"]).health_pb2.HealthCheckRequest())
+    assert resp.status == SERVING
+
+
+def test_score_transaction_rpc(risk_server):
+    engine, stub, *_ = risk_server
+    engine.update_features(TransactionEvent("grpc-acct", 5000, "deposit", device_id="d1"))
+    resp = stub.ScoreTransaction(risk_pb2.ScoreTransactionRequest(
+        account_id="grpc-acct", amount=2000, transaction_type="deposit",
+        device_id="d1", ip_address="1.2.3.4",
+    ))
+    assert 0 <= resp.score <= 100
+    assert resp.action in (1, 2, 3)
+    assert resp.features.total_deposits == 5000
+
+
+def test_score_batch_rpc(risk_server):
+    _, stub, *_ = risk_server
+    reqs = [
+        risk_pb2.ScoreTransactionRequest(account_id=f"b{i}", amount=1000, transaction_type="bet")
+        for i in range(10)
+    ]
+    resp = stub.ScoreBatch(risk_pb2.ScoreBatchRequest(transactions=reqs))
+    assert len(resp.results) == 10
+
+
+def test_blacklist_rpcs(risk_server):
+    _, stub, *_ = risk_server
+    add = stub.AddToBlacklist(risk_pb2.AddToBlacklistRequest(type="device", value="bad-dev"))
+    assert add.success
+    chk = stub.CheckBlacklist(risk_pb2.CheckBlacklistRequest(device_id="bad-dev"))
+    assert chk.is_blacklisted
+    # scoring picks it up
+    resp = stub.ScoreTransaction(risk_pb2.ScoreTransactionRequest(
+        account_id="bl-acct", amount=100, transaction_type="bet", device_id="bad-dev",
+    ))
+    assert "KNOWN_FRAUDSTER" in list(resp.reason_codes)
+
+
+def test_thresholds_rpcs(risk_server):
+    engine, stub, *_ = risk_server
+    old = stub.GetThresholds(risk_pb2.GetThresholdsRequest())
+    upd = stub.UpdateThresholds(risk_pb2.UpdateThresholdsRequest(block_threshold=90, review_threshold=60))
+    assert upd.success
+    now = stub.GetThresholds(risk_pb2.GetThresholdsRequest())
+    assert (now.block_threshold, now.review_threshold) == (90, 60)
+    stub.UpdateThresholds(risk_pb2.UpdateThresholdsRequest(
+        block_threshold=old.block_threshold, review_threshold=old.review_threshold))
+
+
+def test_predict_ltv_rpc(risk_server):
+    _, stub, *_ = risk_server
+    resp = stub.PredictLTV(risk_pb2.PredictLTVRequest(account_id="ltv-acct"))
+    assert resp.segment in range(6)
+    assert 0 <= resp.churn_risk <= 1
+    assert resp.next_best_action
+
+
+def test_bonus_abuse_rpc(risk_server):
+    engine, stub, *_ = risk_server
+    engine.update_features(TransactionEvent("abuser", 100, "deposit"))
+    for _ in range(5):
+        engine.features.record_bonus_claim("abuser", 0.05)
+    resp = stub.CheckBonusAbuse(risk_pb2.CheckBonusAbuseRequest(account_id="abuser"))
+    assert resp.is_abuser
+    assert "BONUS_ONLY_PLAYER" in list(resp.signals)
+
+
+def test_get_features_rpc(risk_server):
+    engine, stub, *_ = risk_server
+    engine.update_features(TransactionEvent("feat-acct", 7000, "deposit"))
+    resp = stub.GetFeatures(risk_pb2.GetFeaturesRequest(account_id="feat-acct"))
+    assert resp.features.total_deposits == 7000
+
+
+def test_graceful_stop_flips_health():
+    engine = TPUScoringEngine(batcher_config=BatcherConfig(batch_size=32, max_wait_ms=1), warmup=False)
+    service = RiskGrpcService(engine)
+    server, health, port = serve_risk(service, 0)
+    from igaming_platform_tpu.serve.grpc_server import health_pb2
+
+    channel = grpc.insecure_channel(f"localhost:{port}")
+    stub = make_health_stub(channel)
+    assert stub.Check(health_pb2.HealthCheckRequest()).status == SERVING
+    health.set_all_not_serving()
+    assert stub.Check(health_pb2.HealthCheckRequest()).status == NOT_SERVING
+    channel.close()
+    server.stop(0)
+    engine.close()
+
+
+# -- wallet over gRPC with the TPU risk gate in-process ----------------------
+
+
+@pytest.fixture(scope="module")
+def wallet_server():
+    engine = TPUScoringEngine(batcher_config=BatcherConfig(batch_size=32, max_wait_ms=1))
+    wallet = WalletService(
+        InMemoryAccountRepository(),
+        InMemoryTransactionRepository(),
+        InMemoryLedgerRepository(),
+        risk=InProcessRiskGate(engine),
+    )
+    server, health, port = serve_wallet(WalletGrpcService(wallet), 0)
+    channel = grpc.insecure_channel(f"localhost:{port}")
+    yield make_wallet_stub(channel), engine
+    channel.close()
+    server.stop(0)
+    engine.close()
+
+
+def test_wallet_full_flow_over_grpc(wallet_server):
+    stub, _ = wallet_server
+    acct = stub.CreateAccount(wallet_pb2.CreateAccountRequest(player_id="wp1", currency="USD")).account
+    dep = stub.Deposit(wallet_pb2.DepositRequest(
+        account_id=acct.id, amount=10_000, idempotency_key="d1", ip_address="1.1.1.1",
+    ))
+    assert dep.new_balance == 10_000
+    assert dep.transaction.status == "completed"
+
+    bet = stub.Bet(wallet_pb2.BetRequest(
+        account_id=acct.id, amount=3_000, idempotency_key="b1", game_id="g1", round_id="r1",
+    ))
+    assert bet.new_balance == 7_000
+    assert bet.real_deducted == 3_000 and bet.bonus_deducted == 0
+
+    win = stub.Win(wallet_pb2.WinRequest(
+        account_id=acct.id, amount=1_000, idempotency_key="w1",
+        game_id="g1", round_id="r1", bet_transaction_id=bet.transaction.id,
+    ))
+    assert win.new_balance == 8_000
+
+    bal = stub.GetBalance(wallet_pb2.GetBalanceRequest(account_id=acct.id))
+    assert bal.balance == 8_000 and bal.withdrawable == 8_000
+
+    hist = stub.GetTransactionHistory(wallet_pb2.GetTransactionHistoryRequest(account_id=acct.id))
+    assert len(hist.transactions) == 3
+
+
+def test_wallet_error_mapping(wallet_server):
+    stub, _ = wallet_server
+    acct = stub.CreateAccount(wallet_pb2.CreateAccountRequest(player_id="wp2")).account
+    with pytest.raises(grpc.RpcError) as exc_info:
+        stub.Withdraw(wallet_pb2.WithdrawRequest(
+            account_id=acct.id, amount=5_000, idempotency_key="wd1",
+        ))
+    assert exc_info.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+    assert "INSUFFICIENT_BALANCE" in exc_info.value.details()
+
+    with pytest.raises(grpc.RpcError) as exc_info:
+        stub.GetBalance(wallet_pb2.GetBalanceRequest(account_id="nonexistent"))
+    assert exc_info.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_wallet_get_account_by_player(wallet_server):
+    stub, _ = wallet_server
+    stub.CreateAccount(wallet_pb2.CreateAccountRequest(player_id="wp3"))
+    got = stub.GetAccount(wallet_pb2.GetAccountRequest(player_id="wp3"))
+    assert got.account.player_id == "wp3"
+
+
+def test_wallet_idempotent_deposit_over_grpc(wallet_server):
+    stub, _ = wallet_server
+    acct = stub.CreateAccount(wallet_pb2.CreateAccountRequest(player_id="wp4")).account
+    r1 = stub.Deposit(wallet_pb2.DepositRequest(account_id=acct.id, amount=500, idempotency_key="k"))
+    r2 = stub.Deposit(wallet_pb2.DepositRequest(account_id=acct.id, amount=500, idempotency_key="k"))
+    assert r1.transaction.id == r2.transaction.id
+    bal = stub.GetBalance(wallet_pb2.GetBalanceRequest(account_id=acct.id))
+    assert bal.balance == 500
