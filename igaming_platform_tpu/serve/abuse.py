@@ -1,0 +1,128 @@
+"""Bonus-abuse detection service — sequence model over event histories.
+
+Upgrades the reference's scalar abuse heuristics (engine.go:462-466,
+bonus_engine.go:268-275) to the sequence detector BASELINE.json config 3
+requires: per-player event histories are kept in fixed-size ring buffers,
+encoded with models.sequence.encode_event, and scored in fixed-shape
+[B, S, E] batches by the transformer (ring/Ulysses-shardable for long
+histories). Device-sharing graph linking covers the MULTI_ACCOUNT signal
+(risk.proto reason codes).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import jax
+import numpy as np
+
+from igaming_platform_tpu.models.sequence import (
+    EVENT_DIM,
+    SeqConfig,
+    abuse_signals,
+    encode_event,
+    init_sequence_model,
+    sequence_forward,
+)
+
+
+class SequenceAbuseDetector:
+    """Per-account event history + transformer scoring + device linking."""
+
+    def __init__(
+        self,
+        params=None,
+        cfg: SeqConfig | None = None,
+        *,
+        max_history: int = 256,
+        mesh=None,
+        seq_mode: str = "dense",
+        threshold: float = 0.5,
+    ):
+        self.cfg = cfg or SeqConfig(d_model=64, n_heads=8, n_layers=2, d_ff=128)
+        self.params = params if params is not None else init_sequence_model(
+            jax.random.key(0), self.cfg
+        )
+        self.max_history = max_history
+        self.threshold = threshold
+        self._histories: dict[str, deque] = {}
+        self._last_ts: dict[str, float] = {}
+        self._device_accounts: dict[str, set[str]] = {}
+        self._account_devices: dict[str, set[str]] = {}
+        self._lock = threading.RLock()
+
+        mode = seq_mode if mesh is not None else "dense"
+        self._fn = jax.jit(
+            lambda p, x: sequence_forward(p, x, self.cfg, mesh=mesh, seq_mode=mode)["abuse"]
+        )
+
+    # -- ingestion -----------------------------------------------------------
+
+    def record_event(
+        self, account_id: str, amount: int, tx_type: str,
+        game_weight: float = 1.0, balance_ratio: float = 0.0,
+        device_id: str = "", timestamp: float | None = None,
+    ) -> None:
+        now = timestamp or time.time()
+        with self._lock:
+            dt = now - self._last_ts.get(account_id, now)
+            self._last_ts[account_id] = now
+            hist = self._histories.setdefault(account_id, deque(maxlen=self.max_history))
+            hist.append(encode_event(amount, dt, tx_type, game_weight, balance_ratio))
+            if device_id:
+                self._device_accounts.setdefault(device_id, set()).add(account_id)
+                self._account_devices.setdefault(account_id, set()).add(device_id)
+
+    def history_length(self, account_id: str) -> int:
+        with self._lock:
+            return len(self._histories.get(account_id, ()))
+
+    # -- scoring -------------------------------------------------------------
+
+    def _history_matrix(self, account_ids: list[str], seq_len: int) -> np.ndarray:
+        x = np.zeros((len(account_ids), seq_len, EVENT_DIM), dtype=np.float32)
+        with self._lock:
+            for i, acct in enumerate(account_ids):
+                hist = self._histories.get(acct)
+                if not hist:
+                    continue
+                events = list(hist)[-seq_len:]
+                x[i, -len(events):] = np.stack(events)  # right-aligned, left-padded
+        return x
+
+    def check(self, account_id: str, bonus_id: str = "") -> tuple[float, list[str], list[str]]:
+        """(abuse_score, signals, linked_accounts) — the CheckBonusAbuse
+        contract (risk.proto:140-145)."""
+        scores = self.check_batch([account_id])
+        score = float(scores[0])
+        signals = abuse_signals(score, self.threshold)
+        linked = self.linked_accounts(account_id)
+        if linked:
+            signals.append("MULTI_ACCOUNT")
+        return score, signals, linked
+
+    def check_batch(self, account_ids: list[str], seq_len: int | None = None) -> np.ndarray:
+        seq_len = seq_len or min(self.max_history, 64)
+        x = self._history_matrix(account_ids, seq_len)
+        return np.asarray(self._fn(self.params, x))
+
+    def is_abuser(self, account_id: str) -> bool:
+        """BonusEngine RiskChecker seam (bonus_engine.go:139-141)."""
+        score, _, _ = self.check(account_id)
+        return score >= self.threshold
+
+    # -- linking -------------------------------------------------------------
+
+    def linked_accounts(self, account_id: str) -> list[str]:
+        """Accounts sharing any device with this one (MULTI_ACCOUNT)."""
+        with self._lock:
+            linked: set[str] = set()
+            for device in self._account_devices.get(account_id, ()):
+                linked |= self._device_accounts.get(device, set())
+            linked.discard(account_id)
+            return sorted(linked)
+
+    def swap_params(self, params) -> None:
+        self.params = params

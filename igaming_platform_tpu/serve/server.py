@@ -1,0 +1,176 @@
+"""Risk service process layer — the main() of the TPU scorer.
+
+Equivalent of /root/reference/services/risk/cmd/main.go:72-258 rebuilt for
+the TPU stack: env config -> engine construction -> AOT warm-up -> gRPC
+server + health SERVING -> HTTP sidecar (/metrics, /health, /ready,
+/debug/thresholds, /debug/score) -> event-consumer bridge -> signal-driven
+graceful shutdown (health NOT_SERVING -> drain -> stop). The reference's
+commented-out wiring (main.go:98-130) is implemented, not stubbed.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from igaming_platform_tpu.core.config import RiskServiceConfig
+from igaming_platform_tpu.obs.metrics import ServiceMetrics
+from igaming_platform_tpu.serve.abuse import SequenceAbuseDetector
+from igaming_platform_tpu.serve.bridge import ScoringBridge
+from igaming_platform_tpu.serve.events import InMemoryBroker, default_broker
+from igaming_platform_tpu.serve.grpc_server import (
+    RiskGrpcService,
+    graceful_stop,
+    serve_risk,
+)
+from igaming_platform_tpu.serve.scorer import ScoreRequest, TPUScoringEngine
+
+logger = logging.getLogger(__name__)
+
+
+class RiskServer:
+    """Assembled risk service: TPU engine + gRPC + HTTP sidecar + bridge."""
+
+    def __init__(
+        self,
+        config: RiskServiceConfig | None = None,
+        *,
+        ml_backend: str = "mock",
+        params=None,
+        mesh=None,
+        broker: InMemoryBroker | None = None,
+        grpc_port: int | None = None,
+        http_port: int | None = None,
+    ):
+        self.config = config or RiskServiceConfig.from_env()
+        self.metrics = ServiceMetrics("risk")
+
+        # Engine (AOT warm-up happens in the constructor, before SERVING).
+        self.engine = TPUScoringEngine(
+            self.config.scoring,
+            ml_backend=ml_backend,
+            params=params,
+            mesh=mesh,
+            batcher_config=self.config.batcher,
+        )
+        self.abuse = SequenceAbuseDetector()
+        self.broker = broker or default_broker()
+        self.bridge = ScoringBridge(self.engine, self.broker, abuse_detector=self.abuse)
+
+        service = RiskGrpcService(
+            self.engine,
+            abuse_detector=lambda acct, bonus: self.abuse.check(acct, bonus),
+            metrics=self.metrics,
+        )
+        self.grpc_server, self.health, self.grpc_port = serve_risk(
+            service, grpc_port if grpc_port is not None else self.config.grpc_port
+        )
+        self.http_server, self.http_port = self._start_http(
+            http_port if http_port is not None else self.config.http_port
+        )
+        self.bridge.start()
+        self._stopped = threading.Event()
+        logger.info("risk server up: grpc=%d http=%d", self.grpc_port, self.http_port)
+
+    # -- HTTP sidecar (main.go:160-202 equivalent) ---------------------------
+
+    def _start_http(self, port: int):
+        server_ref = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _send(self, code: int, body: str, content_type: str = "application/json"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    self._send(200, server_ref.metrics.registry.render_text(), "text/plain")
+                elif self.path == "/health":
+                    self._send(200, '{"status":"healthy"}')
+                elif self.path == "/ready":
+                    ready = not server_ref._stopped.is_set()
+                    self._send(200 if ready else 503, json.dumps({"ready": ready}))
+                elif self.path == "/debug/thresholds":
+                    block, review = server_ref.engine.get_thresholds()
+                    self._send(200, json.dumps({"block": block, "review": review}))
+                else:
+                    self._send(404, '{"error":"not found"}')
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length).decode() if length else "{}"
+                try:
+                    payload = json.loads(raw)
+                except json.JSONDecodeError:
+                    self._send(400, '{"error":"bad json"}')
+                    return
+                if self.path == "/debug/thresholds":
+                    server_ref.engine.set_thresholds(
+                        int(payload.get("block", 80)), int(payload.get("review", 50))
+                    )
+                    self._send(200, '{"ok":true}')
+                elif self.path == "/debug/score":
+                    resp = server_ref.engine.score(ScoreRequest(
+                        account_id=str(payload.get("account_id", "debug")),
+                        amount=int(payload.get("amount", 0)),
+                        tx_type=str(payload.get("transaction_type", "deposit")),
+                        ip=str(payload.get("ip", "")),
+                        device_id=str(payload.get("device_id", "")),
+                    ))
+                    self._send(200, json.dumps({
+                        "score": resp.score,
+                        "action": resp.action,
+                        "reasons": [r.value for r in resp.reason_codes],
+                        "rule_score": resp.rule_score,
+                        "ml_score": resp.ml_score,
+                        "response_time_ms": resp.response_time_ms,
+                    }))
+                else:
+                    self._send(404, '{"error":"not found"}')
+
+        httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        thread = threading.Thread(target=httpd.serve_forever, name="http-sidecar", daemon=True)
+        thread.start()
+        return httpd, httpd.server_address[1]
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def shutdown(self, grace: float = 30.0) -> None:
+        """NOT_SERVING -> stop bridge -> drain gRPC -> stop HTTP."""
+        self._stopped.set()
+        self.bridge.stop()
+        graceful_stop(self.grpc_server, self.health, grace)
+        self.http_server.shutdown()
+        self.engine.close()
+
+    def wait_for_signal(self) -> None:
+        done = threading.Event()
+
+        def handler(signum, frame):
+            logger.info("signal %d: shutting down", signum)
+            done.set()
+
+        signal.signal(signal.SIGINT, handler)
+        signal.signal(signal.SIGTERM, handler)
+        done.wait()
+        self.shutdown()
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    server = RiskServer()
+    server.wait_for_signal()
+
+
+if __name__ == "__main__":
+    main()
