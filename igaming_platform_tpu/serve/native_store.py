@@ -1,0 +1,232 @@
+"""ctypes bindings for the native (C++) feature store.
+
+`NativeFeatureStore` mirrors the semantic core of
+serve.feature_store.InMemoryFeatureStore (sliding windows, HLL
+cardinalities, TTL'd sums, sessions, batch aggregates) with the per-event
+update and the [B, 30] gather executed in C++ — the host-side hot path of
+the ingest bridge (SURVEY.md §2.2 "native ingest bridge"). Builds on
+demand with g++ (native/build.sh); callers fall back to the Python store
+when the toolchain or .so is unavailable (``native_available()``).
+
+String account ids map to dense indices here; device/IP strings hash to
+stable 64-bit values (blake2b, matching serve.hll).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+import time
+
+import numpy as np
+
+from igaming_platform_tpu.core.features import F, NUM_FEATURES
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native"
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "lib", "libfeature_store.so")
+
+_TX_TYPE_CODES = {"deposit": 0, "withdraw": 1, "bet": 2, "win": 3}
+
+_build_lock = threading.Lock()
+
+
+def _hash64(value: str) -> int:
+    if not value:
+        return 0
+    h = int.from_bytes(hashlib.blake2b(value.encode(), digest_size=8).digest(), "little")
+    return h or 1  # 0 means "absent" on the C side
+
+
+def build_native(force: bool = False) -> str | None:
+    """Compile the shared library if needed; returns its path or None."""
+    with _build_lock:
+        if os.path.exists(_LIB_PATH) and not force:
+            return _LIB_PATH
+        src = os.path.join(_NATIVE_DIR, "feature_store.cpp")
+        if not os.path.exists(src):
+            return None
+        try:
+            subprocess.run(
+                ["sh", os.path.join(_NATIVE_DIR, "build.sh")],
+                check=True, capture_output=True, timeout=120,
+            )
+        except (subprocess.CalledProcessError, subprocess.TimeoutExpired, FileNotFoundError):
+            return None
+        return _LIB_PATH if os.path.exists(_LIB_PATH) else None
+
+
+def _load_lib():
+    path = build_native()
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    lib.fs_create.restype = ctypes.c_void_p
+    lib.fs_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.fs_destroy.argtypes = [ctypes.c_void_p]
+    lib.fs_capacity.restype = ctypes.c_int
+    lib.fs_capacity.argtypes = [ctypes.c_void_p]
+    lib.fs_update.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_double, ctypes.c_int64,
+        ctypes.c_int, ctypes.c_uint64, ctypes.c_uint64,
+    ]
+    lib.fs_record_bonus.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_float]
+    lib.fs_velocity.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_double, ctypes.POINTER(ctypes.c_int)
+    ]
+    lib.fs_fill_rows.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ctypes.c_double,
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+    ]
+    return lib
+
+
+_lib = None
+_lib_attempted = False
+
+
+def native_available() -> bool:
+    global _lib, _lib_attempted
+    if not _lib_attempted:
+        _lib_attempted = True
+        _lib = _load_lib()
+    return _lib is not None
+
+
+class NativeFeatureStore:
+    """C++-backed feature store with the InMemoryFeatureStore interface."""
+
+    def __init__(self, max_accounts: int = 1_000_000, history_capacity: int = 128,
+                 hll_precision: int = 10):
+        if not native_available():
+            raise RuntimeError("native feature store unavailable (g++ build failed)")
+        self._lib = _lib
+        self._handle = self._lib.fs_create(max_accounts, history_capacity, hll_precision)
+        self._ids: dict[str, int] = {}
+        self._ids_lock = threading.Lock()
+        self._max_accounts = max_accounts
+        self._blacklists: dict[str, set[str]] = {"device": set(), "ip": set(), "fingerprint": set()}
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.fs_destroy(handle)
+            self._handle = None
+
+    def _idx(self, account_id: str, create: bool = True) -> int:
+        with self._ids_lock:
+            idx = self._ids.get(account_id)
+            if idx is None and create:
+                if len(self._ids) >= self._max_accounts:
+                    return -1
+                idx = len(self._ids)
+                self._ids[account_id] = idx
+            return -1 if idx is None else idx
+
+    # -- writes -------------------------------------------------------------
+
+    def update(self, event) -> None:
+        idx = self._idx(event.account_id)
+        if idx < 0:
+            return
+        ts = event.timestamp or time.time()
+        self._lib.fs_update(
+            self._handle, idx, ts, int(event.amount),
+            _TX_TYPE_CODES.get(event.tx_type, 4),
+            _hash64(event.device_id), _hash64(event.ip),
+        )
+
+    def record_bonus_claim(self, account_id: str, wager_complete_rate: float | None = None) -> None:
+        idx = self._idx(account_id)
+        if idx >= 0:
+            rate = -1.0 if wager_complete_rate is None else float(wager_complete_rate)
+            self._lib.fs_record_bonus(self._handle, idx, rate)
+
+    # -- reads --------------------------------------------------------------
+
+    def velocity(self, account_id: str, now: float | None = None) -> tuple[int, int, int]:
+        idx = self._idx(account_id, create=False)
+        if idx < 0:
+            return (0, 0, 0)
+        out = (ctypes.c_int * 3)()
+        self._lib.fs_velocity(self._handle, idx, now or time.time(), out)
+        return (out[0], out[1], out[2])
+
+    def check_rate_limit(self, account_id: str, max_per_min: int, max_per_hour: int) -> bool:
+        c1, _, ch = self.velocity(account_id)
+        return c1 >= max_per_min or ch >= max_per_hour
+
+    # -- blacklist (host-side sets; set membership isn't the hot path) ------
+
+    def add_to_blacklist(self, list_type: str, value: str) -> None:
+        if list_type not in self._blacklists:
+            raise ValueError(f"unknown blacklist type: {list_type}")
+        self._blacklists[list_type].add(value)
+
+    def check_blacklist(self, device_id: str = "", fingerprint: str = "", ip: str = "") -> bool:
+        return (
+            (bool(device_id) and device_id in self._blacklists["device"])
+            or (bool(fingerprint) and fingerprint in self._blacklists["fingerprint"])
+            or (bool(ip) and ip in self._blacklists["ip"])
+        )
+
+    # -- batch assembly ------------------------------------------------------
+
+    def fill_row(self, out: np.ndarray, account_id: str, amount: int, tx_type: str,
+                 now: float | None = None) -> None:
+        rows = np.zeros((1, NUM_FEATURES), dtype=np.float32)
+        self._fill(rows, [account_id], [amount], [tx_type], now)
+        out[:] = rows[0]
+
+    def _fill(self, out: np.ndarray, account_ids, amounts, tx_types, now=None) -> None:
+        n = out.shape[0]
+        idxs = np.array([self._idx(a, create=False) for a in account_ids], dtype=np.int32)
+        amts = np.asarray(amounts, dtype=np.int64)
+        types = np.array([_TX_TYPE_CODES.get(t, 4) for t in tx_types], dtype=np.int32)
+        self._lib.fs_fill_rows(self._handle, n, idxs, amts, types, now or time.time(), out)
+
+    def gather_batch(self, requests, now: float | None = None):
+        reqs = list(requests)
+        x = np.zeros((len(reqs), NUM_FEATURES), dtype=np.float32)
+        self._fill(
+            x,
+            [r.account_id for r in reqs],
+            [r.amount for r in reqs],
+            [r.tx_type for r in reqs],
+            now,
+        )
+        bl = np.zeros((len(reqs),), dtype=bool)
+        for i, r in enumerate(reqs):
+            ip_flags = getattr(r, "ip_flags", None)
+            if ip_flags is not None:
+                x[i, F.IS_VPN] = float(ip_flags[0])
+                x[i, F.IS_PROXY] = float(ip_flags[1])
+                x[i, F.IS_TOR] = float(ip_flags[2])
+            bl[i] = self.check_blacklist(
+                getattr(r, "device_id", ""), getattr(r, "fingerprint", ""), getattr(r, "ip", "")
+            )
+        return x, bl
+
+    def num_accounts(self) -> int:
+        with self._ids_lock:
+            return len(self._ids)
+
+
+def best_feature_store(**kwargs):
+    """Native store when the toolchain allows, Python store otherwise."""
+    if native_available():
+        try:
+            return NativeFeatureStore()
+        except RuntimeError:
+            pass
+    from igaming_platform_tpu.serve.feature_store import InMemoryFeatureStore
+
+    return InMemoryFeatureStore(**kwargs)

@@ -1,0 +1,7 @@
+#!/bin/sh
+# Build the native runtime pieces into native/lib/.
+set -e
+cd "$(dirname "$0")"
+mkdir -p lib
+g++ -O3 -march=native -std=c++17 -shared -fPIC -o lib/libfeature_store.so feature_store.cpp
+echo "built native/lib/libfeature_store.so"

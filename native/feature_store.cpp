@@ -1,0 +1,271 @@
+// Native feature store — the host-side hot path of the TPU scorer.
+//
+// The serving loop's host work is per-event feature updates and the
+// [B, 30] gather that feeds the device (the role Redis plays for the
+// reference via redis_store.go; SURVEY.md §2.2 calls for a native ingest
+// bridge). This C++ core keeps per-account state in flat arrays:
+//
+//   - circular (ts, amount) history per account  -> 1m/5m/1h sliding counts
+//   - HyperLogLog registers per account          -> device/IP cardinality
+//   - int64 aggregates per account               -> ClickHouse-style batch
+//     features (deposits/withdrawals/bets/wins, counts)
+//   - session / last-tx timestamps with the same TTL semantics as the
+//     Redis keys (1h sum TTL, 24h HLL TTL, 30-min sliding session)
+//
+// Exposed as a C ABI for ctypes (no pybind11 in the image). The Python
+// twin (serve/feature_store.py) is the semantic reference; parity is
+// pinned by tests/test_native_store.py.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+constexpr int kNumFeatures = 30;
+
+// Feature indices (core/features.py schema order).
+enum F {
+  TX_COUNT_1M = 0, TX_COUNT_5M, TX_COUNT_1H, TX_SUM_1H, TX_AVG_1H,
+  UNIQUE_DEVICES_24H, UNIQUE_IPS_24H, IP_COUNTRY_CHANGES, DEVICE_AGE_DAYS,
+  ACCOUNT_AGE_DAYS, TOTAL_DEPOSITS, TOTAL_WITHDRAWALS, NET_DEPOSIT,
+  DEPOSIT_COUNT, WITHDRAW_COUNT, TIME_SINCE_LAST_TX, SESSION_DURATION,
+  AVG_BET_SIZE, WIN_RATE, IS_VPN, IS_PROXY, IS_TOR, DISPOSABLE_EMAIL,
+  BONUS_CLAIM_COUNT, BONUS_WAGER_RATE, BONUS_ONLY_PLAYER, TX_AMOUNT,
+  TX_TYPE_DEPOSIT, TX_TYPE_WITHDRAW, TX_TYPE_BET,
+};
+
+enum TxType { TX_DEPOSIT = 0, TX_WITHDRAW = 1, TX_BET = 2, TX_WIN = 3, TX_OTHER = 4 };
+
+constexpr double kSec1m = 60.0, kSec5m = 300.0, kSec1h = 3600.0;
+constexpr double kSessionTtl = 1800.0, kHllTtl = 86400.0;
+
+struct Hll {
+  std::vector<uint8_t> regs;
+  explicit Hll(int precision) : regs(size_t(1) << precision, 0) {}
+
+  void add(uint64_t hash, int p) {
+    const uint64_t idx = hash >> (64 - p);
+    const uint64_t w = hash << p;  // remaining bits, left-aligned
+    // rank = leading zeros of the remaining (64-p)-bit word + 1
+    int rank = w == 0 ? (64 - p + 1) : (__builtin_clzll(w) + 1);
+    if (rank > 64 - p + 1) rank = 64 - p + 1;
+    if (uint8_t(rank) > regs[idx]) regs[idx] = uint8_t(rank);
+  }
+
+  double estimate() const {
+    const size_t m = regs.size();
+    double alpha;
+    if (m >= 128) alpha = 0.7213 / (1.0 + 1.079 / double(m));
+    else if (m == 64) alpha = 0.709;
+    else if (m == 32) alpha = 0.697;
+    else alpha = 0.673;
+    double sum = 0.0;
+    size_t zeros = 0;
+    for (uint8_t r : regs) {
+      sum += 1.0 / double(uint64_t(1) << r);
+      if (r == 0) ++zeros;
+    }
+    double est = alpha * double(m) * double(m) / sum;
+    if (est <= 2.5 * double(m) && zeros > 0) {
+      est = double(m) * std::log(double(m) / double(zeros));
+    }
+    return est;
+  }
+
+  void reset() { std::fill(regs.begin(), regs.end(), 0); }
+};
+
+struct AccountState {
+  // circular history
+  std::vector<double> hist_ts;
+  std::vector<int64_t> hist_amount;
+  int hist_head = 0;   // next write slot
+  int hist_count = 0;  // valid entries
+
+  int64_t sum_1h = 0;
+  double sum_expires_at = 0.0;
+
+  Hll devices;
+  Hll ips;
+  double hll_expires_at = 0.0;
+
+  double last_tx_ts = 0.0;
+  double session_start = 0.0;
+  double session_expires_at = 0.0;
+  double created_at = 0.0;
+  bool initialized = false;
+
+  int64_t total_deposits = 0, total_withdrawals = 0, total_bets = 0, total_wins = 0;
+  int32_t deposit_count = 0, withdraw_count = 0, bet_count = 0, win_count = 0;
+  int32_t bonus_claim_count = 0;
+  float bonus_wager_rate = 0.0f;
+
+  AccountState(int hist_cap, int hll_p)
+      : hist_ts(hist_cap, 0.0), hist_amount(hist_cap, 0), devices(hll_p), ips(hll_p) {}
+};
+
+struct Store {
+  std::vector<AccountState> accounts;
+  std::vector<std::mutex> locks;  // sharded by idx % locks.size()
+  int hist_cap;
+  int hll_p;
+
+  Store(int max_accounts, int hist_capacity, int hll_precision)
+      : locks(64), hist_cap(hist_capacity), hll_p(hll_precision) {
+    accounts.reserve(max_accounts);
+    for (int i = 0; i < max_accounts; ++i) accounts.emplace_back(hist_capacity, hll_precision);
+  }
+
+  std::mutex& lock_for(int idx) { return locks[size_t(idx) % locks.size()]; }
+};
+
+void window_counts(const AccountState& st, double now, int* c1, int* c5, int* ch) {
+  *c1 = *c5 = *ch = 0;
+  for (int i = 0; i < st.hist_count; ++i) {
+    const double ts = st.hist_ts[i];
+    const double age = now - ts;
+    if (age <= kSec1h && age >= 0.0) {
+      ++*ch;
+      if (age <= kSec5m) {
+        ++*c5;
+        if (age <= kSec1m) ++*c1;
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* fs_create(int max_accounts, int history_capacity, int hll_precision) {
+  return new Store(max_accounts, history_capacity, hll_precision);
+}
+
+void fs_destroy(void* handle) { delete static_cast<Store*>(handle); }
+
+int fs_capacity(void* handle) {
+  return int(static_cast<Store*>(handle)->accounts.size());
+}
+
+// One transaction event (UpdateRealTimeFeatures + batch aggregates).
+void fs_update(void* handle, int idx, double ts, int64_t amount, int tx_type,
+               uint64_t device_hash, uint64_t ip_hash) {
+  Store* s = static_cast<Store*>(handle);
+  if (idx < 0 || size_t(idx) >= s->accounts.size()) return;
+  std::lock_guard<std::mutex> g(s->lock_for(idx));
+  AccountState& st = s->accounts[size_t(idx)];
+
+  if (!st.initialized) {
+    st.initialized = true;
+    st.created_at = ts;
+  }
+
+  // circular history (pruning is implicit: reads filter by window)
+  st.hist_ts[size_t(st.hist_head)] = ts;
+  st.hist_amount[size_t(st.hist_head)] = amount;
+  st.hist_head = (st.hist_head + 1) % s->hist_cap;
+  if (st.hist_count < s->hist_cap) ++st.hist_count;
+
+  if (ts > st.sum_expires_at) st.sum_1h = 0;
+  st.sum_1h += amount;
+  st.sum_expires_at = ts + kSec1h;
+
+  if (ts > st.hll_expires_at) {
+    st.devices.reset();
+    st.ips.reset();
+  }
+  st.hll_expires_at = ts + kHllTtl;
+  if (device_hash != 0) st.devices.add(device_hash, s->hll_p);
+  if (ip_hash != 0) st.ips.add(ip_hash, s->hll_p);
+
+  st.last_tx_ts = ts;
+  if (ts > st.session_expires_at) st.session_start = ts;
+  st.session_expires_at = ts + kSessionTtl;
+
+  switch (tx_type) {
+    case TX_DEPOSIT: st.total_deposits += amount; ++st.deposit_count; break;
+    case TX_WITHDRAW: st.total_withdrawals += amount; ++st.withdraw_count; break;
+    case TX_BET: st.total_bets += amount; ++st.bet_count; break;
+    case TX_WIN: st.total_wins += amount; ++st.win_count; break;
+    default: break;
+  }
+}
+
+void fs_record_bonus(void* handle, int idx, float wager_rate) {
+  Store* s = static_cast<Store*>(handle);
+  if (idx < 0 || size_t(idx) >= s->accounts.size()) return;
+  std::lock_guard<std::mutex> g(s->lock_for(idx));
+  AccountState& st = s->accounts[size_t(idx)];
+  if (!st.initialized) { st.initialized = true; st.created_at = 0.0; }
+  ++st.bonus_claim_count;
+  if (wager_rate >= 0.0f) st.bonus_wager_rate = wager_rate;
+}
+
+void fs_velocity(void* handle, int idx, double now, int* out3) {
+  Store* s = static_cast<Store*>(handle);
+  out3[0] = out3[1] = out3[2] = 0;
+  if (idx < 0 || size_t(idx) >= s->accounts.size()) return;
+  std::lock_guard<std::mutex> g(s->lock_for(idx));
+  window_counts(s->accounts[size_t(idx)], now, &out3[0], &out3[1], &out3[2]);
+}
+
+// Fill n rows of a [n, 30] float32 buffer from account state + tx context.
+// account idx < 0 => leave realtime/batch features zero (unknown account).
+void fs_fill_rows(void* handle, int n, const int32_t* idxs, const int64_t* amounts,
+                  const int32_t* tx_types, double now, float* out) {
+  Store* s = static_cast<Store*>(handle);
+  for (int r = 0; r < n; ++r) {
+    float* row = out + size_t(r) * kNumFeatures;
+    std::memset(row, 0, sizeof(float) * kNumFeatures);
+    const int idx = idxs[r];
+    if (idx >= 0 && size_t(idx) < s->accounts.size()) {
+      std::lock_guard<std::mutex> g(s->lock_for(idx));
+      const AccountState& st = s->accounts[size_t(idx)];
+      if (st.initialized) {
+        int c1, c5, ch;
+        window_counts(st, now, &c1, &c5, &ch);
+        row[TX_COUNT_1M] = float(c1);
+        row[TX_COUNT_5M] = float(c5);
+        row[TX_COUNT_1H] = float(ch);
+        const int64_t sum = now <= st.sum_expires_at ? st.sum_1h : 0;
+        row[TX_SUM_1H] = float(sum);
+        row[TX_AVG_1H] = ch > 0 ? float(double(sum) / double(ch)) : 0.0f;
+        if (now <= st.hll_expires_at) {
+          row[UNIQUE_DEVICES_24H] = float(int64_t(st.devices.estimate() + 0.5));
+          row[UNIQUE_IPS_24H] = float(int64_t(st.ips.estimate() + 0.5));
+        }
+        if (st.last_tx_ts > 0.0) row[TIME_SINCE_LAST_TX] = float(now - st.last_tx_ts);
+        if (st.session_start > 0.0 && now <= st.session_expires_at) {
+          row[SESSION_DURATION] = float(now - st.session_start);
+        }
+        row[ACCOUNT_AGE_DAYS] = float((now - st.created_at) / 86400.0);
+        row[TOTAL_DEPOSITS] = float(st.total_deposits);
+        row[TOTAL_WITHDRAWALS] = float(st.total_withdrawals);
+        row[NET_DEPOSIT] = float(st.total_deposits - st.total_withdrawals);
+        row[DEPOSIT_COUNT] = float(st.deposit_count);
+        row[WITHDRAW_COUNT] = float(st.withdraw_count);
+        row[AVG_BET_SIZE] = st.bet_count > 0
+            ? float(double(st.total_bets) / double(st.bet_count)) : 0.0f;
+        row[WIN_RATE] = st.bet_count > 0
+            ? float(double(st.win_count) / double(st.bet_count)) : 0.0f;
+        row[BONUS_CLAIM_COUNT] = float(st.bonus_claim_count);
+        row[BONUS_WAGER_RATE] = st.bonus_wager_rate;
+        if (st.bonus_claim_count > 3 && st.total_deposits < 5000) {
+          row[BONUS_ONLY_PLAYER] = 1.0f;
+        }
+      }
+    }
+    row[TX_AMOUNT] = float(amounts[r]);
+    const int t = tx_types[r];
+    row[TX_TYPE_DEPOSIT] = t == TX_DEPOSIT ? 1.0f : 0.0f;
+    row[TX_TYPE_WITHDRAW] = t == TX_WITHDRAW ? 1.0f : 0.0f;
+    row[TX_TYPE_BET] = t == TX_BET ? 1.0f : 0.0f;
+  }
+}
+
+}  // extern "C"
