@@ -1,0 +1,48 @@
+# igaming-platform-tpu build/test/bench targets.
+
+PY ?= python
+CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: all test test-fast bench bench-all native proto run-risk run-wallet dryrun clean
+
+all: native test
+
+# Full test suite on the virtual 8-device CPU mesh.
+test:
+	$(PY) -m pytest tests/ -q
+
+test-fast:
+	$(PY) -m pytest tests/ -x -q -p no:cacheprovider
+
+# Headline benchmark (driver contract: one JSON line) — real device.
+bench:
+	$(PY) bench.py
+
+# All five BASELINE configs.
+bench-all:
+	$(PY) benchmarks/run_all.py
+
+# Native runtime pieces (C++ feature store).
+native:
+	sh native/build.sh
+
+# Regenerate protobuf code (wire contract under proto/).
+proto:
+	protoc -I proto --python_out=igaming_platform_tpu/proto_gen \
+	  proto/risk/v1/risk.proto proto/wallet/v1/wallet.proto \
+	  proto/grpc/health/v1/health.proto
+
+# Service processes.
+run-risk:
+	$(PY) -m igaming_platform_tpu.serve.server
+
+run-wallet:
+	$(PY) -m igaming_platform_tpu.platform.server
+
+# Multi-chip sharding validation on virtual CPU devices.
+dryrun:
+	$(CPU_ENV) $(PY) __graft_entry__.py
+
+clean:
+	rm -rf native/lib .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -1,0 +1,160 @@
+"""The five BASELINE.json benchmark configs, as callable measurements.
+
+Each function returns a JSON-able dict with a ``metric``/``value``/``unit``
+triple (plus detail fields). `bench.py` at the repo root is the driver's
+headline metric; this module measures the full matrix:
+
+1. single-txn ScoreTransaction latency through the continuous batcher
+   (the ONNX-CPU single-sample baseline path, engine.go:262-323);
+2. batched fraud scoring over a 10k-txn event replay (RabbitMQ trace);
+3. bonus-abuse sequence detection throughput;
+4. LTV batch prediction over a player table;
+5. DP multi-task training throughput.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+
+def config1_single_txn_latency(n_requests: int = 200, batch_size: int = 256) -> dict:
+    from igaming_platform_tpu.core.config import BatcherConfig
+    from igaming_platform_tpu.serve.scorer import ScoreRequest, TPUScoringEngine
+
+    engine = TPUScoringEngine(batcher_config=BatcherConfig(batch_size=batch_size, max_wait_ms=1.0))
+    try:
+        lat = []
+        for i in range(n_requests):
+            t0 = time.perf_counter()
+            engine.score(ScoreRequest(f"acct-{i % 32}", amount=1000 + i, tx_type="deposit"))
+            lat.append((time.perf_counter() - t0) * 1000.0)
+        lat = np.array(lat[10:])  # drop warm-up
+        return {
+            "metric": "single_txn_score_latency_p99_ms",
+            "value": round(float(np.percentile(lat, 99)), 3),
+            "unit": "ms",
+            "p50_ms": round(float(np.percentile(lat, 50)), 3),
+            "requests": int(lat.size),
+        }
+    finally:
+        engine.close()
+
+
+def config2_replay_throughput(n_events: int = 10_000, batch_size: int = 1024) -> dict:
+    from igaming_platform_tpu.core.config import BatcherConfig
+    from igaming_platform_tpu.serve.bridge import ScoringBridge
+    from igaming_platform_tpu.serve.events import default_broker, new_transaction_event
+    from igaming_platform_tpu.serve.scorer import TPUScoringEngine
+
+    rng = np.random.default_rng(0)
+    tx_types = ("deposit", "withdraw", "bet")
+    events = [
+        new_transaction_event("transaction.completed", {
+            "id": f"t{i}",
+            "account_id": f"acct-{int(rng.integers(0, 500))}",
+            "type": tx_types[int(rng.integers(0, 3))],
+            "amount": int(rng.integers(100, 100_000)),
+            "status": "completed",
+        })
+        for i in range(n_events)
+    ]
+
+    engine = TPUScoringEngine(batcher_config=BatcherConfig(batch_size=batch_size, max_wait_ms=1.0))
+    bridge = ScoringBridge(engine, default_broker(), publish_risk_events=False)
+    try:
+        stats = bridge.replay(events, batch_size=batch_size)
+        return {
+            "metric": "replay_fraud_score_txns_per_sec",
+            "value": round(stats["txns_per_sec"], 1),
+            "unit": "txns/s",
+            "events": stats["events_scored"],
+            "blocked": stats["blocked"],
+        }
+    finally:
+        engine.close()
+
+
+def config3_sequence_throughput(batch: int = 64, seq_len: int = 256, iters: int = 20) -> dict:
+    import jax
+
+    from igaming_platform_tpu.models.sequence import (
+        EVENT_DIM,
+        SeqConfig,
+        init_sequence_model,
+        sequence_forward,
+    )
+
+    cfg = SeqConfig(d_model=128, n_heads=8, n_layers=2, d_ff=256)
+    params = init_sequence_model(jax.random.key(0), cfg)
+    fn = jax.jit(lambda p, x: sequence_forward(p, x, cfg)["abuse"])
+    x = np.random.default_rng(0).normal(size=(batch, seq_len, EVENT_DIM)).astype(np.float32)
+    jax.block_until_ready(fn(params, x))
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(params, x)
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+    return {
+        "metric": "abuse_sequences_per_sec",
+        "value": round(batch * iters / elapsed, 1),
+        "unit": "seq/s",
+        "seq_len": seq_len,
+        "batch": batch,
+    }
+
+
+def config4_ltv_batch_throughput(rows: int = 100_000, iters: int = 10) -> dict:
+    import jax
+
+    from igaming_platform_tpu.models.ltv import NUM_LTV_FEATURES, predict_batch_jit
+
+    x = np.random.default_rng(0).random((rows, NUM_LTV_FEATURES)).astype(np.float32) * 100
+    jax.block_until_ready(predict_batch_jit(x))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = predict_batch_jit(x)
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+    return {
+        "metric": "ltv_predictions_per_sec",
+        "value": round(rows * iters / elapsed, 1),
+        "unit": "players/s",
+        "rows": rows,
+    }
+
+
+def config5_training_throughput(steps: int = 30, batch_size: int = 4096) -> dict:
+    from igaming_platform_tpu.train.data import make_stream
+    from igaming_platform_tpu.train.trainer import TrainConfig, Trainer
+
+    cfg = TrainConfig(batch_size=batch_size)
+    trainer = Trainer(cfg)
+    data = make_stream(batch_size, seed=0)
+    trainer.train_step(next(data))  # compile
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        metrics = trainer.train_step(next(data))
+    elapsed = time.perf_counter() - t0
+    return {
+        "metric": "train_samples_per_sec",
+        "value": round(steps * batch_size / elapsed, 1),
+        "unit": "samples/s",
+        "steps_per_sec": round(steps / elapsed, 2),
+        "final_loss": round(metrics["loss"], 4),
+    }
+
+
+ALL_CONFIGS = {
+    "single_txn": config1_single_txn_latency,
+    "replay": config2_replay_throughput,
+    "sequence": config3_sequence_throughput,
+    "ltv": config4_ltv_batch_throughput,
+    "train": config5_training_throughput,
+}

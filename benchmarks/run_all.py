@@ -1,0 +1,26 @@
+"""Run every BASELINE config and print one JSON line per result.
+
+Usage: python benchmarks/run_all.py [config ...]
+Configs: single_txn replay sequence ltv train (default: all).
+"""
+
+import json
+import sys
+
+from configs import ALL_CONFIGS
+
+
+def main() -> None:
+    names = sys.argv[1:] or list(ALL_CONFIGS)
+    for name in names:
+        fn = ALL_CONFIGS.get(name)
+        if fn is None:
+            print(json.dumps({"error": f"unknown config: {name}"}))
+            continue
+        result = fn()
+        result["config"] = name
+        print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

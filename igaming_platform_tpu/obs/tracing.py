@@ -1,0 +1,106 @@
+"""Profiling / tracing hooks.
+
+The reference deploys Jaeger but emits no spans (SURVEY.md §5 "tracing is
+infrastructure-ready, not wired"); per-request latency is hand-measured.
+Here tracing is wired two ways:
+
+- device side: `jax.profiler` trace capture + named step annotations
+  (``annotate``/``step``) that show up on the TPU timeline;
+- host side: lightweight spans (``span``) collected into an in-process
+  buffer exportable as JSON — the OTLP-shaped record without requiring an
+  OTLP endpoint in the image.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+
+import jax
+
+
+@dataclass
+class Span:
+    name: str
+    start: float
+    end: float = 0.0
+    trace_id: str = ""
+    attributes: dict = field(default_factory=dict)
+
+    @property
+    def duration_ms(self) -> float:
+        return (self.end - self.start) * 1000.0
+
+
+class SpanCollector:
+    """In-process span buffer (bounded ring)."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = capacity
+        self._spans: list[Span] = []
+        self._lock = threading.Lock()
+
+    def add(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+            if len(self._spans) > self.capacity:
+                self._spans = self._spans[-self.capacity:]
+
+    def drain(self) -> list[Span]:
+        with self._lock:
+            out, self._spans = self._spans, []
+            return out
+
+    def to_json(self) -> str:
+        with self._lock:
+            return json.dumps([
+                {
+                    "name": s.name,
+                    "trace_id": s.trace_id,
+                    "start_unix_s": s.start,
+                    "duration_ms": s.duration_ms,
+                    "attributes": s.attributes,
+                }
+                for s in self._spans
+            ])
+
+
+DEFAULT_COLLECTOR = SpanCollector()
+
+
+@contextlib.contextmanager
+def span(name: str, collector: SpanCollector | None = None, **attributes):
+    """Host-side span around gather -> transfer -> compute stages."""
+    collector = collector or DEFAULT_COLLECTOR
+    s = Span(name=name, start=time.time(), trace_id=uuid.uuid4().hex[:16], attributes=attributes)
+    try:
+        yield s
+    finally:
+        s.end = time.time()
+        collector.add(s)
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region on the device profile timeline."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def step(name: str, step_num: int):
+    """Training-step marker (shows as steps in the profiler UI)."""
+    return jax.profiler.StepTraceAnnotation(name, step_num=step_num)
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """Capture a jax.profiler trace (TensorBoard-compatible) for a block."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,101 @@
+"""Pallas TPU kernel: fused oblivious-forest inference per batch tile.
+
+One kernel invocation per [TILE_B, F] batch tile does the whole forest in
+VMEM — selector matmul (MXU), threshold compares, leaf-index reduction and
+leaf-value contraction (VPU) — with no intermediate HBM round-trips. The
+XLA fallback (`ops/gbdt_matmul.py`) materialises [B, T*D] and [B, T, 2^D]
+intermediates in HBM between fusions; here they never leave VMEM.
+
+Follows the pallas_guide tiling rules: tiles padded to (8, 128) multiples
+for float32; grid over the batch dimension; params replicated to every
+grid step via constant index maps. Falls back to interpret mode off-TPU
+(tests run it on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from igaming_platform_tpu.ops.gbdt_matmul import precompute_selector
+
+DEFAULT_TILE_B = 256
+
+
+def _kernel(x_ref, sel_ref, thr_ref, pows_ref, leaves_ref, bias_ref, out_ref, *, n_trees, depth, n_leaves):
+    x = x_ref[...]  # [TB, F]
+    sel = sel_ref[...]  # [F, T*D]
+    gathered = jnp.dot(x, sel, preferred_element_type=jnp.float32)  # [TB, T*D] (MXU)
+    gathered = gathered.reshape(x.shape[0], n_trees, depth)
+
+    bits = (gathered > thr_ref[...][None]).astype(jnp.float32)  # [TB, T, D]
+    leaf_idx = jnp.sum(bits * pows_ref[...][None, None, :], axis=-1)  # [TB, T] float
+
+    leaf_ids = jax.lax.broadcasted_iota(jnp.float32, (1, 1, n_leaves), 2)
+    onehot = (leaf_idx[:, :, None] == leaf_ids).astype(jnp.float32)  # [TB, T, L]
+    vals = jnp.sum(onehot * leaves_ref[...][None], axis=(1, 2))  # [TB]
+    out_ref[...] = vals + bias_ref[0, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("tile_b", "interpret"))
+def _run(x, sel, thr, pows, leaves, bias, *, tile_b, interpret):
+    b, f = x.shape
+    n_trees, depth = thr.shape
+    n_leaves = leaves.shape[1]
+    grid = (b // tile_b,)
+
+    kernel = functools.partial(_kernel, n_trees=n_trees, depth=depth, n_leaves=n_leaves)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b,), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_b, f), lambda i: (i, 0)),
+            pl.BlockSpec((f, n_trees * depth), lambda i: (0, 0)),
+            pl.BlockSpec((n_trees, depth), lambda i: (0, 0)),
+            pl.BlockSpec((depth,), lambda i: (0,)),
+            pl.BlockSpec((n_trees, n_leaves), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile_b,), lambda i: (i,)),
+        interpret=interpret,
+    )(x, sel, thr, pows, leaves, bias)
+
+
+def gbdt_raw_pallas(
+    params: dict,
+    x: jnp.ndarray,
+    *,
+    sel: jnp.ndarray | None = None,
+    tile_b: int = DEFAULT_TILE_B,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """[B, F] -> [B] raw margins via the fused Pallas kernel.
+
+    B must be a multiple of ``tile_b`` (the serving batcher always pads to
+    the compiled size, so this holds on the hot path).
+    """
+    x = jnp.asarray(x, jnp.float32)
+    b, f = x.shape
+    if b % tile_b != 0:
+        if b < tile_b:
+            tile_b = max(8, 1 << (b.bit_length() - 1)) if b >= 8 else 8
+            if b % tile_b != 0:
+                raise ValueError(f"batch {b} not tileable by {tile_b}")
+        else:
+            raise ValueError(f"batch {b} not a multiple of tile {tile_b}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if sel is None:
+        sel = jnp.asarray(precompute_selector(np.asarray(params["feat"]), f))
+
+    thr = jnp.asarray(params["thr"], jnp.float32)
+    depth = thr.shape[1]
+    pows = jnp.asarray([float(1 << d) for d in range(depth)], jnp.float32)
+    leaves = jnp.asarray(params["leaves"], jnp.float32)
+    bias = jnp.asarray(params["bias"], jnp.float32).reshape(1, 1)
+    return _run(x, sel, thr, pows, leaves, bias, tile_b=tile_b, interpret=interpret)

@@ -1,0 +1,149 @@
+"""Wallet service process layer.
+
+Equivalent of /root/reference/services/wallet/cmd/main.go:66-230: config ->
+repositories (SQLite or in-memory) -> risk gate (in-process TPU engine or
+risk.v1 gRPC client) -> wallet service -> gRPC server + health -> HTTP
+sidecar (/metrics, /health, /ready) -> graceful shutdown. The reference's
+commented-out service wiring (main.go:112-134) is implemented.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from igaming_platform_tpu.core.config import WalletServiceConfig
+from igaming_platform_tpu.obs.metrics import ServiceMetrics
+from igaming_platform_tpu.platform.repository import (
+    InMemoryAccountRepository,
+    InMemoryLedgerRepository,
+    InMemoryTransactionRepository,
+    SQLiteStore,
+)
+from igaming_platform_tpu.platform.wallet import WalletConfig, WalletService
+from igaming_platform_tpu.serve.events import InMemoryBroker, Publisher, default_broker
+from igaming_platform_tpu.serve.grpc_server import (
+    WalletGrpcService,
+    graceful_stop,
+    serve_wallet,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class WalletServer:
+    def __init__(
+        self,
+        config: WalletServiceConfig | None = None,
+        *,
+        risk_gate=None,
+        broker: InMemoryBroker | None = None,
+        grpc_port: int | None = None,
+        http_port: int | None = None,
+    ):
+        self.config = config or WalletServiceConfig.from_env()
+        self.metrics = ServiceMetrics("wallet")
+        self.broker = broker or default_broker()
+
+        url = self.config.database_url
+        if url.startswith("sqlite://") and url != "sqlite://:memory:":
+            self.store = SQLiteStore(url.removeprefix("sqlite://"))
+            accounts, transactions, ledger = (
+                self.store.accounts, self.store.transactions, self.store.ledger
+            )
+        elif url == "sqlite://:memory:":
+            self.store = SQLiteStore()
+            accounts, transactions, ledger = (
+                self.store.accounts, self.store.transactions, self.store.ledger
+            )
+        else:
+            self.store = None
+            accounts = InMemoryAccountRepository()
+            transactions = InMemoryTransactionRepository()
+            ledger = InMemoryLedgerRepository()
+
+        if risk_gate is None and self.config.risk_service_addr:
+            from igaming_platform_tpu.platform.risk_adapter import GrpcRiskGate
+
+            risk_gate = GrpcRiskGate(self.config.risk_service_addr)
+
+        self.wallet = WalletService(
+            accounts, transactions, ledger,
+            events=Publisher(self.broker),
+            risk=risk_gate,
+            config=WalletConfig(
+                risk_threshold_block=self.config.risk_threshold_block,
+                risk_threshold_review=self.config.risk_threshold_review,
+            ),
+        )
+        self.grpc_server, self.health, self.grpc_port = serve_wallet(
+            WalletGrpcService(self.wallet, metrics=self.metrics),
+            grpc_port if grpc_port is not None else self.config.grpc_port,
+        )
+        self.http_server, self.http_port = self._start_http(
+            http_port if http_port is not None else self.config.http_port
+        )
+        self._stopped = threading.Event()
+        logger.info("wallet server up: grpc=%d http=%d", self.grpc_port, self.http_port)
+
+    def _start_http(self, port: int):
+        server_ref = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _send(self, code: int, body: str, content_type: str = "application/json"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    self._send(200, server_ref.metrics.registry.render_text(), "text/plain")
+                elif self.path == "/health":
+                    self._send(200, '{"status":"healthy"}')
+                elif self.path == "/ready":
+                    ready = not server_ref._stopped.is_set()
+                    self._send(200 if ready else 503, json.dumps({"ready": ready}))
+                else:
+                    self._send(404, '{"error":"not found"}')
+
+        httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        threading.Thread(target=httpd.serve_forever, name="wallet-http", daemon=True).start()
+        return httpd, httpd.server_address[1]
+
+    def shutdown(self, grace: float = 30.0) -> None:
+        self._stopped.set()
+        graceful_stop(self.grpc_server, self.health, grace)
+        self.http_server.shutdown()
+        if self.store is not None:
+            self.store.close()
+
+    def wait_for_signal(self) -> None:
+        done = threading.Event()
+
+        def handler(signum, frame):
+            logger.info("signal %d: shutting down", signum)
+            done.set()
+
+        signal.signal(signal.SIGINT, handler)
+        signal.signal(signal.SIGTERM, handler)
+        done.wait()
+        self.shutdown()
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    server = WalletServer()
+    server.wait_for_signal()
+
+
+if __name__ == "__main__":
+    main()

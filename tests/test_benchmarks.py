@@ -1,0 +1,40 @@
+"""Keep the benchmark configs executable (tiny sizes, CPU)."""
+
+import sys
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "benchmarks"))
+
+from configs import (  # noqa: E402
+    config1_single_txn_latency,
+    config2_replay_throughput,
+    config3_sequence_throughput,
+    config4_ltv_batch_throughput,
+    config5_training_throughput,
+)
+
+
+def test_config1_runs():
+    r = config1_single_txn_latency(n_requests=30, batch_size=32)
+    assert r["value"] > 0 and r["unit"] == "ms"
+
+
+def test_config2_runs():
+    r = config2_replay_throughput(n_events=300, batch_size=64)
+    assert r["events"] == 300
+    assert r["value"] > 0
+
+
+def test_config3_runs():
+    r = config3_sequence_throughput(batch=4, seq_len=32, iters=2)
+    assert r["value"] > 0
+
+
+def test_config4_runs():
+    r = config4_ltv_batch_throughput(rows=1000, iters=2)
+    assert r["value"] > 0
+
+
+def test_config5_runs():
+    r = config5_training_throughput(steps=3, batch_size=128)
+    assert r["value"] > 0
