@@ -1,0 +1,174 @@
+"""Single-binary platform app: Wallet + Bonus + TPU Risk wired end-to-end.
+
+The reference deploys three processes coupled by gRPC + RabbitMQ
+(README.md:19-36 topology); this app composes the same topology in one
+process for development, integration tests, and the replay benchmarks:
+
+- wallet ops risk-gate through the TPU engine (in-process);
+- bet placement enforces bonus max-bet limits (the coupling the reference
+  documents but never wires — SURVEY.md §3.2);
+- completed transactions flow over the event broker into the scoring
+  bridge (feature updates + abuse histories) and the bonus processor
+  (wagering progress);
+- the bonus award path runs the abuse gate against the sequence detector.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
+from igaming_platform_tpu.core.enums import QUEUE_BONUS_PROCESSOR, EventType
+from igaming_platform_tpu.platform.bonus import (
+    BonusEngine,
+    MaxBetExceededError,
+)
+from igaming_platform_tpu.platform.domain import BonusRestrictionError
+from igaming_platform_tpu.platform.repository import (
+    InMemoryAccountRepository,
+    InMemoryLedgerRepository,
+    InMemoryTransactionRepository,
+    SQLiteStore,
+)
+from igaming_platform_tpu.platform.risk_adapter import InProcessRiskGate
+from igaming_platform_tpu.platform.wallet import WalletConfig, WalletService
+from igaming_platform_tpu.serve.abuse import SequenceAbuseDetector
+from igaming_platform_tpu.serve.bridge import ScoringBridge
+from igaming_platform_tpu.serve.events import Consumer, Event, Publisher, default_broker
+from igaming_platform_tpu.serve.scorer import TPUScoringEngine
+
+DEFAULT_RULES = "igaming_platform_tpu/platform/configs/bonus_rules.yaml"
+
+
+@dataclass
+class AppConfig:
+    bonus_rules_path: str = DEFAULT_RULES
+    sqlite_path: str = ""  # empty = in-memory repositories
+    scoring: ScoringConfig = None  # type: ignore[assignment]
+    batch_size: int = 256
+
+    def __post_init__(self):
+        if self.scoring is None:
+            self.scoring = ScoringConfig()
+
+
+class PlatformApp:
+    def __init__(self, config: AppConfig | None = None, *, ml_backend: str = "mock", params=None):
+        self.config = config or AppConfig()
+        self.broker = default_broker()
+
+        # Risk: TPU engine + sequence abuse detector.
+        self.engine = TPUScoringEngine(
+            self.config.scoring,
+            ml_backend=ml_backend,
+            params=params,
+            batcher_config=BatcherConfig(batch_size=self.config.batch_size, max_wait_ms=1.0),
+        )
+        self.abuse = SequenceAbuseDetector()
+        self.risk_gate = InProcessRiskGate(self.engine)
+        self.bridge = ScoringBridge(self.engine, self.broker, abuse_detector=self.abuse)
+
+        # Wallet.
+        if self.config.sqlite_path:
+            self.store = SQLiteStore(self.config.sqlite_path)
+            accounts, transactions, ledger = (
+                self.store.accounts, self.store.transactions, self.store.ledger
+            )
+        else:
+            self.store = None
+            accounts = InMemoryAccountRepository()
+            transactions = InMemoryTransactionRepository()
+            ledger = InMemoryLedgerRepository()
+        self.wallet = WalletService(
+            accounts, transactions, ledger,
+            events=Publisher(self.broker),
+            risk=self.risk_gate,
+            config=WalletConfig(
+                risk_threshold_block=self.config.scoring.block_threshold,
+                risk_threshold_review=self.config.scoring.review_threshold,
+            ),
+        )
+
+        # Bonus: abuse gate via the sequence detector, player data from the
+        # feature store.
+        self.bonus = BonusEngine(
+            self.config.bonus_rules_path,
+            risk_checker=self.abuse.is_abuser,
+            player_data=self._player_info,
+        )
+        self._bonus_consumer = Consumer(self.broker)
+        self._bonus_consumer.subscribe(QUEUE_BONUS_PROCESSOR, self._on_wallet_event)
+
+    # -- wiring --------------------------------------------------------------
+
+    def _player_info(self, account_id: str):
+        import numpy as np
+
+        from igaming_platform_tpu.core.features import F, NUM_FEATURES
+        from igaming_platform_tpu.platform.bonus import PlayerInfo
+
+        row = np.zeros(NUM_FEATURES, dtype=np.float32)
+        self.engine.features.fill_row(row, account_id, 0, "bet")
+        return PlayerInfo(
+            account_id=account_id,
+            account_age_days=int(row[F.ACCOUNT_AGE_DAYS]),
+            total_deposits=int(row[F.DEPOSIT_COUNT]),
+            total_bonus_claims=int(row[F.BONUS_CLAIM_COUNT]),
+        )
+
+    def _on_wallet_event(self, event: Event) -> None:
+        """Bonus processor: bets drive wagering progress (the bet.placed ->
+        bonus.processor coupling, SURVEY.md §3.2)."""
+        if event.type != EventType.TRANSACTION_COMPLETED.value:
+            return
+        if event.data.get("type") != "bet":
+            return
+        account_id = str(event.data.get("account_id", ""))
+        amount = int(event.data.get("amount", 0))
+        self.bonus.process_wager(account_id, amount, str(event.data.get("game_category", "slots")))
+
+    def _max_bet_gate(self, account_id: str, amount: int) -> None:
+        try:
+            self.bonus.check_max_bet(account_id, amount)
+        except MaxBetExceededError as exc:
+            raise BonusRestrictionError(str(exc)) from exc
+
+    # -- public flows ---------------------------------------------------------
+
+    def deposit(self, account_id: str, amount: int, key: str, **kw):
+        res = self.wallet.deposit(account_id, amount, key, **kw)
+        self.pump()
+        return res
+
+    def bet(self, account_id: str, amount: int, key: str, **kw):
+        res = self.wallet.bet(account_id, amount, key, max_bet_check=self._max_bet_gate, **kw)
+        self.pump()
+        return res
+
+    def win(self, account_id: str, amount: int, key: str, **kw):
+        res = self.wallet.win(account_id, amount, key, **kw)
+        self.pump()
+        return res
+
+    def withdraw(self, account_id: str, amount: int, key: str, **kw):
+        res = self.wallet.withdraw(account_id, amount, key, **kw)
+        self.pump()
+        return res
+
+    def claim_bonus(self, account_id: str, rule_id: str, deposit_amount: int = 0):
+        """Award a bonus and credit the wallet's bonus balance."""
+        bonus = self.bonus.award_bonus(account_id, rule_id, deposit_amount=deposit_amount)
+        self.wallet.grant_bonus(account_id, bonus.bonus_amount, f"bonus:{bonus.id}", rule_id=rule_id)
+        self.engine.features.record_bonus_claim(account_id)
+        self.pump()
+        return bonus
+
+    def pump(self) -> None:
+        """Drain event queues synchronously (deterministic for tests)."""
+        self.bridge.drain()
+        self._bonus_consumer.drain(QUEUE_BONUS_PROCESSOR)
+
+    def close(self) -> None:
+        self.engine.close()
+        if self.store is not None:
+            self.store.close()

@@ -77,6 +77,12 @@ class ScoringBridge:
     # -- event handling ------------------------------------------------------
 
     def _event_to_request(self, event: Event) -> ScoreRequest | None:
+        """Money events scored by the risk pipeline (deposit/withdraw/bet).
+
+        Wins and bonus movements are ingested into the feature store (they
+        feed win_rate / velocity) but are not risk-gated — matching the
+        wallet call sites, where Win skips the risk check entirely
+        (SURVEY.md §3.2)."""
         if event.type not in _MONEY_EVENT_TYPES:
             return None
         data = event.data
@@ -95,10 +101,29 @@ class ScoringBridge:
             device_id=str(data.get("device_id", "")),
         )
 
+    def _ingest_only(self, event: Event) -> bool:
+        """Fold a non-scored money event (e.g. win) into the features."""
+        if event.type not in _MONEY_EVENT_TYPES:
+            return False
+        data = event.data
+        account_id = str(data.get("account_id") or event.aggregate_id)
+        tx_type = str(data.get("type", ""))
+        if not account_id or tx_type not in ("win", "refund", "bonus_grant", "bonus_wager"):
+            return False
+        req = ScoreRequest(
+            account_id=account_id, amount=int(data.get("amount", 0)), tx_type=tx_type,
+            device_id=str(data.get("device_id", "")), ip=str(data.get("ip", "")),
+        )
+        self._ingest(event, req)
+        return True
+
     def _handle_event(self, event: Event) -> None:
         req = self._event_to_request(event)
         if req is None:
-            self.events_skipped += 1
+            if self._ingest_only(event):
+                self.events_processed += 1
+            else:
+                self.events_skipped += 1
             return
         self._ingest(event, req)
         resp = self.engine.score(req)
@@ -170,7 +195,8 @@ class ScoringBridge:
         for event in events:
             req = self._event_to_request(event)
             if req is None:
-                self.events_skipped += 1
+                if not self._ingest_only(event):
+                    self.events_skipped += 1
                 continue
             self._ingest(event, req)
             pending.append((event, req))

@@ -1,0 +1,136 @@
+"""End-to-end platform integration: wallet + bonus + TPU risk + events."""
+
+import numpy as np
+import pytest
+
+from igaming_platform_tpu.core.config import ScoringConfig
+from igaming_platform_tpu.core.enums import BonusStatus
+from igaming_platform_tpu.platform.app import AppConfig, PlatformApp
+from igaming_platform_tpu.platform.bonus import BonusRule, NotEligibleError
+from igaming_platform_tpu.platform.domain import BonusRestrictionError, RiskReviewError
+from igaming_platform_tpu.serve.ipintel import CIDRIPIntelligence, IPRanges
+from igaming_platform_tpu.utils.logging import JSONFormatter, kv, log_context, setup_logging
+
+
+@pytest.fixture()
+def app():
+    a = PlatformApp(AppConfig(batch_size=32))
+    yield a
+    a.close()
+
+
+def test_deposit_bet_win_cycle_feeds_features(app):
+    acct = app.wallet.create_account("e2e-1")
+    app.deposit(acct.id, 20_000, "d1")
+    app.bet(acct.id, 5_000, "b1", game_id="g1")
+    app.win(acct.id, 2_000, "w1")
+
+    # Feature store saw all three through the event bridge.
+    from igaming_platform_tpu.core.features import F, NUM_FEATURES
+
+    row = np.zeros(NUM_FEATURES, dtype=np.float32)
+    app.engine.features.fill_row(row, acct.id, 0, "bet")
+    assert row[F.DEPOSIT_COUNT] == 1
+    assert row[F.TX_COUNT_1H] == 3
+    # Abuse detector collected the history too.
+    assert app.abuse.history_length(acct.id) == 3
+
+
+def test_bonus_claim_wagering_via_events(app):
+    acct = app.wallet.create_account("e2e-2")
+    app.deposit(acct.id, 10_000, "d1")
+
+    # welcome bonus: 100% match, 35x wagering
+    bonus = app.claim_bonus(acct.id, "welcome_bonus_100", deposit_amount=10_000)
+    assert bonus.bonus_amount == 10_000
+    bal = app.wallet.get_balance(acct.id)
+    assert bal.bonus == 10_000
+
+    # a bet drives wagering progress through the bonus.processor queue
+    # (max bet: 10% of bonus = $10; absolute cap 500)
+    app.bet(acct.id, 400, "b1", game_id="g1", game_category="slots")
+    updated = app.bonus.repo.get_by_id(bonus.id)
+    assert updated.wagering_progress == 400
+
+
+def test_max_bet_gate_blocks_oversize_bet(app):
+    acct = app.wallet.create_account("e2e-3")
+    app.deposit(acct.id, 50_000, "d1")
+    app.claim_bonus(acct.id, "welcome_bonus_100", deposit_amount=10_000)
+    with pytest.raises(BonusRestrictionError):
+        app.bet(acct.id, 2_000, "big-bet")  # > max_bet_absolute 500
+
+
+def test_high_risk_withdraw_goes_to_review(app):
+    acct = app.wallet.create_account("e2e-4")
+    # Rapid-fire deposits: velocity rule (+20) and the mock's velocity +
+    # new-account signals; blacklisted device adds +50.
+    # rule 70, ml 0.4 -> final int(0.4*70 + 0.6*40) = 52 >= review(50).
+    for i in range(12):
+        app.deposit(acct.id, 100_000, f"d{i}")
+    app.engine.features.add_to_blacklist("device", "bad-dev")
+    with pytest.raises(RiskReviewError):
+        app.withdraw(acct.id, 50_000, "wd1", device_id="bad-dev")
+
+
+def test_bonus_eligibility_via_feature_store(app):
+    acct = app.wallet.create_account("e2e-5")
+    # friday_reload requires min_deposits_lifetime=3
+    app.deposit(acct.id, 5_000, "d1")
+    with pytest.raises(NotEligibleError):
+        app.bonus.award_bonus(acct.id, "friday_reload", deposit_amount=5_000)
+
+
+def test_ledger_reconciles_after_full_cycle(app):
+    acct = app.wallet.create_account("e2e-6")
+    app.deposit(acct.id, 10_000, "d1")
+    app.bet(acct.id, 3_000, "b1")
+    app.win(acct.id, 4_500, "w1")
+    app.withdraw(acct.id, 2_000, "wd1")
+    bal = app.wallet.get_balance(acct.id)
+    assert app.wallet.ledger.verify_balance(acct.id, bal.balance)
+
+
+# -- ipintel -----------------------------------------------------------------
+
+
+def test_ipintel_cidr_classification():
+    intel = CIDRIPIntelligence(IPRanges(
+        vpn=["10.8.0.0/16"],
+        tor=["171.25.193.0/24"],
+        country_ranges={"DE": ["88.0.0.0/8"]},
+    ))
+    info = intel.analyze("10.8.3.4")
+    assert info.is_vpn and not info.is_tor
+    assert intel.analyze("171.25.193.77").is_tor
+    assert intel.analyze("88.1.2.3").country == "DE"
+    assert intel.analyze("not-an-ip").risk_score == 0
+    assert intel.flags("171.25.193.77") == (0, 0, 1)
+
+
+def test_ipintel_feeds_scoring(app):
+    intel = CIDRIPIntelligence(IPRanges(tor=["171.25.193.0/24"]))
+    from igaming_platform_tpu.serve.scorer import ScoreRequest
+
+    resp = app.engine.score(ScoreRequest(
+        "tor-user", amount=1000, tx_type="deposit",
+        ip="171.25.193.5", ip_flags=intel.flags("171.25.193.5"),
+    ))
+    assert resp.rule_score >= 15  # VPN_DETECTED fired
+
+
+# -- logging -----------------------------------------------------------------
+
+
+def test_json_logging_with_context():
+    import json as json_mod
+    import logging
+
+    record = logging.LogRecord("test", logging.INFO, "f.py", 1, "hello", (), None)
+    record.kv = {"account_id": "a1"}
+    with log_context(request_id="r1"):
+        line = JSONFormatter().format(record)
+    entry = json_mod.loads(line)
+    assert entry["msg"] == "hello"
+    assert entry["account_id"] == "a1"
+    assert entry["request_id"] == "r1"
