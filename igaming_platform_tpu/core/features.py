@@ -100,6 +100,40 @@ for _i, (_lo, _hi) in MINMAX_BOUNDS.items():
     _MM_SCALE[_i] = 1.0 / (_hi - _lo)
 
 
+# Features still unbounded after `normalize` (the reference's normalization
+# only covers the 11 features of onnx_model.go:169-184): squashed by
+# `standardize_for_model` before entering trained models.
+_UNBOUNDED_FEATURES = (
+    F.TX_AVG_1H,
+    F.IP_COUNTRY_CHANGES,
+    F.DEVICE_AGE_DAYS,
+    F.NET_DEPOSIT,
+    F.DEPOSIT_COUNT,
+    F.WITHDRAW_COUNT,
+    F.SESSION_DURATION,
+    F.AVG_BET_SIZE,
+    F.BONUS_CLAIM_COUNT,
+)
+_SQUASH_MASK = np.zeros((NUM_FEATURES,), dtype=np.float32)
+for _i in _UNBOUNDED_FEATURES:
+    _SQUASH_MASK[_i] = 1.0
+
+
+def standardize_for_model(xn: jnp.ndarray) -> jnp.ndarray:
+    """Signed-log squash of the features `normalize` leaves unbounded.
+
+    The reference's normalization contract (reproduced by `normalize`) only
+    scales 11 of 30 features; the rest reach the model at raw magnitudes
+    (cents, seconds, counts), which stalls gradient training. Trained
+    backends apply sign(x)*log1p(|x|) to those — monotonic, so threshold
+    semantics survive — while booleans/ratios/already-scaled features pass
+    through untouched.
+    """
+    xn = jnp.asarray(xn, jnp.float32)
+    squashed = jnp.sign(xn) * jnp.log1p(jnp.abs(xn))
+    return xn * (1.0 - _SQUASH_MASK) + squashed * _SQUASH_MASK
+
+
 def normalize(x: jnp.ndarray, *, ref_compat: bool = False) -> jnp.ndarray:
     """Vectorized feature normalization over a [..., 30] array.
 

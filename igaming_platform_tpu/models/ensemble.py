@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from igaming_platform_tpu.core.config import ScoringConfig
 from igaming_platform_tpu.core.enums import ACTION_APPROVE, ACTION_BLOCK, ACTION_REVIEW
-from igaming_platform_tpu.core.features import normalize
+from igaming_platform_tpu.core.features import normalize, standardize_for_model
 from igaming_platform_tpu.models import gbdt as gbdt_mod
 from igaming_platform_tpu.models import mlp as mlp_mod
 from igaming_platform_tpu.models.mock_model import mock_predict
@@ -108,6 +108,10 @@ def make_score_fn(
     ) -> dict[str, jnp.ndarray]:
         x_raw = jnp.asarray(x_raw, jnp.float32)
         xn = normalize(x_raw, ref_compat=ref_compat)
+        if not ref_compat:
+            # Trained backends get the model-side squash on top of the
+            # reference normalization (core.features.standardize_for_model).
+            xn = standardize_for_model(xn)
 
         if ml_backend == "mock":
             ml = mock_predict(xn)

@@ -1,0 +1,121 @@
+"""Distillation: fit the oblivious GBDT + fraud MLP to a teacher scorer.
+
+The reference's model-refresh toolchain (train -> ONNX export) is declared
+but absent (Makefile:215-225); its live decision function is the mock
+scorer. This module distils any teacher (the reference-parity mock by
+default, or a production label source) into the servable student models:
+
+- the GBDT trains through its soft relaxation (sigmoid splits) with a
+  temperature ramp, then serves with hard splits;
+- the fraud MLP trains directly;
+- `distill_serving_params` returns the {"mlp": ..., "gbdt": ...} pytree the
+  "mlp+gbdt" ensemble backend consumes, ready for
+  TPUScoringEngine.swap_params.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from igaming_platform_tpu.core.features import normalize, standardize_for_model
+from igaming_platform_tpu.models.gbdt import init_gbdt, soft_gbdt_predict
+from igaming_platform_tpu.models.mlp import init_mlp, mlp_predict
+from igaming_platform_tpu.models.mock_model import mock_predict
+from igaming_platform_tpu.train.data import sample_features
+
+
+@dataclass(frozen=True)
+class DistillConfig:
+    steps: int = 300
+    batch_size: int = 1024
+    learning_rate: float = 3e-3
+    n_trees: int = 64
+    depth: int = 4
+    mlp_hidden: tuple[int, ...] = (128, 128)
+    temp_start: float = 5.0
+    temp_end: float = 200.0
+    seed: int = 0
+
+
+def default_teacher(x_raw: np.ndarray) -> np.ndarray:
+    """Reference-parity teacher: mock scorer over ref-compat normalization."""
+    return np.asarray(mock_predict(normalize(x_raw, ref_compat=True)))
+
+
+def distill_gbdt(cfg: DistillConfig = DistillConfig(), teacher: Callable | None = None):
+    """Fit the forest to the teacher; returns (params, final_mae)."""
+    teacher = teacher or default_teacher
+    params = init_gbdt(jax.random.key(cfg.seed), n_trees=cfg.n_trees, depth=cfg.depth)
+    # Split structure (feat ids) stays fixed; thresholds + leaves train.
+    feat = params["feat"]
+    trainable = {"thr": params["thr"], "leaves": params["leaves"], "bias": params["bias"]}
+
+    opt = optax.adam(cfg.learning_rate)
+    opt_state = opt.init(trainable)
+
+    def loss_fn(tr, xn, y, temp):
+        p = {"feat": feat, **tr}
+        pred = soft_gbdt_predict(p, xn, temperature=temp)
+        return jnp.mean((pred - y) ** 2)
+
+    @jax.jit
+    def step(tr, opt_state, xn, y, temp):
+        loss, grads = jax.value_and_grad(loss_fn)(tr, xn, y, temp)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(tr, updates), opt_state, loss
+
+    rng = np.random.default_rng(cfg.seed)
+    for i in range(cfg.steps):
+        x_raw = sample_features(rng, cfg.batch_size)
+        y = jnp.asarray(teacher(x_raw))
+        # Model inputs: production normalization + model-side squash.
+        xn = standardize_for_model(normalize(x_raw))
+        frac = i / max(cfg.steps - 1, 1)
+        temp = cfg.temp_start * (cfg.temp_end / cfg.temp_start) ** frac
+        trainable, opt_state, _ = step(trainable, opt_state, xn, y, temp)
+
+    final = {"feat": feat, **trainable}
+    x_eval = sample_features(np.random.default_rng(cfg.seed + 1), 4096)
+    from igaming_platform_tpu.models.gbdt import gbdt_predict
+
+    mae = float(jnp.mean(jnp.abs(gbdt_predict(final, standardize_for_model(normalize(x_eval))) - teacher(x_eval))))
+    return final, mae
+
+
+def distill_mlp(cfg: DistillConfig = DistillConfig(), teacher: Callable | None = None):
+    teacher = teacher or default_teacher
+    params = init_mlp(jax.random.key(cfg.seed + 7), hidden=cfg.mlp_hidden)
+    opt = optax.adam(cfg.learning_rate)
+    opt_state = opt.init(params)
+
+    def loss_fn(p, xn, y):
+        return jnp.mean((mlp_predict(p, xn) - y) ** 2)
+
+    @jax.jit
+    def step(p, opt_state, xn, y):
+        loss, grads = jax.value_and_grad(loss_fn)(p, xn, y)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(p, updates), opt_state, loss
+
+    rng = np.random.default_rng(cfg.seed + 7)
+    for _ in range(cfg.steps):
+        x_raw = sample_features(rng, cfg.batch_size)
+        y = jnp.asarray(teacher(x_raw))
+        params, opt_state, _ = step(params, opt_state, standardize_for_model(normalize(x_raw)), y)
+
+    x_eval = sample_features(np.random.default_rng(cfg.seed + 8), 4096)
+    mae = float(jnp.mean(jnp.abs(mlp_predict(params, standardize_for_model(normalize(x_eval))) - teacher(x_eval))))
+    return params, mae
+
+
+def distill_serving_params(cfg: DistillConfig = DistillConfig(), teacher: Callable | None = None):
+    """Train both students; returns ({"mlp", "gbdt"}, {"mlp_mae", "gbdt_mae"})."""
+    gbdt_params, gbdt_mae = distill_gbdt(cfg, teacher)
+    mlp_params, mlp_mae = distill_mlp(cfg, teacher)
+    return {"mlp": mlp_params, "gbdt": gbdt_params}, {"mlp_mae": mlp_mae, "gbdt_mae": gbdt_mae}

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from igaming_platform_tpu.core.features import normalize
+from igaming_platform_tpu.core.features import normalize, standardize_for_model
 from igaming_platform_tpu.models.multitask import init_multitask, multitask_forward, param_specs
 from igaming_platform_tpu.parallel.mesh import AXIS_DATA
 from igaming_platform_tpu.parallel.sharding import tree_shardings
@@ -47,7 +47,7 @@ class TrainState:
 
 def make_loss_fn(cfg: TrainConfig):
     def loss_fn(params, x_raw, fraud_t, ltv_t, churn_t):
-        xn = normalize(x_raw)
+        xn = standardize_for_model(normalize(x_raw))
         out = multitask_forward(params, xn)
         # Soft-target BCE for fraud/churn, scaled Huber for LTV.
         fraud_loss = jnp.mean(optax.sigmoid_binary_cross_entropy(out["fraud_logit"], fraud_t))

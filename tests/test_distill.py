@@ -1,0 +1,55 @@
+"""Distillation tests: students approximate the teacher, serve in ensemble."""
+
+import jax
+import numpy as np
+import pytest
+
+from igaming_platform_tpu.core.features import normalize
+from igaming_platform_tpu.models.gbdt import gbdt_predict, init_gbdt
+from igaming_platform_tpu.models.mlp import init_mlp, mlp_predict
+from igaming_platform_tpu.train.data import sample_features
+from igaming_platform_tpu.train.distill import (
+    DistillConfig,
+    default_teacher,
+    distill_gbdt,
+    distill_mlp,
+)
+
+FAST = DistillConfig(steps=80, batch_size=512, n_trees=32, depth=3, mlp_hidden=(64, 64))
+
+
+def _baseline_mae(predict, init_params):
+    x = sample_features(np.random.default_rng(99), 2048)
+    y = default_teacher(x)
+    return float(np.mean(np.abs(np.asarray(predict(init_params, normalize(x))) - y)))
+
+
+def test_distilled_mlp_beats_init():
+    params, mae = distill_mlp(FAST)
+    init = init_mlp(jax.random.key(FAST.seed + 7), hidden=FAST.mlp_hidden)
+    assert mae < _baseline_mae(mlp_predict, init) * 0.7
+    assert mae < 0.15
+
+
+def test_distilled_gbdt_beats_init():
+    params, mae = distill_gbdt(FAST)
+    init = init_gbdt(jax.random.key(FAST.seed), n_trees=FAST.n_trees, depth=FAST.depth)
+    assert mae < _baseline_mae(gbdt_predict, init) * 0.9
+    assert mae < 0.2
+
+
+def test_distilled_params_serve_in_ensemble():
+    from igaming_platform_tpu.core.config import BatcherConfig
+    from igaming_platform_tpu.serve.scorer import ScoreRequest, TPUScoringEngine
+    from igaming_platform_tpu.train.distill import distill_serving_params
+
+    params, maes = distill_serving_params(DistillConfig(steps=30, batch_size=256, n_trees=16, depth=3, mlp_hidden=(32,)))
+    eng = TPUScoringEngine(
+        ml_backend="mlp+gbdt", params=params,
+        batcher_config=BatcherConfig(batch_size=32, max_wait_ms=1),
+    )
+    try:
+        resp = eng.score(ScoreRequest("d-acct", amount=5000, tx_type="deposit"))
+        assert 0.0 <= resp.ml_score <= 1.0
+    finally:
+        eng.close()

@@ -1,0 +1,48 @@
+"""Continuous training loop: checkpoints, resume, live hot-swap."""
+
+import numpy as np
+
+from igaming_platform_tpu.core.config import BatcherConfig
+from igaming_platform_tpu.serve.scorer import ScoreRequest, TPUScoringEngine
+from igaming_platform_tpu.train.loop import LoopConfig, TrainingLoop
+from igaming_platform_tpu.train.trainer import TrainConfig, Trainer
+
+SMALL = TrainConfig(batch_size=128, trunk=(32, 32))
+
+
+def test_loop_checkpoints_and_resumes(tmp_path):
+    ckpt = str(tmp_path / "ckpts")
+    loop = TrainingLoop(
+        Trainer(SMALL),
+        config=LoopConfig(checkpoint_dir=ckpt, checkpoint_every=5, swap_every=0),
+    )
+    loop.run_steps(10)
+    assert loop.checkpoints >= 2
+    step_before = loop.trainer.state.step
+
+    resumed = TrainingLoop(
+        Trainer(SMALL),
+        config=LoopConfig(checkpoint_dir=ckpt, checkpoint_every=0, swap_every=0),
+    )
+    assert resumed.trainer.state.step == step_before
+
+
+def test_loop_hot_swaps_into_live_engine(tmp_path):
+    engine = TPUScoringEngine(
+        ml_backend="multitask",
+        params={"multitask": Trainer(SMALL).export_params()},
+        batcher_config=BatcherConfig(batch_size=32, max_wait_ms=1),
+    )
+    try:
+        loop = TrainingLoop(
+            Trainer(SMALL),
+            engine=engine,
+            config=LoopConfig(checkpoint_dir=str(tmp_path / "c"), checkpoint_every=0, swap_every=3),
+        )
+        loop.run_steps(9)
+        assert loop.swaps == 3
+        # engine still serves with the swapped params
+        resp = engine.score(ScoreRequest("swap-acct", amount=1000, tx_type="bet"))
+        assert 0.0 <= resp.ml_score <= 1.0
+    finally:
+        engine.close()
