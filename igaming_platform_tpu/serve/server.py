@@ -48,6 +48,30 @@ class RiskServer:
         self.config = config or RiskServiceConfig.from_env()
         self.metrics = ServiceMetrics("risk")
 
+        # Model boot: FRAUD_MODEL_PATH names an Orbax checkpoint (replacing
+        # the reference's .onnx file path, risk/cmd/main.go:62-63); like the
+        # reference, a missing model degrades to the deterministic mock
+        # scorer (onnx_model.go:51-59) instead of failing boot.
+        if params is None and self.config.fraud_model_path:
+            import os as _os
+
+            from igaming_platform_tpu.train.checkpoint import restore_params_for_serving
+
+            if _os.path.exists(self.config.fraud_model_path):
+                try:
+                    params = {"multitask": restore_params_for_serving(self.config.fraud_model_path)}
+                    ml_backend = "multitask"
+                    logger.info("loaded fraud model from %s", self.config.fraud_model_path)
+                except Exception:
+                    logger.warning(
+                        "failed to load model at %s; using mock scorer",
+                        self.config.fraud_model_path, exc_info=True,
+                    )
+            else:
+                logger.warning(
+                    "model path %s not found; using mock scorer", self.config.fraud_model_path
+                )
+
         # Engine (AOT warm-up happens in the constructor, before SERVING).
         self.engine = TPUScoringEngine(
             self.config.scoring,
