@@ -1,0 +1,15 @@
+"""Model zoo: rules, mock, MLP, GBDT, ensemble, LTV, multitask, sequence."""
+
+from igaming_platform_tpu.models.ensemble import combine, jit_score_fn, make_score_fn
+from igaming_platform_tpu.models.gbdt import gbdt_predict, gbdt_raw, init_gbdt, soft_gbdt_predict
+from igaming_platform_tpu.models.ltv import predict_batch as ltv_predict_batch
+from igaming_platform_tpu.models.mlp import init_mlp, mlp_predict
+from igaming_platform_tpu.models.mock_model import mock_predict
+from igaming_platform_tpu.models.multitask import fraud_predict, init_multitask, multitask_forward
+from igaming_platform_tpu.models.rules import RULE_WEIGHTS, apply_rules
+from igaming_platform_tpu.models.sequence import (
+    SeqConfig,
+    encode_event,
+    init_sequence_model,
+    sequence_forward,
+)

@@ -1,0 +1,13 @@
+"""Parallelism: mesh, collectives, shardings, pipeline, multi-host."""
+
+from igaming_platform_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_MODEL,
+    AXIS_SEQ,
+    MeshSpec,
+    create_mesh,
+    single_device_mesh,
+)
+from igaming_platform_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from igaming_platform_tpu.parallel.sharding import shard_params, tree_shardings

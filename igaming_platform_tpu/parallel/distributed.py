@@ -1,0 +1,73 @@
+"""Multi-host bootstrap: scale-out over DCN.
+
+The reference scales out with stateless replicas behind gRPC/RabbitMQ;
+the TPU framework scales the device program itself: every host runs the
+same SPMD program, `jax.distributed` stitches their devices into one
+global mesh, and XLA routes collectives over ICI inside a slice and DCN
+across hosts (SURVEY.md §2.3 "Comm backend").
+
+`initialize_from_env` reads the standard coordinator env vars and no-ops
+for single-process runs, so the same entrypoint works from a laptop to a
+multi-host pod. Mesh construction then uses the *global* device list, with
+the `data` axis laid out to span hosts (DP gradient sync is the traffic
+that tolerates DCN latency; TP/SP/EP axes stay within a host's slice).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+from igaming_platform_tpu.parallel.mesh import MeshSpec, create_mesh
+
+logger = logging.getLogger(__name__)
+
+
+def initialize_from_env() -> bool:
+    """Initialize jax.distributed from env; returns True if multi-process.
+
+    Env contract (mirrors jax.distributed.initialize):
+      COORDINATOR_ADDRESS  host:port of process 0
+      NUM_PROCESSES        total process count
+      PROCESS_ID           this process's index
+    """
+    num_processes = int(os.environ.get("NUM_PROCESSES", "1"))
+    if num_processes <= 1:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=os.environ["COORDINATOR_ADDRESS"],
+        num_processes=num_processes,
+        process_id=int(os.environ["PROCESS_ID"]),
+    )
+    logger.info(
+        "distributed initialized: process %d/%d, %d local / %d global devices",
+        jax.process_index(), num_processes, jax.local_device_count(), jax.device_count(),
+    )
+    return True
+
+
+def is_primary() -> bool:
+    """True on the process that owns logging/checkpoint writes."""
+    return jax.process_index() == 0
+
+
+def global_mesh(spec: MeshSpec = MeshSpec()):
+    """Mesh over ALL processes' devices.
+
+    jax.devices() returns the global list ordered host-major, and
+    create_mesh reshapes row-major with `data` as the leading axis — so
+    `data` spans hosts (DCN) while model/seq/expert stay intra-host (ICI),
+    matching the axis-to-fabric mapping above.
+    """
+    return create_mesh(spec, devices=jax.devices())
+
+
+def process_batch_slice(global_batch: int) -> tuple[int, int]:
+    """(per-process batch, offset) for host-local data loading."""
+    n = jax.process_count()
+    if global_batch % n != 0:
+        raise ValueError(f"global batch {global_batch} not divisible by {n} processes")
+    per = global_batch // n
+    return per, per * jax.process_index()

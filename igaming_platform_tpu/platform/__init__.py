@@ -1,0 +1,5 @@
+"""Host platform: wallet, bonus engine, repositories, app composition."""
+
+from igaming_platform_tpu.platform.bonus import BonusEngine, BonusRule, load_rules
+from igaming_platform_tpu.platform.domain import Account, LedgerEntry, Transaction
+from igaming_platform_tpu.platform.wallet import WalletConfig, WalletService

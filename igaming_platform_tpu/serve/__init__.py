@@ -1,0 +1,6 @@
+"""Serving: feature stores, batcher, scoring engine, events, gRPC, abuse."""
+
+from igaming_platform_tpu.serve.batcher import ContinuousBatcher, pad_batch
+from igaming_platform_tpu.serve.events import Consumer, Event, InMemoryBroker, Publisher, default_broker
+from igaming_platform_tpu.serve.feature_store import InMemoryFeatureStore, TransactionEvent
+from igaming_platform_tpu.serve.scorer import ScoreRequest, ScoreResponse, TPUScoringEngine

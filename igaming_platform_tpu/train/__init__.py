@@ -1,0 +1,5 @@
+"""Training: DP+TP trainer, distillation, checkpoints, continuous loop."""
+
+from igaming_platform_tpu.train.checkpoint import restore_trainer, save_checkpoint
+from igaming_platform_tpu.train.data import Batch, make_stream
+from igaming_platform_tpu.train.trainer import TrainConfig, Trainer

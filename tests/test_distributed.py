@@ -1,0 +1,51 @@
+"""Distributed bootstrap (single-process path) + sharded serving engine."""
+
+import numpy as np
+
+from igaming_platform_tpu.core.config import BatcherConfig
+from igaming_platform_tpu.parallel.distributed import (
+    global_mesh,
+    initialize_from_env,
+    is_primary,
+    process_batch_slice,
+)
+from igaming_platform_tpu.parallel.mesh import AXIS_DATA, MeshSpec, mesh_axis_size
+from igaming_platform_tpu.serve.feature_store import TransactionEvent
+from igaming_platform_tpu.serve.scorer import ScoreRequest, TPUScoringEngine
+
+
+def test_single_process_noop(monkeypatch):
+    monkeypatch.delenv("NUM_PROCESSES", raising=False)
+    assert initialize_from_env() is False
+    assert is_primary()
+
+
+def test_global_mesh_covers_all_devices():
+    mesh = global_mesh(MeshSpec(data=-1, model=2))
+    assert mesh_axis_size(mesh, AXIS_DATA) == 4
+    assert mesh_axis_size(mesh, "model") == 2
+
+
+def test_process_batch_slice_single():
+    per, offset = process_batch_slice(1024)
+    assert per == 1024 and offset == 0
+
+
+def test_engine_with_mesh_shards_batches():
+    """TPUScoringEngine over the 8-device mesh == single-device scoring."""
+    mesh = global_mesh(MeshSpec(data=-1))
+    eng_mesh = TPUScoringEngine(
+        mesh=mesh, batcher_config=BatcherConfig(batch_size=64, max_wait_ms=1)
+    )
+    eng_single = TPUScoringEngine(batcher_config=BatcherConfig(batch_size=64, max_wait_ms=1))
+    try:
+        for eng in (eng_mesh, eng_single):
+            eng.update_features(TransactionEvent("dist-acct", 7000, "deposit", device_id="d1"))
+        r_mesh = eng_mesh.score(ScoreRequest("dist-acct", amount=2000, tx_type="deposit"))
+        r_single = eng_single.score(ScoreRequest("dist-acct", amount=2000, tx_type="deposit"))
+        assert r_mesh.score == r_single.score
+        assert r_mesh.action == r_single.action
+        assert abs(r_mesh.ml_score - r_single.ml_score) < 1e-6
+    finally:
+        eng_mesh.close()
+        eng_single.close()
