@@ -64,7 +64,12 @@ def config2_replay_throughput(n_events: int = 10_000, batch_size: int = 1024) ->
         for i in range(n_events)
     ]
 
-    engine = TPUScoringEngine(batcher_config=BatcherConfig(batch_size=batch_size, max_wait_ms=1.0))
+    from igaming_platform_tpu.serve.native_store import best_feature_store
+
+    engine = TPUScoringEngine(
+        batcher_config=BatcherConfig(batch_size=batch_size, max_wait_ms=1.0),
+        feature_store=best_feature_store(),
+    )
     bridge = ScoringBridge(engine, default_broker(), publish_risk_events=False)
     try:
         stats = bridge.replay(events, batch_size=batch_size)

@@ -125,8 +125,11 @@ class ScoringBridge:
             else:
                 self.events_skipped += 1
             return
-        self._ingest(event, req)
+        # Score first, then write back — the reference risk-gates on the
+        # pre-transaction feature state and updates features after the
+        # transaction completes (engine.go:262 vs :486-488).
         resp = self.engine.score(req)
+        self._ingest(event, req)
         self.events_processed += 1
         self._publish_outcomes(event, req, resp.score, resp.action, [r.value for r in resp.reason_codes])
 
@@ -167,10 +170,16 @@ class ScoringBridge:
         """Replay a trace through feature-update + batched scoring.
 
         Unlike the live path (which rides the continuous batcher), replay
-        slices the trace into direct device batches — the throughput-measuring
-        configuration.
+        slices the trace into direct device batches and post-processes
+        results as arrays — per-row Python happens only for the rare rows
+        that publish outcome events (blocked / high-score).
         """
         import time as _time
+
+        import numpy as np
+
+        from igaming_platform_tpu.core.enums import ACTION_BLOCK, decode_reason_mask
+        from igaming_platform_tpu.serve.batcher import pad_batch
 
         batch_size = batch_size or self.engine.batch_size
         pending: list[tuple[Event, ScoreRequest]] = []
@@ -182,15 +191,48 @@ class ScoringBridge:
             nonlocal scored, blocked
             if not pending:
                 return
-            reqs = [r for _, r in pending]
-            responses = self.engine.score_batch(reqs)
-            for (ev, req), resp in zip(pending, responses):
-                self._publish_outcomes(ev, req, resp.score, resp.action,
-                                       [r.value for r in resp.reason_codes])
-                if resp.action == "block":
-                    blocked += 1
-            scored += len(pending)
+            n = len(pending)
+            x, bl = self.engine.features.gather_batch([r for _, r in pending])
+            chunk = pending[:]
+            xp, _ = pad_batch(x, batch_size)
+            blp, _ = pad_batch(bl, batch_size)
+            out = self.engine.score_arrays(xp, blp)
+            scores = np.asarray(out["score"][:n])
+            actions = np.asarray(out["action"][:n])
+            masks = np.asarray(out["reason_mask"][:n])
+
+            is_blocked = actions == ACTION_BLOCK
+            blocked += int(is_blocked.sum())
+            if self.publish_risk_events:
+                notable = np.nonzero(is_blocked | (scores >= self.high_score_threshold))[0]
+                for i in notable:
+                    ev, req = pending[i]
+                    action = "block" if is_blocked[i] else "review"
+                    reasons = [r.value for r in decode_reason_mask(int(masks[i]))]
+                    self._publish_outcomes(ev, req, int(scores[i]), action, reasons)
+            scored += n
             pending.clear()
+            # Post-score feature write-back, one native call per chunk when
+            # the store supports batched ingest.
+            update_batch = getattr(self.engine.features, "update_batch", None)
+            tx_events = [
+                TransactionEvent(
+                    account_id=req.account_id, amount=req.amount, tx_type=req.tx_type,
+                    ip=req.ip, device_id=req.device_id, timestamp=ev.timestamp,
+                )
+                for ev, req in chunk
+            ]
+            if update_batch is not None:
+                update_batch(tx_events)
+            else:
+                for te in tx_events:
+                    self.engine.features.update(te)
+            if self.abuse_detector is not None:
+                for te in tx_events:
+                    self.abuse_detector.record_event(
+                        te.account_id, te.amount, te.tx_type,
+                        device_id=te.device_id, timestamp=te.timestamp,
+                    )
 
         for event in events:
             req = self._event_to_request(event)
@@ -198,7 +240,6 @@ class ScoringBridge:
                 if not self._ingest_only(event):
                     self.events_skipped += 1
                 continue
-            self._ingest(event, req)
             pending.append((event, req))
             if len(pending) >= batch_size:
                 flush()

@@ -35,11 +35,19 @@ _TX_TYPE_CODES = {"deposit": 0, "withdraw": 1, "bet": 2, "win": 3}
 _build_lock = threading.Lock()
 
 
+_hash_cache: dict[str, int] = {}
+
+
 def _hash64(value: str) -> int:
     if not value:
         return 0
-    h = int.from_bytes(hashlib.blake2b(value.encode(), digest_size=8).digest(), "little")
-    return h or 1  # 0 means "absent" on the C side
+    h = _hash_cache.get(value)
+    if h is None:
+        h = int.from_bytes(hashlib.blake2b(value.encode(), digest_size=8).digest(), "little")
+        h = h or 1  # 0 means "absent" on the C side
+        if len(_hash_cache) < 1_000_000:
+            _hash_cache[value] = h
+    return h
 
 
 def build_native(force: bool = False) -> str | None:
@@ -73,6 +81,15 @@ def _load_lib():
     lib.fs_update.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_double, ctypes.c_int64,
         ctypes.c_int, ctypes.c_uint64, ctypes.c_uint64,
+    ]
+    lib.fs_update_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
     ]
     lib.fs_record_bonus.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_float]
     lib.fs_velocity.argtypes = [
@@ -143,6 +160,28 @@ class NativeFeatureStore:
             _TX_TYPE_CODES.get(event.tx_type, 4),
             _hash64(event.device_id), _hash64(event.ip),
         )
+
+    def update_batch(self, events) -> None:
+        """Batched ingest: one native call for a whole event chunk."""
+        events = list(events)
+        n = len(events)
+        if n == 0:
+            return
+        now = time.time()
+        idxs = np.empty(n, np.int32)
+        ts = np.empty(n, np.float64)
+        amounts = np.empty(n, np.int64)
+        types = np.empty(n, np.int32)
+        dev = np.empty(n, np.uint64)
+        ips = np.empty(n, np.uint64)
+        for i, e in enumerate(events):
+            idxs[i] = self._idx(e.account_id)
+            ts[i] = e.timestamp or now
+            amounts[i] = int(e.amount)
+            types[i] = _TX_TYPE_CODES.get(e.tx_type, 4)
+            dev[i] = _hash64(e.device_id)
+            ips[i] = _hash64(e.ip)
+        self._lib.fs_update_batch(self._handle, n, idxs, ts, amounts, types, dev, ips)
 
     def record_bonus_claim(self, account_id: str, wager_complete_rate: float | None = None) -> None:
         idx = self._idx(account_id)

@@ -196,6 +196,16 @@ void fs_update(void* handle, int idx, double ts, int64_t amount, int tx_type,
   }
 }
 
+// Batched ingest: one call per chunk instead of one per event (the ctypes
+// crossing dominates per-event cost from Python).
+void fs_update_batch(void* handle, int n, const int32_t* idxs, const double* ts,
+                     const int64_t* amounts, const int32_t* tx_types,
+                     const uint64_t* device_hashes, const uint64_t* ip_hashes) {
+  for (int i = 0; i < n; ++i) {
+    fs_update(handle, idxs[i], ts[i], amounts[i], tx_types[i], device_hashes[i], ip_hashes[i]);
+  }
+}
+
 void fs_record_bonus(void* handle, int idx, float wager_rate) {
   Store* s = static_cast<Store*>(handle);
   if (idx < 0 || size_t(idx) >= s->accounts.size()) return;
