@@ -260,7 +260,10 @@ class BonusEngine:
             raise NotEligibleError("bonus already claimed")
 
         amount = self._calculate_bonus_amount(rule, deposit_amount)
-        if amount == 0:
+        # Free-spins bonuses legitimately start at zero monetary value —
+        # winnings accrue per spin (use_free_spin). The reference's zero
+        # check (bonus_engine.go:287-289) would wrongly reject them.
+        if amount == 0 and not (rule.type == BonusType.FREE_SPINS and rule.free_spins_count > 0):
             raise NotEligibleError("calculated bonus amount is zero")
 
         now = self.now_fn()
@@ -338,6 +341,30 @@ class BonusEngine:
             self.repo.update(bonus)
             count += 1
         return count
+
+    # -- free spins (PlayerBonus free_spins_* accounting) ---------------------
+
+    def use_free_spin(self, bonus_id: str, win_amount: int = 0) -> PlayerBonus:
+        """Consume one free spin; spin winnings accrue to the bonus amount
+        (capped at the rule's max win) and exhausting spins completes the
+        spins phase — winnings then wager like any bonus funds."""
+        bonus = self.repo.get_by_id(bonus_id)
+        if bonus is None:
+            raise KeyError(f"bonus not found: {bonus_id}")
+        if bonus.type != BonusType.FREE_SPINS or bonus.status != BonusStatus.ACTIVE:
+            raise NotEligibleError(f"not an active free-spins bonus: {bonus_id}")
+        if bonus.free_spins_used >= bonus.free_spins_total:
+            raise NotEligibleError("no free spins remaining")
+        rule = self.rules_by_id.get(bonus.rule_id)
+        bonus.free_spins_used += 1
+        if win_amount > 0:
+            bonus.bonus_amount += win_amount
+            if rule is not None and rule.max_bonus and bonus.bonus_amount > rule.max_bonus:
+                bonus.bonus_amount = rule.max_bonus
+            if rule is not None:
+                bonus.wagering_required = bonus.bonus_amount * rule.wagering_multiplier
+        self.repo.update(bonus)
+        return bonus
 
     def get_rule(self, rule_id: str) -> BonusRule | None:
         return self.rules_by_id.get(rule_id)
