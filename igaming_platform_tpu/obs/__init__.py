@@ -1,0 +1,4 @@
+"""Observability: metrics registry + tracing/profiling hooks."""
+
+from igaming_platform_tpu.obs.metrics import Counter, Gauge, Histogram, Registry, ServiceMetrics
+from igaming_platform_tpu.obs.tracing import SpanCollector, annotate, device_trace, span

@@ -1,0 +1,3 @@
+"""Numeric building blocks: MXU formulations and Pallas kernels."""
+
+from igaming_platform_tpu.ops.gbdt_matmul import gbdt_raw_matmul, precompute_selector

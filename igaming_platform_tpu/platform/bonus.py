@@ -144,6 +144,99 @@ class InMemoryBonusRepository:
         ]
 
 
+class SQLiteBonusRepository:
+    """Durable bonus persistence (player_bonuses table, init-db.sql:75-115
+    analog) on a SQLiteStore's connection."""
+
+    _SCHEMA = """
+    CREATE TABLE IF NOT EXISTS player_bonuses (
+        id TEXT PRIMARY KEY,
+        account_id TEXT NOT NULL,
+        rule_id TEXT NOT NULL,
+        type TEXT NOT NULL,
+        status TEXT NOT NULL,
+        bonus_amount INTEGER NOT NULL,
+        wagering_required INTEGER NOT NULL,
+        wagering_progress INTEGER NOT NULL DEFAULT 0,
+        free_spins_total INTEGER NOT NULL DEFAULT 0,
+        free_spins_used INTEGER NOT NULL DEFAULT 0,
+        awarded_at REAL NOT NULL,
+        expires_at REAL NOT NULL,
+        completed_at REAL,
+        trigger_tx_id TEXT,
+        promo_code TEXT
+    );
+    CREATE INDEX IF NOT EXISTS idx_bonus_account_status
+        ON player_bonuses(account_id, status);
+    """
+
+    def __init__(self, store):
+        self._s = store
+        with self._s._lock:
+            self._s._conn.executescript(self._SCHEMA)
+
+    def _row_to_bonus(self, r) -> PlayerBonus:
+        return PlayerBonus(
+            id=r[0], account_id=r[1], rule_id=r[2], type=BonusType(r[3]),
+            status=BonusStatus(r[4]), bonus_amount=r[5], wagering_required=r[6],
+            wagering_progress=r[7], free_spins_total=r[8], free_spins_used=r[9],
+            awarded_at=r[10], expires_at=r[11], completed_at=r[12],
+            trigger_tx_id=r[13], promo_code=r[14],
+        )
+
+    def create(self, b: PlayerBonus) -> None:
+        with self._s._lock:
+            self._s._conn.execute(
+                "INSERT INTO player_bonuses VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                (b.id, b.account_id, b.rule_id, b.type.value, b.status.value,
+                 b.bonus_amount, b.wagering_required, b.wagering_progress,
+                 b.free_spins_total, b.free_spins_used, b.awarded_at, b.expires_at,
+                 b.completed_at, b.trigger_tx_id, b.promo_code),
+            )
+            self._s._conn.commit()
+
+    def get_by_id(self, bonus_id: str) -> PlayerBonus | None:
+        with self._s._lock:
+            r = self._s._conn.execute(
+                "SELECT * FROM player_bonuses WHERE id=?", (bonus_id,)
+            ).fetchone()
+        return self._row_to_bonus(r) if r else None
+
+    def get_active_by_account(self, account_id: str) -> list[PlayerBonus]:
+        with self._s._lock:
+            rows = self._s._conn.execute(
+                "SELECT * FROM player_bonuses WHERE account_id=? AND status='active'",
+                (account_id,),
+            ).fetchall()
+        return [self._row_to_bonus(r) for r in rows]
+
+    def update(self, b: PlayerBonus) -> None:
+        with self._s._lock:
+            self._s._conn.execute(
+                "UPDATE player_bonuses SET status=?, bonus_amount=?, wagering_required=?,"
+                " wagering_progress=?, free_spins_used=?, completed_at=? WHERE id=?",
+                (b.status.value, b.bonus_amount, b.wagering_required,
+                 b.wagering_progress, b.free_spins_used, b.completed_at, b.id),
+            )
+            self._s._conn.commit()
+
+    def count_by_rule_and_account(self, rule_id: str, account_id: str) -> int:
+        with self._s._lock:
+            r = self._s._conn.execute(
+                "SELECT COUNT(*) FROM player_bonuses WHERE rule_id=? AND account_id=?",
+                (rule_id, account_id),
+            ).fetchone()
+        return int(r[0])
+
+    def get_expired(self, now: float) -> list[PlayerBonus]:
+        with self._s._lock:
+            rows = self._s._conn.execute(
+                "SELECT * FROM player_bonuses WHERE status='active' AND expires_at < ?",
+                (now,),
+            ).fetchall()
+        return [self._row_to_bonus(r) for r in rows]
+
+
 class BonusAbuseError(Exception):
     pass
 

@@ -119,7 +119,11 @@ class InMemoryTransactionRepository:
         with self._lock:
             key = (tx.account_id, tx.idempotency_key)
             if tx.idempotency_key and key in self._by_idem:
-                raise DuplicateTransactionError(tx.idempotency_key)
+                existing = self._by_id[self._by_idem[key]]
+                if existing.status != TxStatus.FAILED:
+                    raise DuplicateTransactionError(tx.idempotency_key)
+                # Failed attempt: the key is re-usable; the failed row stays
+                # reachable by id for audit.
             self._by_id[tx.id] = tx
             if tx.idempotency_key:
                 self._by_idem[key] = tx.id
@@ -203,9 +207,13 @@ CREATE TABLE IF NOT EXISTS transactions (
     round_id TEXT,
     risk_score INTEGER,
     created_at REAL NOT NULL,
-    completed_at REAL,
-    UNIQUE (account_id, idempotency_key)
+    completed_at REAL
 );
+-- Idempotency: unique per (account, key) among non-failed rows only — a
+-- failed attempt releases the key for the retry (partial unique index).
+CREATE UNIQUE INDEX IF NOT EXISTS idx_tx_idem
+    ON transactions(account_id, idempotency_key)
+    WHERE status != 'failed' AND idempotency_key IS NOT NULL;
 CREATE INDEX IF NOT EXISTS idx_tx_account ON transactions(account_id, created_at DESC);
 CREATE TABLE IF NOT EXISTS ledger_entries (
     id TEXT PRIMARY KEY,
@@ -386,8 +394,10 @@ class _SQLiteTransactions:
         if not key:
             return None
         with self._s._lock:
+            # Prefer the live (non-failed) row for the key.
             row = self._s._conn.execute(
-                "SELECT * FROM transactions WHERE account_id=? AND idempotency_key=?",
+                "SELECT * FROM transactions WHERE account_id=? AND idempotency_key=?"
+                " ORDER BY (status = 'failed'), created_at DESC LIMIT 1",
                 (account_id, key),
             ).fetchone()
         return self._row_to_tx(row) if row else None

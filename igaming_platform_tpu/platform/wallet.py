@@ -299,9 +299,15 @@ class WalletService:
             raise InvalidAmountError(f"amount must be positive: {amount}")
 
     def _replay(self, account_id: str, idempotency_key: str) -> OpResult | None:
-        """Idempotency replay (wallet_service.go:242-248)."""
+        """Idempotency replay (wallet_service.go:242-248).
+
+        Failed transactions do NOT satisfy idempotency: a retry after an
+        optimistic-lock conflict must re-execute, not replay the failure.
+        (The reference replays any status — a retried deposit whose first
+        attempt lost the version race would silently never apply.)
+        """
         existing = self.transactions.get_by_idempotency_key(account_id, idempotency_key)
-        if existing is None:
+        if existing is None or existing.status == TxStatus.FAILED:
             return None
         return OpResult(existing, existing.balance_after)
 

@@ -158,9 +158,15 @@ class TPUScoringEngine:
     # -- internals -----------------------------------------------------------
 
     def _run_requests(self, reqs: list[ScoreRequest]) -> list[ScoreResponse]:
-        x, bl = self.features.gather_batch(reqs)
-        out, n = self._run_device(x, bl)
-        return [self._row_response(out, x, i) for i in range(n)]
+        # Chunk to the compiled batch shape: oversized ScoreBatch RPCs run
+        # as several device steps rather than recompiling a new shape.
+        responses: list[ScoreResponse] = []
+        for start in range(0, len(reqs), self.batch_size):
+            chunk = reqs[start : start + self.batch_size]
+            x, bl = self.features.gather_batch(chunk)
+            out, n = self._run_device(x, bl)
+            responses.extend(self._row_response(out, x, i) for i in range(n))
+        return responses
 
     def _run_device(self, x: np.ndarray, bl: np.ndarray):
         n = x.shape[0]

@@ -1,0 +1,3 @@
+"""Utilities: structured logging."""
+
+from igaming_platform_tpu.utils.logging import JSONFormatter, kv, log_context, setup_logging

@@ -1,0 +1,81 @@
+"""Metrics/tracing + durable bonus repository tests."""
+
+import time
+
+from igaming_platform_tpu.core.enums import BonusStatus
+from igaming_platform_tpu.obs.metrics import Registry, ServiceMetrics
+from igaming_platform_tpu.obs.tracing import SpanCollector, span
+from igaming_platform_tpu.platform.bonus import (
+    BonusEngine,
+    BonusRule,
+    SQLiteBonusRepository,
+)
+from igaming_platform_tpu.platform.repository import SQLiteStore
+
+
+def test_counter_gauge_histogram_render():
+    reg = Registry()
+    c = reg.counter("requests_total", "reqs")
+    g = reg.gauge("queue_depth")
+    h = reg.histogram("latency_ms", buckets=(1, 10, 100))
+
+    c.inc(method="Score")
+    c.inc(2, method="Score")
+    g.set(7)
+    h.observe(5.0)
+    h.observe(50.0)
+
+    text = reg.render_text()
+    assert 'requests_total{method="Score"} 3.0' in text
+    assert "queue_depth 7" in text
+    assert 'latency_ms_bucket{le="10"} 1' in text
+    assert 'latency_ms_bucket{le="100"} 2' in text
+    assert "latency_ms_count 2" in text
+    assert h.percentile(0.5) == 10
+    assert h.percentile(0.99) == 100
+
+
+def test_service_metrics_observe_rpc():
+    m = ServiceMetrics("test")
+    start = time.monotonic()
+    m.observe_rpc("Score", start)
+    m.observe_rpc("Score", start, code="INTERNAL")
+    assert m.requests_total.value(method="Score", code="OK") == 1
+    assert m.errors_total.value(method="Score") == 1
+    assert m.request_duration_ms.count(method="Score") == 2
+
+
+def test_span_collector():
+    col = SpanCollector()
+    with span("gather", col, batch=32):
+        time.sleep(0.01)
+    spans = col.drain()
+    assert len(spans) == 1
+    assert spans[0].name == "gather"
+    assert spans[0].duration_ms >= 10
+    assert spans[0].attributes["batch"] == 32
+
+
+def test_sqlite_bonus_repo_full_lifecycle():
+    store = SQLiteStore()
+    repo = SQLiteBonusRepository(store)
+    rule = BonusRule(id="r1", match_percent=100, max_bonus=10_000,
+                     wagering_multiplier=2, expiry_days=1)
+    t = [1000.0]
+    eng = BonusEngine([rule], repo=repo, now_fn=lambda: t[0])
+
+    bonus = eng.award_bonus("sq-acct", "r1", deposit_amount=5_000)
+    assert repo.get_by_id(bonus.id).bonus_amount == 5_000
+    assert repo.count_by_rule_and_account("r1", "sq-acct") == 1
+
+    eng.process_wager("sq-acct", 10_000, "slots")
+    got = repo.get_by_id(bonus.id)
+    assert got.status == BonusStatus.COMPLETED
+    assert got.wagering_progress == 10_000
+
+    # New bonus expires via the sweep.
+    b2 = eng.award_bonus("sq-acct", "r1", deposit_amount=1_000)
+    t[0] += 2 * 86400
+    assert eng.expire_old_bonuses() == 1
+    assert repo.get_by_id(b2.id).status == BonusStatus.EXPIRED
+    store.close()

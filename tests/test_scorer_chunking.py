@@ -1,0 +1,18 @@
+"""score_batch larger than the compiled batch size must chunk, not crash."""
+
+from igaming_platform_tpu.core.config import BatcherConfig
+from igaming_platform_tpu.serve.scorer import ScoreRequest, TPUScoringEngine
+
+
+def test_score_batch_exceeding_compiled_size_chunks():
+    eng = TPUScoringEngine(batcher_config=BatcherConfig(batch_size=16, max_wait_ms=1))
+    try:
+        reqs = [ScoreRequest(f"chunk-{i}", amount=100 + i, tx_type="bet") for i in range(53)]
+        responses = eng.score_batch(reqs)
+        assert len(responses) == 53
+        assert all(r.action in ("approve", "review", "block") for r in responses)
+        # Rows map back to their own requests.
+        assert responses[7].features.tx_amount == 107
+        assert responses[52].features.tx_amount == 152
+    finally:
+        eng.close()
