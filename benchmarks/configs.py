@@ -34,18 +34,41 @@ def config1_single_txn_latency(n_requests: int = 200, batch_size: int = 256) -> 
             engine.score(ScoreRequest(f"acct-{i % 32}", amount=1000 + i, tx_type="deposit"))
             lat.append((time.perf_counter() - t0) * 1000.0)
         lat = np.array(lat[10:])  # drop warm-up
+
+        # Device-step latency for the same compiled program, measured
+        # separately: on a directly-attached TPU the end-to-end number is
+        # device step + batching window; on a tunneled dev chip the
+        # end-to-end figure is dominated by the tunnel's D2H round-trip
+        # (~65 ms floor for ANY readback, even a scalar), which is
+        # environment, not architecture.
+        import jax
+
+        from igaming_platform_tpu.core.features import NUM_FEATURES
+
+        x = np.zeros((batch_size, NUM_FEATURES), dtype=np.float32)
+        bl = np.zeros((batch_size,), dtype=bool)
+        dev = []
+        for _ in range(30):
+            t0 = time.perf_counter()
+            jax.block_until_ready(engine.score_arrays(x, bl))
+            dev.append((time.perf_counter() - t0) * 1000.0)
+        dev = np.array(dev[5:])
         return {
             "metric": "single_txn_score_latency_p99_ms",
             "value": round(float(np.percentile(lat, 99)), 3),
             "unit": "ms",
             "p50_ms": round(float(np.percentile(lat, 50)), 3),
+            "device_step_p99_ms": round(float(np.percentile(dev, 99)), 3),
+            "device_step_p50_ms": round(float(np.percentile(dev, 50)), 3),
             "requests": int(lat.size),
         }
     finally:
         engine.close()
 
 
-def config2_replay_throughput(n_events: int = 10_000, batch_size: int = 1024) -> dict:
+def config2_replay_throughput(
+    n_events: int = 10_000, batch_size: int = 2048, pipeline_depth: int = 8
+) -> dict:
     from igaming_platform_tpu.core.config import BatcherConfig
     from igaming_platform_tpu.serve.bridge import ScoringBridge
     from igaming_platform_tpu.serve.events import default_broker, new_transaction_event
@@ -53,16 +76,18 @@ def config2_replay_throughput(n_events: int = 10_000, batch_size: int = 1024) ->
 
     rng = np.random.default_rng(0)
     tx_types = ("deposit", "withdraw", "bet")
-    events = [
-        new_transaction_event("transaction.completed", {
-            "id": f"t{i}",
-            "account_id": f"acct-{int(rng.integers(0, 500))}",
-            "type": tx_types[int(rng.integers(0, 3))],
-            "amount": int(rng.integers(100, 100_000)),
-            "status": "completed",
-        })
-        for i in range(n_events)
-    ]
+
+    def make_events(n: int, tag: str) -> list:
+        return [
+            new_transaction_event("transaction.completed", {
+                "id": f"{tag}{i}",
+                "account_id": f"acct-{int(rng.integers(0, 500))}",
+                "type": tx_types[int(rng.integers(0, 3))],
+                "amount": int(rng.integers(100, 100_000)),
+                "status": "completed",
+            })
+            for i in range(n)
+        ]
 
     from igaming_platform_tpu.serve.native_store import best_feature_store
 
@@ -72,7 +97,13 @@ def config2_replay_throughput(n_events: int = 10_000, batch_size: int = 1024) ->
     )
     bridge = ScoringBridge(engine, default_broker(), publish_risk_events=False)
     try:
-        stats = bridge.replay(events, batch_size=batch_size)
+        # Warm the transfer pipeline (device program is already AOT-warmed
+        # at engine startup; the first few D2H readbacks establish the
+        # transfer path) — the measured replay is the steady serving state.
+        bridge.replay(make_events(4 * batch_size, "w"), batch_size=batch_size,
+                      pipeline_depth=pipeline_depth)
+        stats = bridge.replay(make_events(n_events, "t"), batch_size=batch_size,
+                              pipeline_depth=pipeline_depth)
         return {
             "metric": "replay_fraud_score_txns_per_sec",
             "value": round(stats["txns_per_sec"], 1),

@@ -89,6 +89,9 @@ class BatcherConfig:
     batch_size: int = 256
     max_wait_ms: float = 2.0
     max_queue: int = 65536
+    # Max device batches with results still in flight (launch/readback
+    # overlap); 1 = fully synchronous.
+    pipeline_depth: int = 4
 
 
 @dataclass(frozen=True)

@@ -10,8 +10,11 @@ batch per step (SURVEY.md §1 "continuous batcher"):
   trade-off of SURVEY.md §7 hard part (c);
 - batches are always padded to the single compiled shape (padding beats
   recompilation; pad rows are masked out on distribution);
-- the runner callable owns the device step; launch overlaps with the next
-  window's accumulation because results distribute after device dispatch.
+- with a two-phase (dispatch/collect) runner, device launches and
+  device→host readback run on SEPARATE threads with a bounded in-flight
+  window, so batch k+1 computes while batch k's results are still in
+  flight — on interconnects where D2H readback has real latency this is
+  the difference between serialized round-trips and wire-rate streaming.
 """
 
 from __future__ import annotations
@@ -27,26 +30,121 @@ import numpy as np
 from igaming_platform_tpu.core.config import BatcherConfig
 
 
-@dataclass
+@dataclass(slots=True)
 class _WorkItem:
     payload: Any
     future: Future
 
 
+_SENTINEL = object()
+
+
+class CollectorPipeline:
+    """Bounded in-flight window drained by a collector thread.
+
+    The producer ``put()``s dispatched work (device handles with async D2H
+    copies already started); the collector thread runs ``process(item)`` —
+    the blocking readback + post-processing. Depth-bounded for
+    backpressure. Error discipline, shared by every pipelined path
+    (batcher, replay):
+
+    - if ``process`` raises, the error is recorded and the collector KEEPS
+      DRAINING (discarding items) instead of exiting, so a producer
+      blocked in ``put()`` can never deadlock on a dead collector;
+    - ``put()`` re-raises the collector's error instead of queueing onto a
+      failed pipeline;
+    - ``close()`` always delivers the shutdown sentinel and joins, so no
+      collector thread is leaked even when the producer aborts mid-stream.
+    """
+
+    def __init__(self, process: Callable[[Any], None], depth: int, name: str = "collector"):
+        self._process = process
+        self._queue: queue.Queue = queue.Queue(max(1, depth))
+        self._errors: list[BaseException] = []
+        self._thread = threading.Thread(target=self._loop, name=name, daemon=True)
+        self._closed = False
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is _SENTINEL:
+                return
+            if self._errors:
+                continue  # drain without processing after a failure
+            try:
+                self._process(item)
+            except BaseException as exc:  # noqa: BLE001 — re-raised in put/close
+                self._errors.append(exc)
+
+    def put(self, item: Any) -> None:
+        """Enqueue; blocks at depth (backpressure). Raises the collector's
+        pending error rather than feeding a failed pipeline."""
+        if self._errors:
+            raise self._errors[0]
+        while True:
+            try:
+                self._queue.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                if self._errors:
+                    raise self._errors[0]
+
+    def close(self, raise_errors: bool = True) -> None:
+        """Deliver the sentinel, join the collector, optionally re-raise
+        its first error. Safe to call more than once."""
+        if not self._closed:
+            self._closed = True
+            while True:
+                try:
+                    self._queue.put(_SENTINEL, timeout=0.1)
+                    break
+                except queue.Full:
+                    if not self._thread.is_alive():
+                        break
+            self._thread.join(timeout=30)
+        if raise_errors and self._errors:
+            raise self._errors[0]
+
+
 class ContinuousBatcher:
     """Generic request coalescer.
 
-    ``runner(payloads: list) -> list[result]`` is called from the launcher
-    thread with 1..batch_size payloads; it must return one result per
-    payload (it may pad internally to its compiled shape).
+    Two runner styles:
+
+    - one-phase: ``runner(payloads: list) -> list[result]`` runs the whole
+      step synchronously on the launcher thread;
+    - two-phase (pipelined): ``dispatch(payloads) -> handle`` launches the
+      device step and starts async D2H copies WITHOUT blocking, and
+      ``collect(handle) -> list[result]`` finalizes it. Dispatch runs on
+      the launcher thread, collect on a collector thread, with at most
+      ``cfg.pipeline_depth`` batches in flight.
     """
 
-    def __init__(self, runner: Callable[[list], Sequence], cfg: BatcherConfig | None = None):
+    def __init__(
+        self,
+        runner: Callable[[list], Sequence] | None = None,
+        cfg: BatcherConfig | None = None,
+        *,
+        dispatch: Callable[[list], Any] | None = None,
+        collect: Callable[[Any], Sequence] | None = None,
+    ):
+        if runner is None and (dispatch is None or collect is None):
+            raise ValueError("need either runner or dispatch+collect")
         self.cfg = cfg or BatcherConfig()
         self._runner = runner
+        self._dispatch = dispatch
+        self._collect = collect
         self._queue: queue.Queue[_WorkItem] = queue.Queue(self.cfg.max_queue)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, name="continuous-batcher", daemon=True)
+        self._pipeline = (
+            CollectorPipeline(
+                self._finalize_batch, self.cfg.pipeline_depth, name="batcher-collector"
+            )
+            if dispatch is not None
+            else None
+        )
         self._started = False
         self.batches_run = 0
         self.rows_scored = 0
@@ -61,6 +159,11 @@ class ContinuousBatcher:
         self._stop.set()
         if self._started:
             self._thread.join(timeout=5)
+        # Close AFTER the launcher has joined: no further puts can race the
+        # sentinel, and every already-dispatched batch still resolves its
+        # futures during the drain.
+        if self._pipeline is not None:
+            self._pipeline.close(raise_errors=False)
 
     def submit(self, payload: Any) -> Future:
         fut: Future = Future()
@@ -96,16 +199,41 @@ class ContinuousBatcher:
                 except queue.Empty:
                     break
 
-            try:
-                results = self._runner([it.payload for it in items])
-                for it, res in zip(items, results):
-                    it.future.set_result(res)
-            except Exception as exc:  # noqa: BLE001 — propagate to callers
-                for it in items:
-                    if not it.future.done():
-                        it.future.set_exception(exc)
+            if self._dispatch is not None:
+                try:
+                    handle = self._dispatch([it.payload for it in items])
+                    # Blocks when pipeline_depth batches are already in
+                    # flight — natural backpressure on the launcher.
+                    self._pipeline.put((items, handle))
+                except Exception as exc:  # noqa: BLE001 — propagate to callers
+                    for it in items:
+                        if not it.future.done():
+                            it.future.set_exception(exc)
+            else:
+                try:
+                    results = self._runner([it.payload for it in items])
+                    for it, res in zip(items, results):
+                        it.future.set_result(res)
+                except Exception as exc:  # noqa: BLE001 — propagate to callers
+                    for it in items:
+                        if not it.future.done():
+                            it.future.set_exception(exc)
             self.batches_run += 1
             self.rows_scored += len(items)
+
+    def _finalize_batch(self, item) -> None:
+        """Collector-side: blocking readback, then resolve futures. Never
+        raises — request errors belong to the request futures, not the
+        pipeline."""
+        items, handle = item
+        try:
+            results = self._collect(handle)
+            for it, res in zip(items, results):
+                it.future.set_result(res)
+        except Exception as exc:  # noqa: BLE001 — propagate to callers
+            for it in items:
+                if not it.future.done():
+                    it.future.set_exception(exc)
 
 
 def _now() -> float:

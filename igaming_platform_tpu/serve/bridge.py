@@ -166,52 +166,86 @@ class ScoringBridge:
 
     # -- offline replay (BASELINE config 2) ----------------------------------
 
-    def replay(self, events: Iterable[Event], batch_size: int | None = None) -> dict:
+    def replay(
+        self,
+        events: Iterable[Event],
+        batch_size: int | None = None,
+        pipeline_depth: int = 4,
+    ) -> dict:
         """Replay a trace through feature-update + batched scoring.
 
         Unlike the live path (which rides the continuous batcher), replay
         slices the trace into direct device batches and post-processes
         results as arrays — per-row Python happens only for the rare rows
         that publish outcome events (blocked / high-score).
+
+        The host loop (gather → dispatch → feature write-back) runs ahead
+        of device→host readback: dispatched batches park in a bounded
+        in-flight queue with async copies while a collector thread does the
+        blocking readback + outcome publishing, so readback latency
+        overlaps the next batches' gather/compute instead of serializing
+        with it (device_get releases the GIL while it waits). Scoring
+        semantics are unchanged — batch k+1's gather still happens after
+        batch k's write-back (score on pre-transaction state, update after,
+        engine.go:262 vs :486-488); only the *result readback* is deferred.
+        ``pipeline_depth`` bounds the in-flight batches (0 = synchronous).
         """
         import time as _time
 
+        import jax
         import numpy as np
 
         from igaming_platform_tpu.core.enums import ACTION_BLOCK, decode_reason_mask
-        from igaming_platform_tpu.serve.batcher import pad_batch
+        from igaming_platform_tpu.serve.batcher import CollectorPipeline
 
-        batch_size = batch_size or self.engine.batch_size
+        # Chunks ride the engine's single compiled shape (padding beats
+        # recompilation), so the slice size cannot exceed it.
+        batch_size = min(batch_size or self.engine.batch_size, self.engine.batch_size)
+        store = self.engine.features
+        if hasattr(store, "gather_columns") and hasattr(store, "update_columns"):
+            return self._replay_columnar(events, batch_size, pipeline_depth)
         pending: list[tuple[Event, ScoreRequest]] = []
         scored = 0
         blocked = 0
         start = _time.monotonic()
 
-        def flush():
+        def postprocess(item) -> None:
             nonlocal scored, blocked
-            if not pending:
-                return
-            n = len(pending)
-            x, bl = self.engine.features.gather_batch([r for _, r in pending])
-            chunk = pending[:]
-            xp, _ = pad_batch(x, batch_size)
-            blp, _ = pad_batch(bl, batch_size)
-            out = self.engine.score_arrays(xp, blp)
-            scores = np.asarray(out["score"][:n])
-            actions = np.asarray(out["action"][:n])
-            masks = np.asarray(out["reason_mask"][:n])
+            chunk, out = item
+            n = len(chunk)
+            host = jax.device_get(out)
+            scores = np.asarray(host["score"][:n])
+            actions = np.asarray(host["action"][:n])
+            masks = np.asarray(host["reason_mask"][:n])
 
             is_blocked = actions == ACTION_BLOCK
             blocked += int(is_blocked.sum())
             if self.publish_risk_events:
                 notable = np.nonzero(is_blocked | (scores >= self.high_score_threshold))[0]
                 for i in notable:
-                    ev, req = pending[i]
+                    ev, req = chunk[i]
                     action = "block" if is_blocked[i] else "review"
                     reasons = [r.value for r in decode_reason_mask(int(masks[i]))]
                     self._publish_outcomes(ev, req, int(scores[i]), action, reasons)
             scored += n
+
+        pipeline = (
+            CollectorPipeline(postprocess, pipeline_depth, name="replay-collector")
+            if pipeline_depth > 0
+            else None
+        )
+
+        def flush():
+            if not pending:
+                return
+            chunk = pending[:]
             pending.clear()
+            x, bl = self.engine.features.gather_batch([r for _, r in chunk])
+            out, _ = self.engine._launch_device(x, bl)
+            if pipeline is not None:
+                pipeline.put((chunk, out))  # blocks at depth — backpressure
+            else:
+                postprocess((chunk, out))
             # Post-score feature write-back, one native call per chunk when
             # the store supports batched ingest.
             update_batch = getattr(self.engine.features, "update_batch", None)
@@ -234,16 +268,144 @@ class ScoringBridge:
                         device_id=te.device_id, timestamp=te.timestamp,
                     )
 
-        for event in events:
-            req = self._event_to_request(event)
-            if req is None:
-                if not self._ingest_only(event):
+        try:
+            for event in events:
+                req = self._event_to_request(event)
+                if req is None:
+                    if not self._ingest_only(event):
+                        self.events_skipped += 1
+                    continue
+                pending.append((event, req))
+                if len(pending) >= batch_size:
+                    flush()
+            flush()
+        except BaseException:
+            # Producer failed: still reap the collector (drain + join) so no
+            # thread or pinned device buffer outlives this call.
+            if pipeline is not None:
+                pipeline.close(raise_errors=False)
+            raise
+        if pipeline is not None:
+            pipeline.close()  # drains remaining batches; re-raises collector errors
+        elapsed = _time.monotonic() - start
+        return {
+            "events_scored": scored,
+            "blocked": blocked,
+            "elapsed_s": elapsed,
+            "txns_per_sec": scored / elapsed if elapsed > 0 else 0.0,
+        }
+
+    def _replay_columnar(self, events: Iterable[Event], batch_size: int, pipeline_depth: int) -> dict:
+        """Columnar replay: event fields parse straight into parallel
+        columns (no per-row ScoreRequest/TransactionEvent objects), the
+        store gathers/ingests whole columns in one native call each, and a
+        collector thread hides device→host readback. Semantics match the
+        object path: score on pre-transaction state, write back after;
+        non-scored money events (win/refund/bonus) fold in immediately.
+        """
+        import time as _time
+
+        import jax
+        import numpy as np
+
+        from igaming_platform_tpu.core.enums import ACTION_BLOCK, decode_reason_mask
+        from igaming_platform_tpu.serve.batcher import CollectorPipeline
+
+        store = self.engine.features
+        scored = 0
+        blocked = 0
+        start = _time.monotonic()
+
+        # Parallel pending columns for the current chunk.
+        c_events: list[Event] = []
+        c_acct: list[str] = []
+        c_amt: list[int] = []
+        c_type: list[str] = []
+        c_ip: list[str] = []
+        c_dev: list[str] = []
+        c_ts: list[float] = []
+
+        def postprocess(item) -> None:
+            nonlocal scored, blocked
+            chunk, out = item
+            evs, accts, amts, types, ips, devs = chunk
+            n = len(evs)
+            host = jax.device_get(out)
+            scores = np.asarray(host["score"][:n])
+            actions = np.asarray(host["action"][:n])
+            masks = np.asarray(host["reason_mask"][:n])
+            is_blocked = actions == ACTION_BLOCK
+            blocked += int(is_blocked.sum())
+            if self.publish_risk_events:
+                notable = np.nonzero(is_blocked | (scores >= self.high_score_threshold))[0]
+                for i in notable:
+                    req = ScoreRequest(
+                        account_id=accts[i], amount=amts[i], tx_type=types[i],
+                        ip=ips[i], device_id=devs[i],
+                    )
+                    action = "block" if is_blocked[i] else "review"
+                    reasons = [r.value for r in decode_reason_mask(int(masks[i]))]
+                    self._publish_outcomes(evs[i], req, int(scores[i]), action, reasons)
+            scored += n
+
+        pipeline = (
+            CollectorPipeline(postprocess, pipeline_depth, name="replay-collector")
+            if pipeline_depth > 0
+            else None
+        )
+
+        def flush() -> None:
+            if not c_events:
+                return
+            chunk = (c_events[:], c_acct[:], c_amt[:], c_type[:], c_ip[:], c_dev[:])
+            ts = c_ts[:]
+            c_events.clear(); c_acct.clear(); c_amt.clear()
+            c_type.clear(); c_ip.clear(); c_dev.clear(); c_ts.clear()
+            x, bl = store.gather_columns(chunk[1], chunk[2], chunk[3], ips=chunk[4], devices=chunk[5])
+            out, _ = self.engine._launch_device(x, bl)
+            if pipeline is not None:
+                pipeline.put((chunk, out))  # blocks at depth — backpressure
+            else:
+                postprocess((chunk, out))
+            store.update_columns(chunk[1], chunk[2], chunk[3], chunk[4], chunk[5], ts)
+            if self.abuse_detector is not None:
+                for i in range(len(ts)):
+                    self.abuse_detector.record_event(
+                        chunk[1][i], chunk[2][i], chunk[3][i],
+                        device_id=chunk[5][i], timestamp=ts[i],
+                    )
+
+        money_types = _MONEY_EVENT_TYPES
+        try:
+            for event in events:
+                if event.type not in money_types:
                     self.events_skipped += 1
-                continue
-            pending.append((event, req))
-            if len(pending) >= batch_size:
-                flush()
-        flush()
+                    continue
+                data = event.data
+                account_id = data.get("account_id") or event.aggregate_id
+                if not account_id:
+                    self.events_skipped += 1
+                    continue
+                tx_type = data.get("type", "deposit")
+                if tx_type in ("deposit", "withdraw", "bet"):
+                    c_events.append(event)
+                    c_acct.append(str(account_id))
+                    c_amt.append(int(data.get("amount", 0)))
+                    c_type.append(tx_type)
+                    c_ip.append(str(data.get("ip", "")))
+                    c_dev.append(str(data.get("device_id", "")))
+                    c_ts.append(event.timestamp)
+                    if len(c_events) >= batch_size:
+                        flush()
+                elif not self._ingest_only(event):
+                    self.events_skipped += 1
+            flush()
+        except BaseException:
+            if pipeline is not None:
+                pipeline.close(raise_errors=False)
+            raise
+        if pipeline is not None:
+            pipeline.close()  # drains remaining batches; re-raises collector errors
         elapsed = _time.monotonic() - start
         return {
             "events_scored": scored,

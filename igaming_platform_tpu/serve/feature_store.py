@@ -37,7 +37,7 @@ SECONDS_1H = 3600
 SESSION_TTL = 1800  # 30 min sliding session window (redis_store.go:157-160)
 
 
-@dataclass
+@dataclass(slots=True)
 class TransactionEvent:
     """Feature-update payload (scoring engine TransactionEvent, engine.go:143-150)."""
 

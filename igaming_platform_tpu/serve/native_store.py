@@ -254,6 +254,38 @@ class NativeFeatureStore:
             )
         return x, bl
 
+    # -- columnar fast path (replay/ingest: no per-row request objects) ------
+
+    def gather_columns(self, account_ids, amounts, tx_types,
+                       ips=None, devices=None, now: float | None = None):
+        """[B,30] gather straight from parallel columns — the per-row
+        ScoreRequest objects of gather_batch() skipped entirely."""
+        n = len(account_ids)
+        x = np.zeros((n, NUM_FEATURES), dtype=np.float32)
+        self._fill(x, account_ids, amounts, tx_types, now)
+        bl = np.zeros((n,), dtype=bool)
+        if any(self._blacklists.values()):
+            dev_bl = self._blacklists["device"]
+            ip_bl = self._blacklists["ip"]
+            for i in range(n):
+                d = devices[i] if devices is not None else ""
+                p = ips[i] if ips is not None else ""
+                bl[i] = (bool(d) and d in dev_bl) or (bool(p) and p in ip_bl)
+        return x, bl
+
+    def update_columns(self, account_ids, amounts, tx_types, ips, devices, timestamps) -> None:
+        """Batched ingest from parallel columns: one native call."""
+        n = len(account_ids)
+        if n == 0:
+            return
+        idxs = np.fromiter((self._idx(a) for a in account_ids), np.int32, n)
+        ts = np.asarray(timestamps, dtype=np.float64)
+        amts = np.fromiter(amounts, np.int64, n)
+        types = np.fromiter((_TX_TYPE_CODES.get(t, 4) for t in tx_types), np.int32, n)
+        dev = np.fromiter((_hash64(d) for d in devices), np.uint64, n)
+        ip = np.fromiter((_hash64(i) for i in ips), np.uint64, n)
+        self._lib.fs_update_batch(self._handle, n, idxs, ts, amts, types, dev, ip)
+
     def num_accounts(self) -> int:
         with self._ids_lock:
             return len(self._ids)

@@ -34,7 +34,7 @@ from igaming_platform_tpu.serve.batcher import ContinuousBatcher, pad_batch
 from igaming_platform_tpu.serve.feature_store import InMemoryFeatureStore, TransactionEvent
 
 
-@dataclass
+@dataclass(slots=True)
 class ScoreRequest:
     """Mirror of scoring.ScoreRequest (engine.go:40-53)."""
 
@@ -52,7 +52,7 @@ class ScoreRequest:
     ip_flags: tuple[int, int, int] | None = None  # (vpn, proxy, tor) when known
 
 
-@dataclass
+@dataclass(slots=True)
 class ScoreResponse:
     """Mirror of scoring.ScoreResponse (engine.go:56-64)."""
 
@@ -102,7 +102,11 @@ class TPUScoringEngine:
         else:
             self._fn = jax.jit(fn)
 
-        self._batcher = ContinuousBatcher(self._run_requests, batcher_config)
+        self._batcher = ContinuousBatcher(
+            cfg=batcher_config,
+            dispatch=self._dispatch_requests,
+            collect=self._collect_requests,
+        )
         if warmup:
             self.warmup()
         self._batcher.start()
@@ -110,10 +114,15 @@ class TPUScoringEngine:
     # -- lifecycle -----------------------------------------------------------
 
     def warmup(self) -> None:
-        """AOT-compile the serving shape before accepting traffic."""
+        """AOT-compile the serving shape before accepting traffic, and warm
+        the device->host readback path (first real transfer on some
+        interconnects is far costlier than steady state) so the first
+        request doesn't pay either cost."""
         x = np.zeros((self.batch_size, NUM_FEATURES), dtype=np.float32)
         bl = np.zeros((self.batch_size,), dtype=bool)
-        jax.block_until_ready(self._fn(self._params, x, bl, self._thresholds))
+        out = self._fn(self._params, x, bl, self._thresholds)
+        jax.block_until_ready(out)
+        jax.device_get(out)
 
     def close(self) -> None:
         self._batcher.stop()
@@ -169,13 +178,36 @@ class TPUScoringEngine:
         return responses
 
     def _run_device(self, x: np.ndarray, bl: np.ndarray):
+        out, n = self._launch_device(x, bl)
+        return jax.device_get(out), n
+
+    def _launch_device(self, x: np.ndarray, bl: np.ndarray):
+        """Dispatch the compiled step and start async D2H copies; returns
+        the on-device output dict WITHOUT blocking on readback."""
         n = x.shape[0]
         xp, _ = pad_batch(x, self.batch_size)
         blp, _ = pad_batch(bl, self.batch_size)
         with self._params_lock:
             params = self._params
         out = self._fn(params, xp, blp, self._thresholds)
-        return jax.device_get(out), n
+        for leaf in jax.tree.leaves(out):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        return out, n
+
+    # Two-phase batcher hooks: dispatch on the launcher thread, collect on
+    # the collector thread, so batch k+1 launches while batch k's results
+    # are still crossing the device->host link.
+
+    def _dispatch_requests(self, reqs: list[ScoreRequest]):
+        x, bl = self.features.gather_batch(reqs)
+        out, n = self._launch_device(x, bl)
+        return out, x, n
+
+    def _collect_requests(self, handle) -> list[ScoreResponse]:
+        out, x, n = handle
+        host = jax.device_get(out)
+        return [self._row_response(host, x, i) for i in range(n)]
 
     def _row_response(self, out: dict, x: np.ndarray, i: int) -> ScoreResponse:
         return ScoreResponse(
