@@ -47,7 +47,16 @@ class TransactionRepository(Protocol):
     def get_by_id(self, tx_id: str) -> Transaction | None: ...
     def get_by_idempotency_key(self, account_id: str, key: str) -> Transaction | None: ...
     def update(self, tx: Transaction) -> None: ...
-    def list_by_account(self, account_id: str, limit: int = 50, offset: int = 0) -> list[Transaction]: ...
+    def list_by_account(
+        self, account_id: str, limit: int = 50, offset: int = 0,
+        *, types: list[str] | None = None, from_ts: float | None = None,
+        to_ts: float | None = None, game_id: str | None = None,
+    ) -> list[Transaction]: ...
+    def count_by_account(
+        self, account_id: str,
+        *, types: list[str] | None = None, from_ts: float | None = None,
+        to_ts: float | None = None, game_id: str | None = None,
+    ) -> int: ...
 
 
 class LedgerRepository(Protocol):
@@ -142,11 +151,44 @@ class InMemoryTransactionRepository:
         with self._lock:
             self._by_id[tx.id] = tx
 
-    def list_by_account(self, account_id: str, limit: int = 50, offset: int = 0) -> list[Transaction]:
+    @staticmethod
+    def _matches(tx: Transaction, types, from_ts, to_ts, game_id) -> bool:
+        if types and tx.type.value not in types:
+            return False
+        if from_ts is not None and tx.created_at < from_ts:
+            return False
+        if to_ts is not None and tx.created_at >= to_ts:
+            return False
+        if game_id and tx.game_id != game_id:
+            return False
+        return True
+
+    def list_by_account(
+        self, account_id: str, limit: int = 50, offset: int = 0,
+        *, types: list[str] | None = None, from_ts: float | None = None,
+        to_ts: float | None = None, game_id: str | None = None,
+    ) -> list[Transaction]:
+        """History page, newest first; filters apply before pagination
+        (wallet.proto:172-186: types / from / to / game_id)."""
         with self._lock:
             ids = self._by_account.get(account_id, [])
-            newest_first = list(reversed(ids))
-            return [self._by_id[t] for t in newest_first[offset : offset + limit]]
+            newest_first = [
+                self._by_id[t] for t in reversed(ids)
+                if self._matches(self._by_id[t], types, from_ts, to_ts, game_id)
+            ]
+            return newest_first[offset : offset + limit]
+
+    def count_by_account(
+        self, account_id: str,
+        *, types: list[str] | None = None, from_ts: float | None = None,
+        to_ts: float | None = None, game_id: str | None = None,
+    ) -> int:
+        with self._lock:
+            ids = self._by_account.get(account_id, [])
+            return sum(
+                1 for t in ids
+                if self._matches(self._by_id[t], types, from_ts, to_ts, game_id)
+            )
 
 
 class InMemoryLedgerRepository:
@@ -410,14 +452,51 @@ class _SQLiteTransactions:
             )
             self._s._conn.commit()
 
-    def list_by_account(self, account_id: str, limit: int = 50, offset: int = 0) -> list[Transaction]:
+    @staticmethod
+    def _filter_sql(types, from_ts, to_ts, game_id) -> tuple[str, list]:
+        clauses, params = [], []
+        if types:
+            clauses.append(f"AND type IN ({','.join('?' * len(types))})")
+            params.extend(types)
+        if from_ts is not None:
+            clauses.append("AND created_at >= ?")
+            params.append(from_ts)
+        if to_ts is not None:
+            clauses.append("AND created_at < ?")
+            params.append(to_ts)
+        if game_id:
+            clauses.append("AND game_id = ?")
+            params.append(game_id)
+        return " ".join(clauses), params
+
+    def list_by_account(
+        self, account_id: str, limit: int = 50, offset: int = 0,
+        *, types: list[str] | None = None, from_ts: float | None = None,
+        to_ts: float | None = None, game_id: str | None = None,
+    ) -> list[Transaction]:
+        """History page, newest first; filters apply before pagination
+        (wallet.proto:172-186: types / from / to / game_id)."""
+        where, params = self._filter_sql(types, from_ts, to_ts, game_id)
         with self._s._lock:
             rows = self._s._conn.execute(
-                "SELECT * FROM transactions WHERE account_id=? ORDER BY created_at DESC, rowid DESC"
-                " LIMIT ? OFFSET ?",
-                (account_id, limit, offset),
+                f"SELECT * FROM transactions WHERE account_id=? {where}"
+                " ORDER BY created_at DESC, rowid DESC LIMIT ? OFFSET ?",
+                (account_id, *params, limit, offset),
             ).fetchall()
         return [self._row_to_tx(r) for r in rows]
+
+    def count_by_account(
+        self, account_id: str,
+        *, types: list[str] | None = None, from_ts: float | None = None,
+        to_ts: float | None = None, game_id: str | None = None,
+    ) -> int:
+        where, params = self._filter_sql(types, from_ts, to_ts, game_id)
+        with self._s._lock:
+            (n,) = self._s._conn.execute(
+                f"SELECT COUNT(*) FROM transactions WHERE account_id=? {where}",
+                (account_id, *params),
+            ).fetchone()
+        return int(n)
 
     def daily_stats(self, account_id: str, day_start: float, day_end: float) -> dict:
         """Aggregate per-day totals (postgres.go:285-308)."""

@@ -110,8 +110,24 @@ class WalletService:
     def get_balance(self, account_id: str) -> Account:
         return self.accounts.get_by_id(account_id)
 
-    def get_transaction_history(self, account_id: str, limit: int = 50, offset: int = 0):
-        return self.transactions.list_by_account(account_id, limit, offset)
+    def get_transaction_history(
+        self, account_id: str, limit: int = 50, offset: int = 0,
+        *, types: list[str] | None = None, from_ts: float | None = None,
+        to_ts: float | None = None, game_id: str | None = None,
+    ):
+        return self.transactions.list_by_account(
+            account_id, limit, offset,
+            types=types, from_ts=from_ts, to_ts=to_ts, game_id=game_id,
+        )
+
+    def count_transactions(
+        self, account_id: str,
+        *, types: list[str] | None = None, from_ts: float | None = None,
+        to_ts: float | None = None, game_id: str | None = None,
+    ) -> int:
+        return self.transactions.count_by_account(
+            account_id, types=types, from_ts=from_ts, to_ts=to_ts, game_id=game_id,
+        )
 
     # -- money movement -------------------------------------------------------
 

@@ -57,8 +57,15 @@ class CollectorPipeline:
       collector thread is leaked even when the producer aborts mid-stream.
     """
 
-    def __init__(self, process: Callable[[Any], None], depth: int, name: str = "collector"):
+    def __init__(
+        self,
+        process: Callable[[Any], None],
+        depth: int,
+        name: str = "collector",
+        on_discard: Callable[[Any], None] | None = None,
+    ):
         self._process = process
+        self._on_discard = on_discard
         self._queue: queue.Queue = queue.Queue(max(1, depth))
         self._errors: list[BaseException] = []
         self._thread = threading.Thread(target=self._loop, name=name, daemon=True)
@@ -71,7 +78,14 @@ class CollectorPipeline:
             if item is _SENTINEL:
                 return
             if self._errors:
-                continue  # drain without processing after a failure
+                # Drain without processing after a failure; give the owner a
+                # chance to resolve whatever the item carried (futures).
+                if self._on_discard is not None:
+                    try:
+                        self._on_discard(item)
+                    except Exception:  # noqa: BLE001 — discard is best-effort
+                        pass
+                continue
             try:
                 self._process(item)
             except BaseException as exc:  # noqa: BLE001 — re-raised in put/close
@@ -89,6 +103,12 @@ class CollectorPipeline:
             except queue.Full:
                 if self._errors:
                     raise self._errors[0]
+
+    def fail(self, exc: BaseException) -> None:
+        """Poison the pipeline: a producer blocked in ``put()`` raises
+        ``exc`` instead of waiting forever, and the collector drains
+        remaining items through ``on_discard`` without processing them."""
+        self._errors.append(exc)
 
     def close(self, raise_errors: bool = True) -> None:
         """Deliver the sentinel, join the collector, optionally re-raise
@@ -140,7 +160,10 @@ class ContinuousBatcher:
         self._thread = threading.Thread(target=self._loop, name="continuous-batcher", daemon=True)
         self._pipeline = (
             CollectorPipeline(
-                self._finalize_batch, self.cfg.pipeline_depth, name="batcher-collector"
+                self._finalize_batch,
+                self.cfg.pipeline_depth,
+                name="batcher-collector",
+                on_discard=self._discard_batch,
             )
             if dispatch is not None
             else None
@@ -159,6 +182,15 @@ class ContinuousBatcher:
         self._stop.set()
         if self._started:
             self._thread.join(timeout=5)
+            if self._thread.is_alive() and self._pipeline is not None:
+                # Launcher is wedged in pipeline.put() (collector stalled in
+                # a blocking readback): poison the pipeline so put() raises
+                # and the launcher fails its in-flight futures, instead of
+                # racing the shutdown sentinel and spinning forever.
+                self._pipeline.fail(
+                    RuntimeError("batcher stopped while collector stalled")
+                )
+                self._thread.join(timeout=5)
         # Close AFTER the launcher has joined: no further puts can race the
         # sentinel, and every already-dispatched batch still resolves its
         # futures during the drain.
@@ -220,6 +252,15 @@ class ContinuousBatcher:
                             it.future.set_exception(exc)
             self.batches_run += 1
             self.rows_scored += len(items)
+
+    def _discard_batch(self, item) -> None:
+        """Poisoned-pipeline drain: fail the batch's futures instead of
+        abandoning them."""
+        items, _ = item
+        exc = self._pipeline._errors[0] if self._pipeline._errors else RuntimeError("batcher pipeline failed")
+        for it in items:
+            if not it.future.done():
+                it.future.set_exception(exc)
 
     def _finalize_batch(self, item) -> None:
         """Collector-side: blocking readback, then resolve futures. Never

@@ -364,6 +364,12 @@ _RISK_METHODS = {
 # ---------------------------------------------------------------------------
 
 
+def _ts_to_float(ts) -> float:
+    """protobuf Timestamp → float epoch, keeping sub-second precision
+    (Transaction.created_at is a float; ToSeconds() would truncate)."""
+    return ts.seconds + ts.nanos / 1e9
+
+
 class WalletGrpcService:
     """wallet.v1.WalletService against platform.wallet.WalletService."""
 
@@ -557,13 +563,25 @@ class WalletGrpcService:
 
     def GetTransactionHistory(self, request, context):
         limit = min(request.limit or 50, 100)
-        txs = self.wallet.get_transaction_history(request.account_id, limit, request.offset)
-        if request.types:
-            txs = [t for t in txs if t.type.value in request.types]
+        # Filters apply before pagination (wallet.proto:172-186); `total`
+        # is the filtered count, `has_more` whether a further page exists.
+        filters = dict(
+            types=list(request.types) or None,
+            # The proto field is named `from` (wallet.proto:177) — a Python
+            # keyword, hence getattr. created_at is a float epoch, so keep
+            # the Timestamp's sub-second precision.
+            from_ts=_ts_to_float(getattr(request, "from")) if request.HasField("from") else None,
+            to_ts=_ts_to_float(request.to) if request.HasField("to") else None,
+            game_id=request.game_id or None,
+        )
+        txs = self.wallet.get_transaction_history(
+            request.account_id, limit, request.offset, **filters
+        )
+        total = self.wallet.count_transactions(request.account_id, **filters)
         return wallet_pb2.GetTransactionHistoryResponse(
             transactions=[self._tx_to_proto(t) for t in txs],
-            total=len(txs),
-            has_more=len(txs) == limit,
+            total=total,
+            has_more=request.offset + len(txs) < total,
         )
 
 

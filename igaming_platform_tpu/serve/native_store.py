@@ -279,7 +279,12 @@ class NativeFeatureStore:
         if n == 0:
             return
         idxs = np.fromiter((self._idx(a) for a in account_ids), np.int32, n)
+        # Same `timestamp or now` fallback as update()/update_batch(): an
+        # unset (zero) event timestamp must not land at epoch 0, where every
+        # sliding window would exclude it.
         ts = np.asarray(timestamps, dtype=np.float64)
+        if (ts == 0).any():
+            ts = np.where(ts == 0, time.time(), ts)
         amts = np.fromiter(amounts, np.int64, n)
         types = np.fromiter((_TX_TYPE_CODES.get(t, 4) for t in tx_types), np.int32, n)
         dev = np.fromiter((_hash64(d) for d in devices), np.uint64, n)

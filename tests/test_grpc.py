@@ -214,3 +214,38 @@ def test_wallet_idempotent_deposit_over_grpc(wallet_server):
     assert r1.transaction.id == r2.transaction.id
     bal = stub.GetBalance(wallet_pb2.GetBalanceRequest(account_id=acct.id))
     assert bal.balance == 500
+
+
+def test_wallet_history_filters_over_grpc(wallet_server):
+    stub, _ = wallet_server
+    acct = stub.CreateAccount(wallet_pb2.CreateAccountRequest(player_id="wp5")).account
+    stub.Deposit(wallet_pb2.DepositRequest(account_id=acct.id, amount=10_000, idempotency_key="d1"))
+    stub.Bet(wallet_pb2.BetRequest(account_id=acct.id, amount=1_000, idempotency_key="b1", game_id="g1"))
+    stub.Bet(wallet_pb2.BetRequest(account_id=acct.id, amount=1_000, idempotency_key="b2", game_id="g2"))
+
+    # Type filter applies before pagination; total is the filtered count.
+    hist = stub.GetTransactionHistory(wallet_pb2.GetTransactionHistoryRequest(
+        account_id=acct.id, types=["bet"], limit=1,
+    ))
+    assert len(hist.transactions) == 1
+    assert hist.transactions[0].type == "bet"
+    assert hist.total == 2
+    assert hist.has_more
+
+    by_game = stub.GetTransactionHistory(wallet_pb2.GetTransactionHistoryRequest(
+        account_id=acct.id, game_id="g1",
+    ))
+    assert [t.idempotency_key for t in by_game.transactions] == ["b1"]
+    assert not by_game.has_more
+
+    # Date-range filter: `to` at epoch 1 excludes everything.
+    from google.protobuf.timestamp_pb2 import Timestamp
+
+    req = wallet_pb2.GetTransactionHistoryRequest(account_id=acct.id)
+    getattr(req, "from").CopyFrom(Timestamp(seconds=1))
+    none_before = stub.GetTransactionHistory(wallet_pb2.GetTransactionHistoryRequest(
+        account_id=acct.id, to=Timestamp(seconds=1),
+    ))
+    assert none_before.total == 0
+    all_after = stub.GetTransactionHistory(req)
+    assert all_after.total == 3

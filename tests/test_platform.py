@@ -219,6 +219,47 @@ def test_history_pagination():
     assert len(txs) == 2
 
 
+def _history_filter_checks(w):
+    """Shared assertions for history filters (wallet.proto:172-186):
+    types / from / to / game_id apply before pagination; count matches."""
+    acct = w.create_account("pf1")
+    w.deposit(acct.id, 10_000, "d1")
+    w.bet(acct.id, 1_000, "b1", game_id="slots-1")
+    w.bet(acct.id, 1_000, "b2", game_id="slots-2")
+    w.win(acct.id, 500, "w1", game_id="slots-1")
+    w.withdraw(acct.id, 2_000, "wd1")
+
+    bets = w.get_transaction_history(acct.id, types=["bet"])
+    assert [t.type.value for t in bets] == ["bet", "bet"]
+    assert w.count_transactions(acct.id, types=["bet"]) == 2
+
+    # type filter applies BEFORE pagination: offset=1 within the bets
+    page = w.get_transaction_history(acct.id, limit=1, offset=1, types=["bet"])
+    assert len(page) == 1 and page[0].idempotency_key == "b1"
+
+    by_game = w.get_transaction_history(acct.id, game_id="slots-1")
+    assert {t.idempotency_key for t in by_game} == {"b1", "w1"}
+
+    cutoff = w.get_transaction_history(acct.id, types=["bet"])[0].created_at
+    older = w.get_transaction_history(acct.id, to_ts=cutoff)
+    assert all(t.created_at < cutoff for t in older)
+    newer_count = w.count_transactions(acct.id, from_ts=cutoff)
+    assert newer_count == 5 - len(older)
+
+
+def test_history_filters_in_memory():
+    _history_filter_checks(make_wallet())
+
+
+def test_history_filters_sqlite():
+    store = SQLiteStore()
+    w = WalletService(store.accounts, store.transactions, store.ledger)
+    try:
+        _history_filter_checks(w)
+    finally:
+        store.close()
+
+
 # -- sqlite backend ----------------------------------------------------------
 
 
