@@ -30,11 +30,12 @@ from igaming_platform_tpu.platform.repository import (
     InMemoryTransactionRepository,
     SQLiteStore,
 )
+from igaming_platform_tpu.platform.outbox import InMemoryOutbox, OutboxPublisher, OutboxRelay
 from igaming_platform_tpu.platform.risk_adapter import InProcessRiskGate
 from igaming_platform_tpu.platform.wallet import WalletConfig, WalletService
 from igaming_platform_tpu.serve.abuse import SequenceAbuseDetector
 from igaming_platform_tpu.serve.bridge import ScoringBridge
-from igaming_platform_tpu.serve.events import Consumer, Event, Publisher, default_broker
+from igaming_platform_tpu.serve.events import Consumer, Event, default_broker
 from igaming_platform_tpu.serve.scorer import TPUScoringEngine
 
 DEFAULT_RULES = "igaming_platform_tpu/platform/configs/bonus_rules.yaml"
@@ -79,9 +80,15 @@ class PlatformApp:
             accounts = InMemoryAccountRepository()
             transactions = InMemoryTransactionRepository()
             ledger = InMemoryLedgerRepository()
+        # Transactional outbox (init-db.sql:177-188, actually wired here):
+        # wallet events stage into the same store as the money movement and
+        # a relay delivers them at-least-once — a broker outage at commit
+        # time delays events instead of dropping them.
+        self.outbox = self.store if self.store is not None else InMemoryOutbox()
+        self.outbox_relay = OutboxRelay(self.outbox, self.broker)
         self.wallet = WalletService(
             accounts, transactions, ledger,
-            events=Publisher(self.broker),
+            events=OutboxPublisher(self.outbox),
             risk=self.risk_gate,
             config=WalletConfig(
                 risk_threshold_block=self.config.scoring.block_threshold,
@@ -165,6 +172,7 @@ class PlatformApp:
 
     def pump(self) -> None:
         """Drain event queues synchronously (deterministic for tests)."""
+        self.outbox_relay.flush()
         self.bridge.drain()
         self._bonus_consumer.drain(QUEUE_BONUS_PROCESSOR)
 

@@ -339,6 +339,17 @@ class SQLiteStore:
             self._conn.execute("UPDATE event_outbox SET published = 1 WHERE id = ?", (row_id,))
             self._conn.commit()
 
+    def outbox_purge_published(self, older_than_s: float = 3600.0) -> int:
+        """Delete published rows past the retention window so the table
+        doesn't grow one row per money movement forever."""
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM event_outbox WHERE published = 1 AND created_at < ?",
+                (time.time() - older_than_s,),
+            )
+            self._conn.commit()
+            return cur.rowcount
+
 
 class _SQLiteAccounts:
     def __init__(self, store: SQLiteStore):
@@ -449,6 +460,22 @@ class _SQLiteTransactions:
             self._s._conn.execute(
                 "UPDATE transactions SET status=?, completed_at=?, risk_score=? WHERE id=?",
                 (t.status.value, t.completed_at, t.risk_score, t.id),
+            )
+            self._s._conn.commit()
+
+    def update_with_event(self, t: Transaction, exchange: str, routing_key: str, payload: str) -> None:
+        """Transaction-row update + outbox stage in ONE commit — the atomic
+        pair the transactional-outbox pattern requires (a crash can no
+        longer complete the transaction without staging its event)."""
+        with self._s._lock:
+            self._s._conn.execute(
+                "UPDATE transactions SET status=?, completed_at=?, risk_score=? WHERE id=?",
+                (t.status.value, t.completed_at, t.risk_score, t.id),
+            )
+            self._s._conn.execute(
+                "INSERT INTO event_outbox (exchange, routing_key, payload, published, created_at)"
+                " VALUES (?,?,?,0,?)",
+                (exchange, routing_key, payload, time.time()),
             )
             self._s._conn.commit()
 

@@ -24,7 +24,8 @@ from igaming_platform_tpu.platform.repository import (
     SQLiteStore,
 )
 from igaming_platform_tpu.platform.wallet import WalletConfig, WalletService
-from igaming_platform_tpu.serve.events import InMemoryBroker, Publisher, default_broker
+from igaming_platform_tpu.platform.outbox import InMemoryOutbox, OutboxPublisher, OutboxRelay
+from igaming_platform_tpu.serve.events import InMemoryBroker, default_broker
 from igaming_platform_tpu.serve.grpc_server import (
     WalletGrpcService,
     graceful_stop,
@@ -70,9 +71,15 @@ class WalletServer:
 
             risk_gate = GrpcRiskGate(self.config.risk_service_addr)
 
+        # Transactional outbox: events stage durably with the money movement
+        # (SQLite deployments share the store; in-memory gets the analog) and
+        # a background relay delivers them at-least-once.
+        self.outbox = self.store if self.store is not None else InMemoryOutbox()
+        self.outbox_relay = OutboxRelay(self.outbox, self.broker)
+        self.outbox_relay.start()
         self.wallet = WalletService(
             accounts, transactions, ledger,
-            events=Publisher(self.broker),
+            events=OutboxPublisher(self.outbox),
             risk=risk_gate,
             config=WalletConfig(
                 risk_threshold_block=self.config.risk_threshold_block,
@@ -123,6 +130,8 @@ class WalletServer:
         self._stopped.set()
         graceful_stop(self.grpc_server, self.health, grace)
         self.http_server.shutdown()
+        # Final drain before the store closes so accepted ops' events ship.
+        self.outbox_relay.stop(drain=True)
         if self.store is not None:
             self.store.close()
 

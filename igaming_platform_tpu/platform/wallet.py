@@ -44,6 +44,7 @@ from igaming_platform_tpu.platform.domain import (
     Transaction,
     new_id,
 )
+from igaming_platform_tpu.platform.outbox import OutboxPublisher
 from igaming_platform_tpu.serve.events import Event, Publisher, new_transaction_event
 
 
@@ -385,8 +386,7 @@ class WalletService:
             raise
         self._ledger_entry(tx, description)
         tx.complete()
-        self.transactions.update(tx)
-        self._publish(new_transaction_event(event_type.value, {
+        self._complete_and_publish(tx, new_transaction_event(event_type.value, {
             "id": tx.id, "account_id": tx.account_id, "type": tx.type.value,
             "amount": tx.amount, "balance_before": tx.balance_before,
             "balance_after": tx.balance_after, "status": tx.status.value,
@@ -406,6 +406,26 @@ class WalletService:
             balance_after=tx.balance_after,
             description=description,
         ))
+
+    def _complete_and_publish(self, tx: Transaction, event: Event) -> None:
+        """Mark the transaction completed and emit its event.
+
+        When the event seam is the transactional outbox backed by the SAME
+        store as the transaction rows, the completion update and the event
+        stage commit atomically (update_with_event) — a crash cannot
+        complete the money movement without staging its event. Otherwise
+        (in-memory repos, direct broker) the two steps run sequentially.
+        """
+        atomic = (
+            isinstance(self.events, OutboxPublisher)
+            and hasattr(self.transactions, "update_with_event")
+            and getattr(self.transactions, "_s", None) is self.events.outbox
+        )
+        if atomic:
+            self.transactions.update_with_event(tx, EXCHANGE_WALLET, event.type, event.to_json())
+        else:
+            self.transactions.update(tx)
+            self._publish(event)
 
     def _publish(self, event: Event) -> None:
         if self.events is not None:

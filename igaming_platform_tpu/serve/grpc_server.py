@@ -562,7 +562,10 @@ class WalletGrpcService:
         return wallet_pb2.GetTransactionResponse(transaction=self._tx_to_proto(tx))
 
     def GetTransactionHistory(self, request, context):
-        limit = min(request.limit or 50, 100)
+        # Clamp both ends: a negative int32 limit would reach SQLite as
+        # LIMIT -1 (= unlimited) and dump the whole history.
+        limit = max(1, min(request.limit or 50, 100))
+        offset = max(0, request.offset)
         # Filters apply before pagination (wallet.proto:172-186); `total`
         # is the filtered count, `has_more` whether a further page exists.
         filters = dict(
@@ -575,13 +578,13 @@ class WalletGrpcService:
             game_id=request.game_id or None,
         )
         txs = self.wallet.get_transaction_history(
-            request.account_id, limit, request.offset, **filters
+            request.account_id, limit, offset, **filters
         )
         total = self.wallet.count_transactions(request.account_id, **filters)
         return wallet_pb2.GetTransactionHistoryResponse(
             transactions=[self._tx_to_proto(t) for t in txs],
             total=total,
-            has_more=request.offset + len(txs) < total,
+            has_more=offset + len(txs) < total,
         )
 
 

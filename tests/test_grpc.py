@@ -249,3 +249,19 @@ def test_wallet_history_filters_over_grpc(wallet_server):
     assert none_before.total == 0
     all_after = stub.GetTransactionHistory(req)
     assert all_after.total == 3
+
+
+def test_wallet_history_negative_limit_clamped(wallet_server):
+    """A negative int32 limit must not bypass the page cap (it would reach
+    SQLite as LIMIT -1 = unlimited)."""
+    stub, _ = wallet_server
+    acct = stub.CreateAccount(wallet_pb2.CreateAccountRequest(player_id="wp6")).account
+    for i in range(3):
+        stub.Deposit(wallet_pb2.DepositRequest(
+            account_id=acct.id, amount=1_000, idempotency_key=f"neg-{i}"))
+    hist = stub.GetTransactionHistory(wallet_pb2.GetTransactionHistoryRequest(
+        account_id=acct.id, limit=-1, offset=-5,
+    ))
+    assert len(hist.transactions) == 1  # clamped to the minimum page of 1
+    assert hist.total == 3
+    assert hist.has_more
