@@ -119,6 +119,9 @@ class WalletServer:
                 elif self.path == "/ready":
                     ready = not server_ref._stopped.is_set()
                     self._send(200 if ready else 503, json.dumps({"ready": ready}))
+                elif self.path == "/debug/spans":
+                    from igaming_platform_tpu.obs.tracing import DEFAULT_COLLECTOR
+                    self._send(200, DEFAULT_COLLECTOR.to_json())
                 else:
                     self._send(404, '{"error":"not found"}')
 

@@ -28,6 +28,7 @@ from typing import Callable
 import grpc
 
 from igaming_platform_tpu.obs.metrics import ServiceMetrics
+from igaming_platform_tpu.obs.tracing import span
 
 logger = logging.getLogger(__name__)
 
@@ -96,20 +97,28 @@ def _rpc(metrics: ServiceMetrics, method: str, fn: Callable):
 
     def handler(request, context):
         start = time.monotonic()
-        try:
-            resp = fn(request, context)
-            metrics.observe_rpc(method, start)
-            return resp
-        except RpcAbort as abort:
-            metrics.observe_rpc(method, start, code=abort.code.name)
-            context.abort(abort.code, abort.details)
-        except grpc.RpcError:
-            metrics.observe_rpc(method, start, code="ERROR")
-            raise
-        except Exception as exc:  # noqa: BLE001 — recovery interceptor
-            logger.exception("handler panic in %s", method)
-            metrics.observe_rpc(method, start, code="INTERNAL")
-            context.abort(grpc.StatusCode.INTERNAL, f"internal error: {exc}")
+        # Per-RPC host span (the OTel spans the reference deploys Jaeger
+        # for but never emits — SURVEY.md §5); status lands as an attribute
+        # so sampled traces show which calls aborted.
+        with span(f"rpc.{method}") as s:
+            try:
+                resp = fn(request, context)
+                metrics.observe_rpc(method, start)
+                s.attributes["code"] = "OK"
+                return resp
+            except RpcAbort as abort:
+                metrics.observe_rpc(method, start, code=abort.code.name)
+                s.attributes["code"] = abort.code.name
+                context.abort(abort.code, abort.details)
+            except grpc.RpcError:
+                metrics.observe_rpc(method, start, code="ERROR")
+                s.attributes["code"] = "ERROR"
+                raise
+            except Exception as exc:  # noqa: BLE001 — recovery interceptor
+                logger.exception("handler panic in %s", method)
+                metrics.observe_rpc(method, start, code="INTERNAL")
+                s.attributes["code"] = "INTERNAL"
+                context.abort(grpc.StatusCode.INTERNAL, f"internal error: {exc}")
 
     return handler
 

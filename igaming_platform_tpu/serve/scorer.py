@@ -29,6 +29,7 @@ from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
 from igaming_platform_tpu.core.enums import ReasonCode, action_from_code, decode_reason_mask
 from igaming_platform_tpu.core.features import NUM_FEATURES, FeatureVector
 from igaming_platform_tpu.models.ensemble import make_score_fn
+from igaming_platform_tpu.obs.tracing import annotate, span
 from igaming_platform_tpu.parallel.mesh import AXIS_DATA, validate_batch_for_mesh
 from igaming_platform_tpu.serve.batcher import ContinuousBatcher, pad_batch
 from igaming_platform_tpu.serve.feature_store import InMemoryFeatureStore, TransactionEvent
@@ -172,8 +173,10 @@ class TPUScoringEngine:
         responses: list[ScoreResponse] = []
         for start in range(0, len(reqs), self.batch_size):
             chunk = reqs[start : start + self.batch_size]
-            x, bl = self.features.gather_batch(chunk)
-            out, n = self._run_device(x, bl)
+            with span("score.gather", batch=len(chunk)):
+                x, bl = self.features.gather_batch(chunk)
+            with span("score.device", batch=len(chunk)), annotate("score_step"):
+                out, n = self._run_device(x, bl)
             responses.extend(self._row_response(out, x, i) for i in range(n))
         return responses
 
@@ -200,13 +203,20 @@ class TPUScoringEngine:
     # are still crossing the device->host link.
 
     def _dispatch_requests(self, reqs: list[ScoreRequest]):
-        x, bl = self.features.gather_batch(reqs)
-        out, n = self._launch_device(x, bl)
+        # Spans are per BATCH, not per request — tracing overhead stays off
+        # the per-transaction cost. The three stage names (gather/dispatch/
+        # readback) mirror the reference's goroutine fan-out + ONNX call
+        # (engine.go:326-417, :277-288) as host timeline segments.
+        with span("score.gather", batch=len(reqs)):
+            x, bl = self.features.gather_batch(reqs)
+        with span("score.dispatch", batch=len(reqs)), annotate("score_step"):
+            out, n = self._launch_device(x, bl)
         return out, x, n
 
     def _collect_requests(self, handle) -> list[ScoreResponse]:
         out, x, n = handle
-        host = jax.device_get(out)
+        with span("score.readback", batch=n):
+            host = jax.device_get(out)
         return [self._row_response(host, x, i) for i in range(n)]
 
     def _row_response(self, out: dict, x: np.ndarray, i: int) -> ScoreResponse:

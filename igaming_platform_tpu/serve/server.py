@@ -127,6 +127,9 @@ class RiskServer:
                 elif self.path == "/debug/thresholds":
                     block, review = server_ref.engine.get_thresholds()
                     self._send(200, json.dumps({"block": block, "review": review}))
+                elif self.path == "/debug/spans":
+                    from igaming_platform_tpu.obs.tracing import DEFAULT_COLLECTOR
+                    self._send(200, DEFAULT_COLLECTOR.to_json())
                 else:
                     self._send(404, '{"error":"not found"}')
 

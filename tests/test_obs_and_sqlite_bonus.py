@@ -79,3 +79,60 @@ def test_sqlite_bonus_repo_full_lifecycle():
     assert eng.expire_old_bonuses() == 1
     assert repo.get_by_id(b2.id).status == BonusStatus.EXPIRED
     store.close()
+
+
+def test_scorer_emits_stage_spans():
+    """The serving hot path emits gather/dispatch/readback spans per batch
+    (the OTel wiring the reference deploys Jaeger for but never emits)."""
+    from igaming_platform_tpu.core.config import BatcherConfig
+    from igaming_platform_tpu.obs.tracing import DEFAULT_COLLECTOR
+    from igaming_platform_tpu.serve.scorer import ScoreRequest, TPUScoringEngine
+
+    DEFAULT_COLLECTOR.drain()
+    engine = TPUScoringEngine(batcher_config=BatcherConfig(batch_size=8, max_wait_ms=1.0))
+    try:
+        engine.score(ScoreRequest("span-acct", amount=1000, tx_type="deposit"))
+        names = {s.name for s in DEFAULT_COLLECTOR.drain()}
+        assert {"score.gather", "score.dispatch", "score.readback"} <= names
+    finally:
+        engine.close()
+
+
+def test_rpc_handler_emits_span_with_status_code():
+    import grpc
+
+    from igaming_platform_tpu.obs.tracing import DEFAULT_COLLECTOR
+    from igaming_platform_tpu.proto_gen.wallet.v1 import wallet_pb2
+    from igaming_platform_tpu.platform.repository import (
+        InMemoryAccountRepository,
+        InMemoryLedgerRepository,
+        InMemoryTransactionRepository,
+    )
+    from igaming_platform_tpu.platform.wallet import WalletService
+    from igaming_platform_tpu.serve.grpc_server import (
+        WalletGrpcService,
+        make_wallet_stub,
+        serve_wallet,
+    )
+
+    wallet = WalletService(
+        InMemoryAccountRepository(), InMemoryTransactionRepository(),
+        InMemoryLedgerRepository(),
+    )
+    server, _, port = serve_wallet(WalletGrpcService(wallet), 0)
+    channel = grpc.insecure_channel(f"localhost:{port}")
+    stub = make_wallet_stub(channel)
+    try:
+        DEFAULT_COLLECTOR.drain()
+        stub.CreateAccount(wallet_pb2.CreateAccountRequest(player_id="span-p"))
+        try:
+            stub.GetAccount(wallet_pb2.GetAccountRequest(account_id="missing"))
+        except grpc.RpcError:
+            pass
+        spans = {s.name: s for s in DEFAULT_COLLECTOR.drain()}
+        assert spans["rpc.CreateAccount"].attributes["code"] == "OK"
+        assert spans["rpc.GetAccount"].attributes["code"] == "NOT_FOUND"
+        assert spans["rpc.CreateAccount"].duration_ms >= 0.0
+    finally:
+        channel.close()
+        server.stop(0)
