@@ -180,6 +180,19 @@ class ServiceMetrics:
             f"{service}_batch_occupancy", "Rows per device batch",
             buckets=(1, 8, 32, 64, 128, 256, 512, 1024),
         )
+        # Business-level series backing the Grafana dashboards the reference
+        # README promises (README.md:196-202) but ships no data for: per-type
+        # transaction flow (bonus conversion = bonus_grant rate vs deposit
+        # rate) and LTV segment assignment counts.
+        self.transactions_total = self.registry.counter(
+            f"{service}_transactions_total", "Completed transactions by type"
+        )
+        self.transaction_amount_cents = self.registry.counter(
+            f"{service}_transaction_amount_cents_total", "Transaction volume in cents by type"
+        )
+        self.ltv_segment_total = self.registry.counter(
+            f"{service}_ltv_segment_total", "LTV segment assignments by segment"
+        )
 
     def observe_rpc(self, method: str, start_time: float, code: str = "OK") -> None:
         self.requests_total.inc(method=method, code=code)

@@ -241,6 +241,7 @@ class RiskGrpcService:
         out = predict_batch_jit(self._ltv_row(request.account_id))
         ts = Timestamp()
         ts.GetCurrentTime()
+        self.metrics.ltv_segment_total.inc(segment=str(int(out["segment"][0])))
         return risk_pb2.PredictLTVResponse(
             account_id=request.account_id,
             predicted_ltv=float(out["ltv"][0]),
@@ -386,6 +387,13 @@ class WalletGrpcService:
         self.wallet = wallet
         self.metrics = metrics or ServiceMetrics("wallet")
 
+    def _record_txn(self, res) -> None:
+        """Per-type flow counters (count + cents volume) — the series the
+        bonus-conversion and throughput dashboards chart."""
+        tx = res.transaction
+        self.metrics.transactions_total.inc(type=tx.type.value)
+        self.metrics.transaction_amount_cents.inc(tx.amount, type=tx.type.value)
+
     def _tx_to_proto(self, tx) -> wallet_pb2.Transaction:
         from google.protobuf.timestamp_pb2 import Timestamp
 
@@ -491,6 +499,7 @@ class WalletGrpcService:
             )
         except WalletError as exc:
             self._domain_error(context, exc)
+        self._record_txn(res)
         return wallet_pb2.DepositResponse(
             transaction=self._tx_to_proto(res.transaction),
             new_balance=res.new_balance,
@@ -508,6 +517,7 @@ class WalletGrpcService:
             )
         except WalletError as exc:
             self._domain_error(context, exc)
+        self._record_txn(res)
         return wallet_pb2.WithdrawResponse(
             transaction=self._tx_to_proto(res.transaction),
             new_balance=res.new_balance,
@@ -527,6 +537,7 @@ class WalletGrpcService:
             )
         except WalletError as exc:
             self._domain_error(context, exc)
+        self._record_txn(res)
         return wallet_pb2.BetResponse(
             transaction=self._tx_to_proto(res.transaction),
             new_balance=res.new_balance,
@@ -546,6 +557,7 @@ class WalletGrpcService:
             )
         except WalletError as exc:
             self._domain_error(context, exc)
+        self._record_txn(res)
         return wallet_pb2.WinResponse(
             transaction=self._tx_to_proto(res.transaction), new_balance=res.new_balance
         )
@@ -560,6 +572,7 @@ class WalletGrpcService:
             )
         except WalletError as exc:
             self._domain_error(context, exc)
+        self._record_txn(res)
         return wallet_pb2.RefundResponse(
             transaction=self._tx_to_proto(res.transaction), new_balance=res.new_balance
         )

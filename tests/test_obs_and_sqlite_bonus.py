@@ -136,3 +136,75 @@ def test_rpc_handler_emits_span_with_status_code():
     finally:
         channel.close()
         server.stop(0)
+
+
+def test_transaction_type_counters_recorded_over_grpc():
+    """The wallet gRPC layer feeds the per-type flow counters the bonus
+    dashboard charts (wallet_transactions_total / _amount_cents_total)."""
+    import grpc
+
+    from igaming_platform_tpu.proto_gen.wallet.v1 import wallet_pb2
+    from igaming_platform_tpu.platform.repository import (
+        InMemoryAccountRepository,
+        InMemoryLedgerRepository,
+        InMemoryTransactionRepository,
+    )
+    from igaming_platform_tpu.platform.wallet import WalletService
+    from igaming_platform_tpu.serve.grpc_server import (
+        WalletGrpcService,
+        make_wallet_stub,
+        serve_wallet,
+    )
+
+    wallet = WalletService(
+        InMemoryAccountRepository(), InMemoryTransactionRepository(),
+        InMemoryLedgerRepository(),
+    )
+    svc = WalletGrpcService(wallet)
+    server, _, port = serve_wallet(svc, 0)
+    channel = grpc.insecure_channel(f"localhost:{port}")
+    stub = make_wallet_stub(channel)
+    try:
+        acct = stub.CreateAccount(wallet_pb2.CreateAccountRequest(player_id="m-p")).account
+        stub.Deposit(wallet_pb2.DepositRequest(account_id=acct.id, amount=10_000, idempotency_key="m-d"))
+        stub.Bet(wallet_pb2.BetRequest(account_id=acct.id, amount=2_500, idempotency_key="m-b"))
+        assert svc.metrics.transactions_total.value(type="deposit") == 1
+        assert svc.metrics.transactions_total.value(type="bet") == 1
+        assert svc.metrics.transaction_amount_cents.value(type="deposit") == 10_000
+        assert svc.metrics.transaction_amount_cents.value(type="bet") == 2_500
+        rendered = svc.metrics.registry.render_text()
+        assert 'wallet_transactions_total{type="deposit"} 1' in rendered
+    finally:
+        channel.close()
+        server.stop(0)
+
+
+def test_grafana_dashboards_are_valid_and_reference_real_series():
+    """Every provisioned dashboard parses and only charts metric families
+    the services actually export."""
+    import json
+    import re
+    from pathlib import Path
+
+    families = {
+        "grpc_requests_total", "grpc_request_duration_ms", "grpc_errors_total",
+        "risk_score", "txns_scored_total", "batch_occupancy",
+        "transactions_total", "transaction_amount_cents_total", "ltv_segment_total",
+    }
+    suffixes = ("", "_bucket", "_sum", "_count")
+    valid = {f"{svc}_{fam}{sfx}" for svc in ("risk", "wallet")
+             for fam in families for sfx in suffixes}
+
+    dashboards = sorted(Path("deploy/grafana/dashboards").glob("*.json"))
+    assert len(dashboards) == 5
+    for path in dashboards:
+        doc = json.loads(path.read_text())
+        assert doc["uid"] and doc["panels"], path.name
+        for p in doc["panels"]:
+            for t in p["targets"]:
+                for name in re.findall(r"[a-z][a-z0-9_]{4,}", t["expr"]):
+                    if name in ("histogram_quantile", "rate", "sum", "by", "le",
+                                "method", "code", "type", "segment", "job"):
+                        continue
+                    if re.fullmatch(r"(risk|wallet)_[a-z0-9_]+", name):
+                        assert name in valid, f"{path.name}: unknown series {name}"
