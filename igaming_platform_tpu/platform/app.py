@@ -90,6 +90,7 @@ class PlatformApp:
             accounts, transactions, ledger,
             events=OutboxPublisher(self.outbox),
             risk=self.risk_gate,
+            audit=self.store.audit if self.store is not None else None,
             config=WalletConfig(
                 risk_threshold_block=self.config.scoring.block_threshold,
                 risk_threshold_review=self.config.scoring.review_threshold,

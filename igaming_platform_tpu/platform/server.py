@@ -81,6 +81,7 @@ class WalletServer:
             accounts, transactions, ledger,
             events=OutboxPublisher(self.outbox),
             risk=risk_gate,
+            audit=self.store.audit if self.store is not None else None,
             config=WalletConfig(
                 risk_threshold_block=self.config.risk_threshold_block,
                 risk_threshold_review=self.config.risk_threshold_review,

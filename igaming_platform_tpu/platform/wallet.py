@@ -84,6 +84,7 @@ class WalletService:
         events: Publisher | None = None,
         risk: RiskGate | None = None,
         config: WalletConfig | None = None,
+        audit=None,
     ):
         self.accounts = accounts
         self.transactions = transactions
@@ -91,6 +92,9 @@ class WalletService:
         self.events = events
         self.risk = risk
         self.config = config or WalletConfig()
+        # audit(entity, entity_id, action, old, new) — the append-only
+        # audit_log of init-db.sql:191-204 (SQLiteStore.audit); None = no-op.
+        self.audit = audit
 
     # -- account management --------------------------------------------------
 
@@ -109,6 +113,23 @@ class WalletService:
         return account
 
     def get_balance(self, account_id: str) -> Account:
+        return self.accounts.get_by_id(account_id)
+
+    def set_account_status(self, account_id: str, status: AccountStatus, reason: str = "") -> Account:
+        """Back-office lifecycle op (suspend / reactivate / close).
+
+        The reference models the states (domain/models.go AccountStatus,
+        repository UpdateStatus) but exposes no operation that transitions
+        them; here the transition exists and is audit-logged with
+        old/new values (init-db.sql:191-204 audit_log semantics).
+        """
+        account = self.accounts.get_by_id(account_id)
+        old = account.status
+        if old == status:
+            return account
+        self.accounts.update_status(account_id, status)
+        self._audit("account", account_id, "status_change",
+                    old=old.value, new=f"{status.value}:{reason}" if reason else status.value)
         return self.accounts.get_by_id(account_id)
 
     def get_transaction_history(
@@ -307,6 +328,8 @@ class WalletService:
         forfeited = account.bonus
         if forfeited:
             self.accounts.update_balance(account.id, account.balance, 0, account.version)
+            self._audit("account", account_id, "bonus_forfeiture",
+                        old=str(forfeited), new="0")
         return forfeited
 
     # -- internals ------------------------------------------------------------
@@ -426,6 +449,13 @@ class WalletService:
         else:
             self.transactions.update(tx)
             self._publish(event)
+
+    def _audit(self, entity: str, entity_id: str, action: str, old: str = "", new: str = "") -> None:
+        if self.audit is not None:
+            try:
+                self.audit(entity, entity_id, action, old, new)
+            except Exception:  # noqa: BLE001 — auditing must not fail the op
+                pass
 
     def _publish(self, event: Event) -> None:
         if self.events is not None:

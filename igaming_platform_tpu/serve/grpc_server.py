@@ -91,6 +91,31 @@ class HealthServicer:
         return health_pb2.HealthCheckResponse(status=status)
 
 
+class _FixedWindowRateLimiter:
+    """Per-account fixed-window counter — the INCR+EXPIRE semantics of the
+    reference's CheckRateLimit (redis_store.go:196-203), enforced at the
+    RPC edge (the reference reads the limit from env but never calls it)."""
+
+    def __init__(self, per_minute: int):
+        self.per_minute = per_minute
+        self._lock = threading.Lock()
+        self._windows: dict[str, tuple[int, int]] = {}
+
+    def allow(self, account_id: str) -> bool:
+        if not self.per_minute:
+            return True
+        now_min = int(time.time() // 60)
+        with self._lock:
+            win, count = self._windows.get(account_id, (now_min, 0))
+            if win != now_min:
+                win, count = now_min, 0
+            count += 1
+            self._windows[account_id] = (win, count)
+            if len(self._windows) > 100_000:  # bound memory: drop stale windows
+                self._windows = {a: wc for a, wc in self._windows.items() if wc[0] == now_min}
+            return count <= self.per_minute
+
+
 def _rpc(metrics: ServiceMetrics, method: str, fn: Callable):
     """Wrap a handler with metrics + panic recovery (the interceptor chain
     of wallet/cmd/main.go:274-311 collapsed into one decorator)."""
@@ -139,16 +164,21 @@ def _unary(fn, req_cls, resp_cls):
 class RiskGrpcService:
     """risk.v1.RiskService against the TPU scoring engine + LTV + abuse."""
 
-    def __init__(self, engine, ltv_source=None, abuse_detector=None, metrics: ServiceMetrics | None = None):
+    def __init__(self, engine, ltv_source=None, abuse_detector=None, metrics: ServiceMetrics | None = None,
+                 rate_limit_per_minute: int = 0):
         """
         engine: serve.scorer.TPUScoringEngine
         ltv_source: callable(account_id) -> [25]-dim LTV feature row or None
         abuse_detector: callable(account_id, bonus_id) -> (score, signals, linked)
+        rate_limit_per_minute: per-account ScoreTransaction cap (0 disables;
+            redis_store.go:196-203 CheckRateLimit, enforced here rather than
+            declared-only as in the reference)
         """
         self.engine = engine
         self.ltv_source = ltv_source
         self.abuse_detector = abuse_detector
         self.metrics = metrics or ServiceMetrics("risk")
+        self._rate_limiter = _FixedWindowRateLimiter(rate_limit_per_minute)
 
     # -- scoring --
 
@@ -209,6 +239,11 @@ class RiskGrpcService:
         )
 
     def ScoreTransaction(self, request, context):
+        # Per-account scoring cap; the batch path (ScoreBatch / event
+        # replay) is internal and exempt.
+        if not self._rate_limiter.allow(request.account_id):
+            raise RpcAbort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                           "RATE_LIMITED: per-account scoring rate limit exceeded")
         resp = self.engine.score(self._request_from_proto(request))
         self.metrics.score_distribution.observe(resp.score)
         self.metrics.txns_scored_total.inc()

@@ -88,6 +88,7 @@ class RiskServer:
             self.engine,
             abuse_detector=lambda acct, bonus: self.abuse.check(acct, bonus),
             metrics=self.metrics,
+            rate_limit_per_minute=self.config.rate_limit_per_minute,
         )
         self.grpc_server, self.health, self.grpc_port = serve_risk(
             service, grpc_port if grpc_port is not None else self.config.grpc_port

@@ -265,3 +265,30 @@ def test_wallet_history_negative_limit_clamped(wallet_server):
     assert len(hist.transactions) == 1  # clamped to the minimum page of 1
     assert hist.total == 3
     assert hist.has_more
+
+
+def test_score_transaction_rate_limited():
+    """Per-account scoring cap returns RESOURCE_EXHAUSTED once exceeded;
+    other accounts are unaffected (fixed-window, per account)."""
+    from igaming_platform_tpu.core.config import BatcherConfig
+    from igaming_platform_tpu.serve.grpc_server import RiskGrpcService, make_risk_stub, serve_risk
+    from igaming_platform_tpu.serve.scorer import TPUScoringEngine
+
+    engine = TPUScoringEngine(batcher_config=BatcherConfig(batch_size=16, max_wait_ms=1))
+    server, _, port = serve_risk(RiskGrpcService(engine, rate_limit_per_minute=3), 0)
+    channel = grpc.insecure_channel(f"localhost:{port}")
+    stub = make_risk_stub(channel)
+    try:
+        req = lambda acct: risk_pb2.ScoreTransactionRequest(
+            account_id=acct, amount=1000, transaction_type="deposit")
+        for _ in range(3):
+            stub.ScoreTransaction(req("rl-acct"))
+        with pytest.raises(grpc.RpcError) as exc:
+            stub.ScoreTransaction(req("rl-acct"))
+        assert exc.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        # A different account still scores fine.
+        stub.ScoreTransaction(req("rl-other"))
+    finally:
+        channel.close()
+        server.stop(0)
+        engine.close()

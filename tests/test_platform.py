@@ -452,3 +452,62 @@ def test_wallet_bonus_integration_max_bet_gate():
         w.bet(acct.id, 1_000, "bet1", max_bet_check=gate)
     res = w.bet(acct.id, 400, "bet2", max_bet_check=gate)
     assert res.bonus_deducted == 400
+
+
+def test_account_status_lifecycle_blocks_ops_and_audits(tmp_path):
+    """Suspension blocks money ops, reactivation restores them, and both
+    transitions land in the append-only audit log with old/new values."""
+    from igaming_platform_tpu.core.enums import AccountStatus
+    from igaming_platform_tpu.platform.domain import AccountSuspendedError
+    from igaming_platform_tpu.platform.repository import SQLiteStore
+    from igaming_platform_tpu.platform.wallet import WalletService
+
+    store = SQLiteStore(str(tmp_path / "audit.db"))
+    wallet = WalletService(
+        store.accounts, store.transactions, store.ledger, audit=store.audit,
+    )
+    acct = wallet.create_account("audit-p")
+    wallet.deposit(acct.id, 10_000, "a-d1")
+
+    wallet.set_account_status(acct.id, AccountStatus.SUSPENDED, reason="kyc review")
+    with pytest.raises(AccountSuspendedError):
+        wallet.deposit(acct.id, 1_000, "a-d2")
+    with pytest.raises(AccountSuspendedError):
+        wallet.withdraw(acct.id, 1_000, "a-w1")
+
+    wallet.set_account_status(acct.id, AccountStatus.ACTIVE)
+    wallet.deposit(acct.id, 1_000, "a-d3")
+    assert wallet.get_balance(acct.id).balance == 11_000
+
+    rows = store._conn.execute(
+        "SELECT action, old_value, new_value FROM audit_log WHERE entity_id=? ORDER BY id",
+        (acct.id,),
+    ).fetchall()
+    assert ("status_change", "active", "suspended:kyc review") in rows
+    assert ("status_change", "suspended", "active") in rows
+    # Idempotent transition writes no duplicate audit row.
+    n = len(rows)
+    wallet.set_account_status(acct.id, AccountStatus.ACTIVE)
+    n2 = store._conn.execute(
+        "SELECT COUNT(*) FROM audit_log WHERE entity_id=?", (acct.id,)
+    ).fetchone()[0]
+    assert n2 == n
+    store.close()
+
+
+def test_bonus_forfeiture_audited(tmp_path):
+    from igaming_platform_tpu.platform.repository import SQLiteStore
+    from igaming_platform_tpu.platform.wallet import WalletService
+
+    store = SQLiteStore(str(tmp_path / "forfeit.db"))
+    wallet = WalletService(
+        store.accounts, store.transactions, store.ledger, audit=store.audit,
+    )
+    acct = wallet.create_account("forfeit-p")
+    wallet.grant_bonus(acct.id, 5_000, "fb-1", rule_id="welcome")
+    assert wallet.forfeit_bonus_balance(acct.id) == 5_000
+    row = store._conn.execute(
+        "SELECT old_value, new_value FROM audit_log WHERE action='bonus_forfeiture'"
+    ).fetchone()
+    assert row == ("5000", "0")
+    store.close()
