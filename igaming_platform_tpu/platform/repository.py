@@ -18,6 +18,7 @@ behind the same interface unchanged.
 
 from __future__ import annotations
 
+import contextlib
 import sqlite3
 import threading
 import time
@@ -301,12 +302,40 @@ class SQLiteStore:
         self._conn.execute("PRAGMA journal_mode=WAL") if path != ":memory:" else None
         self._conn.executescript(_SCHEMA)
         self._lock = threading.RLock()
+        self._tx_depth = 0
         self.accounts = _SQLiteAccounts(self)
         self.transactions = _SQLiteTransactions(self)
         self.ledger = _SQLiteLedger(self)
 
     def close(self) -> None:
         self._conn.close()
+
+    def _commit(self) -> None:
+        """Commit unless inside a unit of work (then the UoW commits)."""
+        if self._tx_depth == 0:
+            self._conn.commit()
+
+    @contextlib.contextmanager
+    def unit_of_work(self):
+        """Run several repository calls as ONE database transaction — the
+        UnitOfWork wrapper of postgres.go:393-443. Everything inside
+        commits together or rolls back together; per-call commits are
+        suppressed while the UoW is open. Reentrant (nesting joins the
+        outermost transaction); the store lock is held throughout, so the
+        op is also serialized against other threads."""
+        with self._lock:
+            self._tx_depth += 1
+            try:
+                yield self
+            except BaseException:
+                self._tx_depth -= 1
+                if self._tx_depth == 0:
+                    self._conn.rollback()
+                raise
+            else:
+                self._tx_depth -= 1
+                if self._tx_depth == 0:
+                    self._conn.commit()
 
     def audit(self, entity: str, entity_id: str, action: str, old: str = "", new: str = "") -> None:
         with self._lock:
@@ -362,7 +391,7 @@ class _SQLiteAccounts:
                 (a.id, a.player_id, a.currency, a.balance, a.bonus, a.status.value, a.version,
                  a.created_at, a.updated_at),
             )
-            self._s._conn.commit()
+            self._s._commit()
 
     def _row_to_account(self, row) -> Account:
         return Account(
@@ -389,7 +418,7 @@ class _SQLiteAccounts:
                 " WHERE id=? AND version=?",
                 (balance, bonus, time.time(), account_id, expected_version),
             )
-            self._s._conn.commit()
+            self._s._commit()
             if cur.rowcount == 0:
                 # Either missing or a version conflict — same contract as
                 # postgres.go:144-147.
@@ -406,7 +435,7 @@ class _SQLiteAccounts:
                 "UPDATE accounts SET status=?, updated_at=? WHERE id=?",
                 (status.value, time.time(), account_id),
             )
-            self._s._conn.commit()
+            self._s._commit()
             if cur.rowcount == 0:
                 raise AccountNotFoundError(account_id)
 
@@ -424,7 +453,7 @@ class _SQLiteTransactions:
                      t.balance_before, t.balance_after, t.status.value, t.reference,
                      t.game_id, t.round_id, t.risk_score, t.created_at, t.completed_at),
                 )
-                self._s._conn.commit()
+                self._s._commit()
             except sqlite3.IntegrityError as exc:
                 if "UNIQUE" in str(exc):
                     raise DuplicateTransactionError(t.idempotency_key) from exc
@@ -461,7 +490,7 @@ class _SQLiteTransactions:
                 "UPDATE transactions SET status=?, completed_at=?, risk_score=? WHERE id=?",
                 (t.status.value, t.completed_at, t.risk_score, t.id),
             )
-            self._s._conn.commit()
+            self._s._commit()
 
     def update_with_event(self, t: Transaction, exchange: str, routing_key: str, payload: str) -> None:
         """Transaction-row update + outbox stage in ONE commit — the atomic
@@ -477,7 +506,7 @@ class _SQLiteTransactions:
                 " VALUES (?,?,?,0,?)",
                 (exchange, routing_key, payload, time.time()),
             )
-            self._s._conn.commit()
+            self._s._commit()
 
     @staticmethod
     def _filter_sql(types, from_ts, to_ts, game_id) -> tuple[str, list]:
@@ -561,7 +590,7 @@ class _SQLiteLedger:
                 (e.id, e.transaction_id, e.account_id, e.entry_type.value, e.amount,
                  e.balance_after, e.description, e.created_at),
             )
-            self._s._conn.commit()
+            self._s._commit()
 
     def get_by_transaction(self, tx_id: str) -> list[LedgerEntry]:
         with self._s._lock:

@@ -19,6 +19,7 @@ and the reference's deliberate risk asymmetry preserved:
 
 from __future__ import annotations
 
+import contextlib
 import time
 from dataclasses import dataclass
 from typing import Protocol
@@ -58,6 +59,11 @@ class RiskGate(Protocol):
     ) -> tuple[int, str, list[str]]:
         """Returns (score, action, reason_codes); raises on unavailability."""
         ...
+
+
+@contextlib.contextmanager
+def _null_uow():
+    yield
 
 
 @dataclass
@@ -399,23 +405,42 @@ class WalletService:
         description: str, risk_score: int | None,
         event_type: EventType = EventType.TRANSACTION_COMPLETED,
     ) -> None:
+        """Persist the money movement: tx row -> optimistic balance update ->
+        ledger -> complete + event.
+
+        On a store with unit_of_work (SQLite) the WHOLE pipeline is one
+        database transaction (postgres.go:393-443 UnitOfWork): a crash or
+        error at any step rolls everything back — the books can never
+        diverge mid-op. In-memory repos run step-by-step (divergence there
+        is detectable via ledger.verify_balance, the reference's own
+        guarantee level).
+        """
         tx.risk_score = risk_score
-        self.transactions.create(tx)
+        uow = getattr(getattr(self.transactions, "_s", None), "unit_of_work", None)
         try:
-            self.accounts.update_balance(account.id, new_balance, new_bonus, account.version)
+            with uow() if uow is not None else _null_uow():
+                self.transactions.create(tx)
+                self.accounts.update_balance(account.id, new_balance, new_bonus, account.version)
+                self._ledger_entry(tx, description)
+                tx.complete()
+                self._complete_and_publish(tx, new_transaction_event(event_type.value, {
+                    "id": tx.id, "account_id": tx.account_id, "type": tx.type.value,
+                    "amount": tx.amount, "balance_before": tx.balance_before,
+                    "balance_after": tx.balance_after, "status": tx.status.value,
+                    "game_id": tx.game_id or "", "round_id": tx.round_id or "",
+                    "risk_score": risk_score or 0,
+                }))
         except ConcurrentUpdateError:
+            # The optimistic-lock loser keeps an auditable FAILED row (the
+            # UoW rolled its pending row back, so persist it afresh; the
+            # partial unique index ignores failed rows, releasing the key
+            # for the retry).
             tx.fail()
-            self.transactions.update(tx)
+            if uow is not None:
+                self.transactions.create(tx)
+            else:
+                self.transactions.update(tx)
             raise
-        self._ledger_entry(tx, description)
-        tx.complete()
-        self._complete_and_publish(tx, new_transaction_event(event_type.value, {
-            "id": tx.id, "account_id": tx.account_id, "type": tx.type.value,
-            "amount": tx.amount, "balance_before": tx.balance_before,
-            "balance_after": tx.balance_after, "status": tx.status.value,
-            "game_id": tx.game_id or "", "round_id": tx.round_id or "",
-            "risk_score": risk_score or 0,
-        }))
 
     def _ledger_entry(self, tx: Transaction, description: str) -> None:
         """Double-entry record (wallet_service.go:679-704)."""

@@ -511,3 +511,77 @@ def test_bonus_forfeiture_audited(tmp_path):
     ).fetchone()
     assert row == ("5000", "0")
     store.close()
+
+
+def test_unit_of_work_rolls_back_whole_op_on_sqlite(tmp_path):
+    """With the SQLite UnitOfWork, a failure anywhere in the commit
+    pipeline rolls back EVERYTHING — no pending row, no balance change,
+    no ledger entry, no staged event. Books cannot diverge mid-op."""
+    from igaming_platform_tpu.platform.outbox import OutboxPublisher
+    from igaming_platform_tpu.platform.repository import SQLiteStore
+    from igaming_platform_tpu.platform.wallet import WalletService
+
+    store = SQLiteStore(str(tmp_path / "uow.db"))
+    wallet = WalletService(
+        store.accounts, store.transactions, store.ledger,
+        events=OutboxPublisher(store),
+    )
+    acct = wallet.create_account("uow-p")
+    wallet.deposit(acct.id, 10_000, "u-d1")
+    while store.outbox_drain():
+        store.outbox_mark_published(store.outbox_drain()[0][0])
+
+    # Inject a failure AFTER the balance update (ledger write dies).
+    orig = store.ledger.create
+    store.ledger.create = lambda e: (_ for _ in ()).throw(OSError("disk full"))
+    with pytest.raises(OSError):
+        wallet.deposit(acct.id, 2_000, "u-d2")
+    store.ledger.create = orig
+
+    after = wallet.accounts.get_by_id(acct.id)
+    assert after.balance == 10_000                       # balance rolled back
+    assert wallet.ledger.verify_balance(acct.id, 10_000)  # books consistent
+    assert wallet.transactions.get_by_idempotency_key(acct.id, "u-d2") is None
+    assert store.outbox_drain() == []                    # no phantom event
+
+    # The retry with the same key succeeds cleanly.
+    wallet.deposit(acct.id, 2_000, "u-d2")
+    assert wallet.accounts.get_by_id(acct.id).balance == 12_000
+    assert wallet.ledger.verify_balance(acct.id, 12_000)
+    store.close()
+
+
+def test_uow_optimistic_loser_keeps_failed_row_sqlite(tmp_path):
+    """A version-conflict loser still leaves an auditable FAILED
+    transaction row, and the idempotency key stays usable for the retry."""
+    from igaming_platform_tpu.core.enums import TxStatus
+    from igaming_platform_tpu.platform.domain import ConcurrentUpdateError
+    from igaming_platform_tpu.platform.repository import SQLiteStore
+    from igaming_platform_tpu.platform.wallet import WalletService
+
+    store = SQLiteStore(str(tmp_path / "cas.db"))
+    wallet = WalletService(store.accounts, store.transactions, store.ledger)
+    acct = wallet.create_account("cas-p")
+    wallet.deposit(acct.id, 5_000, "c-seed")
+
+    # Force a conflict: bump the version behind the op's back.
+    orig_get = store.accounts.get_by_id
+    def stale_get(account_id):
+        fresh = orig_get(account_id)
+        store.accounts.update_balance(
+            account_id, fresh.balance, fresh.bonus, fresh.version)  # version++
+        return fresh  # now stale
+    store.accounts.get_by_id = stale_get
+    with pytest.raises(ConcurrentUpdateError):
+        wallet.deposit(acct.id, 1_000, "c-d1")
+    store.accounts.get_by_id = orig_get
+
+    failed = wallet.transactions.get_by_idempotency_key(acct.id, "c-d1")
+    assert failed is not None and failed.status == TxStatus.FAILED
+    # Retry re-executes (failed rows don't satisfy idempotency).
+    res = wallet.deposit(acct.id, 1_000, "c-d1")
+    assert res.transaction.status == TxStatus.COMPLETED
+    final = wallet.accounts.get_by_id(acct.id)
+    assert final.balance == 6_000
+    assert wallet.ledger.verify_balance(acct.id, 6_000)
+    store.close()
