@@ -193,6 +193,12 @@ class ServiceMetrics:
         self.ltv_segment_total = self.registry.counter(
             f"{service}_ltv_segment_total", "LTV segment assignments by segment"
         )
+        self.reconciliation_checked = self.registry.gauge(
+            f"{service}_reconciliation_checked", "Accounts checked by the last reconciliation sweep"
+        )
+        self.reconciliation_mismatched = self.registry.gauge(
+            f"{service}_reconciliation_mismatched", "Balance/ledger mismatches in the last sweep"
+        )
 
     def observe_rpc(self, method: str, start_time: float, code: str = "OK") -> None:
         self.requests_total.inc(method=method, code=code)

@@ -117,6 +117,10 @@ class InMemoryAccountRepository:
             acct.status = status
             acct.updated_at = time.time()
 
+    def list_ids(self) -> list[str]:
+        with self._lock:
+            return list(self._accounts.keys())
+
 
 class InMemoryTransactionRepository:
     def __init__(self):
@@ -438,6 +442,10 @@ class _SQLiteAccounts:
             self._s._commit()
             if cur.rowcount == 0:
                 raise AccountNotFoundError(account_id)
+
+    def list_ids(self) -> list[str]:
+        with self._s._lock:
+            return [r[0] for r in self._s._conn.execute("SELECT id FROM accounts").fetchall()]
 
 
 class _SQLiteTransactions:

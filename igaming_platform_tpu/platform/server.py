@@ -25,6 +25,7 @@ from igaming_platform_tpu.platform.repository import (
 )
 from igaming_platform_tpu.platform.wallet import WalletConfig, WalletService
 from igaming_platform_tpu.platform.outbox import InMemoryOutbox, OutboxPublisher, OutboxRelay
+from igaming_platform_tpu.platform.reconcile import ReconciliationJob, Reconciler
 from igaming_platform_tpu.serve.events import InMemoryBroker, default_broker
 from igaming_platform_tpu.serve.grpc_server import (
     WalletGrpcService,
@@ -87,6 +88,15 @@ class WalletServer:
                 risk_threshold_review=self.config.risk_threshold_review,
             ),
         )
+        # Periodic ledger reconciliation sweep (postgres.go:371-390 run as a
+        # real job; mismatches audit + export as gauges).
+        self.reconciler = Reconciler(
+            accounts, ledger,
+            audit=self.store.audit if self.store is not None else None,
+            metrics=self.metrics,
+        )
+        self.reconcile_job = ReconciliationJob(self.reconciler, interval_s=300.0)
+        self.reconcile_job.start()
         self.grpc_server, self.health, self.grpc_port = serve_wallet(
             WalletGrpcService(self.wallet, metrics=self.metrics),
             grpc_port if grpc_port is not None else self.config.grpc_port,
@@ -123,6 +133,10 @@ class WalletServer:
                 elif self.path == "/debug/spans":
                     from igaming_platform_tpu.obs.tracing import DEFAULT_COLLECTOR
                     self._send(200, DEFAULT_COLLECTOR.to_json())
+                elif self.path == "/debug/reconciliation":
+                    report = server_ref.reconciler.run_once()
+                    self._send(200 if report.mismatched == 0 else 500,
+                               json.dumps(report.to_dict()))
                 else:
                     self._send(404, '{"error":"not found"}')
 
@@ -134,6 +148,7 @@ class WalletServer:
         self._stopped.set()
         graceful_stop(self.grpc_server, self.health, grace)
         self.http_server.shutdown()
+        self.reconcile_job.stop()
         # Final drain before the store closes so accepted ops' events ship.
         self.outbox_relay.stop(drain=True)
         if self.store is not None:
