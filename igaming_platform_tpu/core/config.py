@@ -107,6 +107,11 @@ class RiskServiceConfig:
     ltv_model_path: str = ""
     rate_limit_per_minute: int = 600
     log_level: str = "info"
+    # Analytical-store scan feeding the batch half of the feature vector
+    # (the hourly ClickHouse ticker of risk/cmd/main.go:226-236): path to a
+    # wallet SQLite file; empty disables the refresh job.
+    batch_feature_db: str = ""
+    batch_feature_interval_s: float = 3600.0
     scoring: ScoringConfig = field(default_factory=ScoringConfig)
     batcher: BatcherConfig = field(default_factory=BatcherConfig)
 
@@ -123,6 +128,10 @@ class RiskServiceConfig:
             ltv_model_path=getenv_str("LTV_MODEL_PATH", d.ltv_model_path),
             rate_limit_per_minute=getenv_int("RATE_LIMIT_PER_MINUTE", d.rate_limit_per_minute),
             log_level=getenv_str("LOG_LEVEL", d.log_level),
+            batch_feature_db=getenv_str("BATCH_FEATURE_DB", d.batch_feature_db),
+            batch_feature_interval_s=getenv_float(
+                "BATCH_FEATURE_INTERVAL_S", d.batch_feature_interval_s
+            ),
             scoring=ScoringConfig.from_env(),
             batcher=BatcherConfig(
                 batch_size=getenv_int("BATCH_SIZE", 256),

@@ -147,6 +147,34 @@ class InMemoryFeatureStore:
                 st.total_wins += event.amount
                 st.win_count += 1
 
+    def load_batch_features(
+        self, account_id: str, *,
+        total_deposits: int = 0, total_withdrawals: int = 0,
+        deposit_count: int = 0, withdraw_count: int = 0,
+        total_bets: int = 0, total_wins: int = 0,
+        bet_count: int = 0, win_count: int = 0,
+        bonus_claim_count: int | None = None,
+        created_at: float | None = None,
+    ) -> None:
+        """Bulk-overwrite the batch aggregates from an authoritative scan
+        (the hourly ClickHouse refresh of risk/cmd/main.go:226-236, which
+        the reference declares but leaves commented out). Realtime windows
+        (velocity, HLLs, sessions) are NOT touched — they remain stream-fed."""
+        with self._lock:
+            st = self._state(account_id, time.time())
+            st.total_deposits = total_deposits
+            st.total_withdrawals = total_withdrawals
+            st.deposit_count = deposit_count
+            st.withdraw_count = withdraw_count
+            st.total_bets = total_bets
+            st.total_wins = total_wins
+            st.bet_count = bet_count
+            st.win_count = win_count
+            if bonus_claim_count is not None:
+                st.bonus_claim_count = bonus_claim_count
+            if created_at is not None:
+                st.created_at = created_at
+
     def record_bonus_claim(self, account_id: str, wager_complete_rate: float | None = None) -> None:
         with self._lock:
             st = self._state(account_id, time.time())

@@ -97,6 +97,30 @@ class RiskServer:
             http_port if http_port is not None else self.config.http_port
         )
         self.bridge.start()
+
+        # Batch-feature refresh ticker (risk/cmd/main.go:226-236, actually
+        # implemented): re-hydrates per-account analytical aggregates from
+        # the wallet store — with an immediate first scan so a restarted
+        # scorer doesn't serve empty batch features until the first tick.
+        self.batch_refresh = None
+        if self.config.batch_feature_db:
+            from igaming_platform_tpu.serve.batch_refresh import (
+                BatchFeatureRefreshJob,
+                wallet_store_source,
+            )
+
+            self.batch_refresh = BatchFeatureRefreshJob(
+                self.engine.features,
+                wallet_store_source(self.config.batch_feature_db),
+                interval_s=self.config.batch_feature_interval_s,
+            )
+            try:
+                n = self.batch_refresh.refresh_once()
+                logger.info("batch features hydrated for %d accounts", n)
+            except Exception:
+                logger.warning("initial batch-feature refresh failed", exc_info=True)
+            self.batch_refresh.start()
+
         self._stopped = threading.Event()
         logger.info("risk server up: grpc=%d http=%d", self.grpc_port, self.http_port)
 
@@ -176,6 +200,8 @@ class RiskServer:
     def shutdown(self, grace: float = 30.0) -> None:
         """NOT_SERVING -> stop bridge -> drain gRPC -> stop HTTP."""
         self._stopped.set()
+        if self.batch_refresh is not None:
+            self.batch_refresh.stop()
         self.bridge.stop()
         graceful_stop(self.grpc_server, self.health, grace)
         self.http_server.shutdown()
