@@ -120,7 +120,16 @@ def _sinusoidal_positions(seq_len: int, d_model: int) -> np.ndarray:
 
 
 def _dense_attention(q, k, v):
-    """q,k,v: [B, H, S, Dh] -> [B, H, S, Dh]; full softmax attention."""
+    """q,k,v: [B, H, S, Dh] -> [B, H, S, Dh]; full softmax attention.
+
+    On TPU with block-divisible S the intra-chip core is the Pallas flash
+    kernel (VMEM-resident online softmax, no [S, S] in HBM); elsewhere the
+    XLA einsum path, which is also the golden reference for the kernel.
+    """
+    from igaming_platform_tpu.ops.pallas.flash_attention import flash_attention, supports
+
+    if jax.default_backend() == "tpu" and supports(q.shape):
+        return flash_attention(q, k, v)
     scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     p = jax.nn.softmax(s, axis=-1)

@@ -1,0 +1,115 @@
+"""Pallas TPU kernel: blockwise (flash) attention for the sequence model.
+
+The XLA dense path (`models/sequence._dense_attention`) materialises the
+[S, S] score matrix in HBM per head — at S=2048 that is 4 M floats per
+(batch, head) touched twice, pure HBM bandwidth. This kernel never leaves
+VMEM: each grid step owns one query block, streams KV blocks through the
+MXU, and folds them into a running online-softmax accumulator
+(max / normaliser / weighted sum), so memory is O(S·Dh) instead of O(S²).
+
+This is the intra-chip core; across chips the ring/Ulysses strategies of
+models/sequence.py shard S over the `seq` mesh axis and this kernel runs
+on each chip's local shard. Matches the dense path bit-for-bit up to
+float32 associativity (pinned in tests/test_flash_attention.py).
+
+Reference behavior being accelerated: the bonus-abuse sequence detector
+(BASELINE.json config 3; engine.go:462-466 is the scalar-rule version).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float):
+    q = q_ref[0]  # [bq, dh]
+    s_total = k_ref.shape[1]
+    bq, dh = q.shape
+
+    m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, dh), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]  # [bk, dh]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale  # MXU
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    _, l, acc = jax.lax.fori_loop(0, s_total // block_k, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_k", "interpret"))
+def _run(q, k, v, *, block_q, block_k, interpret):
+    bh, s, dh = q.shape
+    kernel = functools.partial(
+        _kernel, block_k=block_k, scale=1.0 / math.sqrt(dh)
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(bh, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dh), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, s, dh), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, s, dh), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dh), lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def supports(q_shape: tuple, block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K) -> bool:
+    """Whether the kernel handles this shape without masking (S divisible
+    by both effective block sizes). Padding keys would perturb the
+    softmax, so non-divisible shapes take the dense path instead."""
+    s = q_shape[-2]
+    return s % _eff_block(s, block_q) == 0 and s % _eff_block(s, block_k) == 0
+
+
+def _eff_block(s: int, block: int) -> int:
+    return min(block, s)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """[B, H, S, Dh] q,k,v -> [B, H, S, Dh] full (non-causal) attention.
+
+    S must be divisible by the (effective) block sizes — the serving path
+    pads event histories to a fixed max_len, so this holds on the hot
+    path; `supports()` lets callers fall back to the dense core otherwise.
+    """
+    b, h, s, dh = q.shape
+    bq, bk = _eff_block(s, block_q), _eff_block(s, block_k)
+    if s % bq != 0 or s % bk != 0:
+        raise ValueError(f"seq len {s} not divisible by blocks ({bq}, {bk})")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    out = _run(
+        q.reshape(b * h, s, dh), k.reshape(b * h, s, dh), v.reshape(b * h, s, dh),
+        block_q=bq, block_k=bk, interpret=interpret,
+    )
+    return out.reshape(b, h, s, dh)

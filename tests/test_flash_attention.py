@@ -1,0 +1,72 @@
+"""Flash-attention kernel == dense attention (golden parity).
+
+The Pallas kernel runs in interpret mode on CPU (same arithmetic, no TPU
+needed); the dense einsum path is the golden reference.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from igaming_platform_tpu.ops.pallas.flash_attention import flash_attention, supports
+
+
+def dense(q, k, v):
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+
+
+@pytest.mark.parametrize("b,h,s,dh", [
+    (2, 4, 512, 16),    # serving shape family (d_model=128 / 8 heads)
+    (1, 2, 2048, 16),   # max_len history
+    (2, 8, 256, 64),    # wider heads
+    (1, 1, 128, 16),    # single block (eff block = s)
+])
+def test_matches_dense(b, h, s, dh):
+    key = jax.random.key(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, h, s, dh), jnp.float32)
+    k = jax.random.normal(kk, (b, h, s, dh), jnp.float32)
+    v = jax.random.normal(kv, (b, h, s, dh), jnp.float32)
+
+    out = flash_attention(q, k, v, interpret=True)
+    ref = dense(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_extreme_logits_numerically_stable():
+    """Online softmax must survive logits that overflow a naive exp."""
+    q = jnp.full((1, 1, 256, 16), 30.0, jnp.float32)
+    k = jnp.full((1, 1, 256, 16), 30.0, jnp.float32)
+    v = jnp.ones((1, 1, 256, 16), jnp.float32)
+    out = flash_attention(q, k, v, interpret=True)
+    assert np.all(np.isfinite(np.asarray(out)))
+    np.testing.assert_allclose(np.asarray(out), 1.0, rtol=1e-6)
+
+
+def test_supports_predicate():
+    assert supports((1, 1, 2048, 16))
+    assert supports((1, 1, 128, 16))      # single-block fallback
+    assert not supports((1, 1, 300, 16))  # not block-divisible
+    with pytest.raises(ValueError):
+        q = jnp.zeros((1, 1, 300, 16))
+        flash_attention(q, q, q, interpret=True)
+
+
+def test_sequence_model_unchanged_on_cpu():
+    """On CPU the model keeps the dense core (kernel dispatch is TPU-only),
+    so existing golden values are untouched."""
+    from igaming_platform_tpu.models.sequence import (
+        SeqConfig, init_sequence_model, sequence_forward,
+    )
+
+    cfg = SeqConfig(max_len=256)
+    params = init_sequence_model(jax.random.key(1), cfg)
+    x = np.random.default_rng(0).normal(size=(2, 256, 12)).astype(np.float32)
+    out = sequence_forward(params, x, cfg)
+    assert out["abuse"].shape == (2,)
+    assert np.all((np.asarray(out["abuse"]) >= 0) & (np.asarray(out["abuse"]) <= 1))
