@@ -117,6 +117,10 @@ def make_score_fn(
             ml = mock_predict(xn)
         elif ml_backend == "mlp":
             ml = mlp_mod.mlp_predict(params["mlp"], xn)
+        elif ml_backend == "mlp_int8":
+            from igaming_platform_tpu.ops.quantize import mlp_predict_int8
+
+            ml = mlp_predict_int8(params["mlp_int8"], xn)
         elif ml_backend == "gbdt":
             ml = gbdt_mod.gbdt_predict(params["gbdt"], xn)
         elif ml_backend == "mlp+gbdt":
