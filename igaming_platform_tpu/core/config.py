@@ -112,6 +112,10 @@ class RiskServiceConfig:
     # wallet SQLite file; empty disables the refresh job.
     batch_feature_db: str = ""
     batch_feature_interval_s: float = 3600.0
+    # "auto" = native C++ store when the library builds, else Python;
+    # "native" forces C++ (fails fast if unavailable); "python" forces the
+    # in-memory reference implementation.
+    feature_store: str = "auto"
     scoring: ScoringConfig = field(default_factory=ScoringConfig)
     batcher: BatcherConfig = field(default_factory=BatcherConfig)
 
@@ -132,6 +136,7 @@ class RiskServiceConfig:
             batch_feature_interval_s=getenv_float(
                 "BATCH_FEATURE_INTERVAL_S", d.batch_feature_interval_s
             ),
+            feature_store=getenv_str("FEATURE_STORE", d.feature_store),
             scoring=ScoringConfig.from_env(),
             batcher=BatcherConfig(
                 batch_size=getenv_int("BATCH_SIZE", 256),

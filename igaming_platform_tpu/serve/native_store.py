@@ -92,6 +92,12 @@ def _load_lib():
         np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
     ]
     lib.fs_record_bonus.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_float]
+    lib.fs_load_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_double,
+    ]
     lib.fs_velocity.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_double, ctypes.POINTER(ctypes.c_int)
     ]
@@ -182,6 +188,24 @@ class NativeFeatureStore:
             dev[i] = _hash64(e.device_id)
             ips[i] = _hash64(e.ip)
         self._lib.fs_update_batch(self._handle, n, idxs, ts, amounts, types, dev, ips)
+
+    def load_batch_features(
+        self, account_id: str, *,
+        total_deposits: int = 0, total_withdrawals: int = 0,
+        deposit_count: int = 0, withdraw_count: int = 0,
+        total_bets: int = 0, total_wins: int = 0,
+        bet_count: int = 0, win_count: int = 0,
+        bonus_claim_count: int | None = None,
+        created_at: float | None = None,
+    ) -> None:
+        """Bulk-overwrite batch aggregates (serve/batch_refresh.py sink)."""
+        self._lib.fs_load_batch(
+            self._handle, self._idx(account_id),
+            total_deposits, total_withdrawals, deposit_count, withdraw_count,
+            total_bets, total_wins, bet_count, win_count,
+            -1 if bonus_claim_count is None else bonus_claim_count,
+            -1.0 if created_at is None else created_at,
+        )
 
     def record_bonus_claim(self, account_id: str, wager_complete_rate: float | None = None) -> None:
         idx = self._idx(account_id)

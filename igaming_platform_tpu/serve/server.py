@@ -72,6 +72,22 @@ class RiskServer:
                     "model path %s not found; using mock scorer", self.config.fraud_model_path
                 )
 
+        # Feature store: the native C++ core by default (SURVEY.md §2.2's
+        # native ingest bridge), Python fallback when the build is absent.
+        feature_store = None
+        if self.config.feature_store in ("auto", "native"):
+            from igaming_platform_tpu.serve.native_store import native_available
+
+            if native_available():
+                from igaming_platform_tpu.serve.native_store import NativeFeatureStore
+
+                feature_store = NativeFeatureStore()
+                logger.info("using native C++ feature store")
+            elif self.config.feature_store == "native":
+                raise RuntimeError("FEATURE_STORE=native but the C++ library is unavailable")
+            else:
+                logger.info("native feature store unavailable; using Python store")
+
         # Engine (AOT warm-up happens in the constructor, before SERVING).
         self.engine = TPUScoringEngine(
             self.config.scoring,
@@ -79,6 +95,7 @@ class RiskServer:
             params=params,
             mesh=mesh,
             batcher_config=self.config.batcher,
+            feature_store=feature_store,
         )
         self.abuse = SequenceAbuseDetector()
         self.broker = broker or default_broker()

@@ -216,6 +216,32 @@ void fs_record_bonus(void* handle, int idx, float wager_rate) {
   if (wager_rate >= 0.0f) st.bonus_wager_rate = wager_rate;
 }
 
+// Bulk-overwrite the batch aggregates from an authoritative scan (the
+// hourly analytical refresh; serve/batch_refresh.py). Realtime windows
+// (history, HLLs, sessions) are untouched. created_at < 0 => keep.
+void fs_load_batch(void* handle, int idx,
+                   int64_t total_deposits, int64_t total_withdrawals,
+                   int32_t deposit_count, int32_t withdraw_count,
+                   int64_t total_bets, int64_t total_wins,
+                   int32_t bet_count, int32_t win_count,
+                   int32_t bonus_claim_count, double created_at) {
+  Store* s = static_cast<Store*>(handle);
+  if (idx < 0 || size_t(idx) >= s->accounts.size()) return;
+  std::lock_guard<std::mutex> g(s->lock_for(idx));
+  AccountState& st = s->accounts[size_t(idx)];
+  if (!st.initialized) { st.initialized = true; st.created_at = created_at >= 0.0 ? created_at : 0.0; }
+  st.total_deposits = total_deposits;
+  st.total_withdrawals = total_withdrawals;
+  st.deposit_count = deposit_count;
+  st.withdraw_count = withdraw_count;
+  st.total_bets = total_bets;
+  st.total_wins = total_wins;
+  st.bet_count = bet_count;
+  st.win_count = win_count;
+  if (bonus_claim_count >= 0) st.bonus_claim_count = bonus_claim_count;
+  if (created_at >= 0.0) st.created_at = created_at;
+}
+
 void fs_velocity(void* handle, int idx, double now, int* out3) {
   Store* s = static_cast<Store*>(handle);
   out3[0] = out3[1] = out3[2] = 0;

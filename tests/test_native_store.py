@@ -162,3 +162,65 @@ def test_native_gather_faster_than_python():
 def test_best_feature_store_returns_native():
     store = best_feature_store()
     assert isinstance(store, NativeFeatureStore)
+
+
+def test_native_load_batch_features_parity():
+    """load_batch_features (the batch-refresh sink) behaves identically in
+    the native and Python stores."""
+    import numpy as np
+
+    from igaming_platform_tpu.core.features import F, NUM_FEATURES
+    from igaming_platform_tpu.serve.feature_store import InMemoryFeatureStore
+    from igaming_platform_tpu.serve.native_store import NativeFeatureStore, native_available
+
+    if not native_available():
+        import pytest
+        pytest.skip("native library unavailable")
+
+    kw = dict(total_deposits=50_000, total_withdrawals=10_000,
+              deposit_count=5, withdraw_count=2, total_bets=20_000,
+              total_wins=8_000, bet_count=20, win_count=6,
+              bonus_claim_count=3, created_at=1000.0)
+    rows = []
+    for store in (InMemoryFeatureStore(), NativeFeatureStore(max_accounts=16)):
+        store.load_batch_features("acct", **kw)
+        row = np.zeros(NUM_FEATURES, dtype=np.float32)
+        store.fill_row(row, "acct", 500, "bet", now=2000.0)
+        rows.append(row)
+    py, nat = rows
+    for f in (F.TOTAL_DEPOSITS, F.TOTAL_WITHDRAWALS, F.DEPOSIT_COUNT,
+              F.WITHDRAW_COUNT, F.NET_DEPOSIT, F.AVG_BET_SIZE, F.WIN_RATE,
+              F.BONUS_CLAIM_COUNT, F.ACCOUNT_AGE_DAYS):
+        assert py[f] == nat[f], f"feature {f}: python={py[f]} native={nat[f]}"
+
+
+def test_batch_refresh_job_works_with_native_store(tmp_path):
+    import numpy as np
+
+    from igaming_platform_tpu.core.features import F, NUM_FEATURES
+    from igaming_platform_tpu.platform.repository import SQLiteStore
+    from igaming_platform_tpu.platform.wallet import WalletService
+    from igaming_platform_tpu.serve.batch_refresh import (
+        BatchFeatureRefreshJob,
+        wallet_store_source,
+    )
+    from igaming_platform_tpu.serve.native_store import NativeFeatureStore, native_available
+
+    if not native_available():
+        import pytest
+        pytest.skip("native library unavailable")
+
+    path = str(tmp_path / "w.db")
+    store = SQLiteStore(path)
+    wallet = WalletService(store.accounts, store.transactions, store.ledger)
+    acct = wallet.create_account("nb-p")
+    for i in range(3):
+        wallet.deposit(acct.id, 7_000, f"nb-{i}")
+
+    fs = NativeFeatureStore(max_accounts=16)
+    assert BatchFeatureRefreshJob(fs, wallet_store_source(path)).refresh_once() == 1
+    row = np.zeros(NUM_FEATURES, dtype=np.float32)
+    fs.fill_row(row, acct.id, 0, "bet")
+    assert row[F.DEPOSIT_COUNT] == 3
+    assert row[F.TOTAL_DEPOSITS] == 21_000
+    store.close()
