@@ -67,7 +67,7 @@ def config1_single_txn_latency(n_requests: int = 200, batch_size: int = 256) -> 
 
 
 def config2_replay_throughput(
-    n_events: int = 10_000, batch_size: int = 2048, pipeline_depth: int = 8
+    n_events: int = 10_000, batch_size: int = 4096, pipeline_depth: int = 8
 ) -> dict:
     from igaming_platform_tpu.core.config import BatcherConfig
     from igaming_platform_tpu.serve.bridge import ScoringBridge

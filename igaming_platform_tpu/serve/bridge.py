@@ -327,13 +327,13 @@ class ScoringBridge:
 
         def postprocess(item) -> None:
             nonlocal scored, blocked
-            chunk, out = item
+            chunk, packed = item
             evs, accts, amts, types, ips, devs = chunk
             n = len(evs)
-            host = jax.device_get(out)
-            scores = np.asarray(host["score"][:n])
-            actions = np.asarray(host["action"][:n])
-            masks = np.asarray(host["reason_mask"][:n])
+            host = jax.device_get(packed)  # ONE [3, B] transfer
+            scores = np.asarray(host[0][:n])
+            actions = np.asarray(host[1][:n])
+            masks = np.asarray(host[2][:n])
             is_blocked = actions == ACTION_BLOCK
             blocked += int(is_blocked.sum())
             if self.publish_risk_events:
@@ -362,11 +362,11 @@ class ScoringBridge:
             c_events.clear(); c_acct.clear(); c_amt.clear()
             c_type.clear(); c_ip.clear(); c_dev.clear(); c_ts.clear()
             x, bl = store.gather_columns(chunk[1], chunk[2], chunk[3], ips=chunk[4], devices=chunk[5])
-            out, _ = self.engine._launch_device(x, bl)
+            packed, _ = self.engine.launch_packed(x, bl)
             if pipeline is not None:
-                pipeline.put((chunk, out))  # blocks at depth — backpressure
+                pipeline.put((chunk, packed))  # blocks at depth — backpressure
             else:
-                postprocess((chunk, out))
+                postprocess((chunk, packed))
             store.update_columns(chunk[1], chunk[2], chunk[3], chunk[4], chunk[5], ts)
             if self.abuse_detector is not None:
                 for i in range(len(ts)):

@@ -23,6 +23,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
@@ -103,6 +104,7 @@ class TPUScoringEngine:
         else:
             self._fn = jax.jit(fn)
 
+        self._pack_fn = None
         self._batcher = ContinuousBatcher(
             cfg=batcher_config,
             dispatch=self._dispatch_requests,
@@ -197,6 +199,23 @@ class TPUScoringEngine:
             if hasattr(leaf, "copy_to_host_async"):
                 leaf.copy_to_host_async()
         return out, n
+
+    def launch_packed(self, x: np.ndarray, bl: np.ndarray):
+        """Dispatch the score step and pack the replay-relevant outputs
+        (score / action / reason_mask) into ONE int32 [3, B] device array
+        with its D2H copy started. On a high-latency host link (tunneled
+        dev chip) one packed transfer replaces five per-array round
+        trips — the readback cost is per-array, not per-byte, at these
+        sizes."""
+        out, n = self._launch_device(x, bl)
+        if self._pack_fn is None:
+            self._pack_fn = jax.jit(
+                lambda s, a, m: jnp.stack((s, a, m)).astype(jnp.int32)
+            )
+        packed = self._pack_fn(out["score"], out["action"], out["reason_mask"])
+        if hasattr(packed, "copy_to_host_async"):
+            packed.copy_to_host_async()
+        return packed, n
 
     # Two-phase batcher hooks: dispatch on the launcher thread, collect on
     # the collector thread, so batch k+1 launches while batch k's results
