@@ -32,7 +32,10 @@ def redis_available() -> bool:
 class RedisFeatureStore:
     """Same interface as InMemoryFeatureStore, state in Redis."""
 
-    def __init__(self, url: str = "redis://localhost:6379"):
+    def __init__(self, url: str = "redis://localhost:6379", client=None):
+        if client is not None:
+            self._r = client  # injected (tests use a fake; any redis-like API)
+            return
         if not redis_available():
             raise RuntimeError("redis client library not installed")
         import redis
@@ -101,6 +104,29 @@ class RedisFeatureStore:
             n += 1
         return any(pipe.execute()) if n else False
 
+    def load_batch_features(
+        self, account_id: str, *,
+        total_deposits: int = 0, total_withdrawals: int = 0,
+        deposit_count: int = 0, withdraw_count: int = 0,
+        total_bets: int = 0, total_wins: int = 0,
+        bet_count: int = 0, win_count: int = 0,
+        bonus_claim_count: int | None = None,
+        created_at: float | None = None,
+    ) -> None:
+        """Batch aggregates in a hash (the ClickHouse-refresh sink,
+        serve/batch_refresh.py), read back by fill_row."""
+        mapping = {
+            "total_deposits": total_deposits, "total_withdrawals": total_withdrawals,
+            "deposit_count": deposit_count, "withdraw_count": withdraw_count,
+            "total_bets": total_bets, "total_wins": total_wins,
+            "bet_count": bet_count, "win_count": win_count,
+        }
+        if bonus_claim_count is not None:
+            mapping["bonus_claim_count"] = bonus_claim_count
+        if created_at is not None:
+            mapping["created_at"] = created_at
+        self._r.hset(self._k(account_id, "batch"), mapping=mapping)
+
     def fill_row(self, out, account_id: str, amount: int, tx_type: str, now=None) -> None:
         now = int(now or time.time())
         pipe = self._r.pipeline()
@@ -113,7 +139,23 @@ class RedisFeatureStore:
         pipe.pfcount(self._k(account_id, "ips:24h"))
         pipe.get(self._k(account_id, "last_tx"))
         pipe.get(self._k(account_id, "session_start"))
-        c1, c5, ch, total, dev, ips, last_tx, session = pipe.execute()
+        pipe.hgetall(self._k(account_id, "batch"))
+        c1, c5, ch, total, dev, ips, last_tx, session, batch = pipe.execute()
+        batch = {k: float(v) for k, v in (batch or {}).items()}
+        td, tw = batch.get("total_deposits", 0.0), batch.get("total_withdrawals", 0.0)
+        out[F.TOTAL_DEPOSITS] = td
+        out[F.TOTAL_WITHDRAWALS] = tw
+        out[F.NET_DEPOSIT] = td - tw
+        out[F.DEPOSIT_COUNT] = batch.get("deposit_count", 0.0)
+        out[F.WITHDRAW_COUNT] = batch.get("withdraw_count", 0.0)
+        bet_count = batch.get("bet_count", 0.0)
+        if bet_count:
+            out[F.AVG_BET_SIZE] = batch.get("total_bets", 0.0) / bet_count
+            out[F.WIN_RATE] = batch.get("win_count", 0.0) / bet_count
+        out[F.BONUS_CLAIM_COUNT] = batch.get("bonus_claim_count", 0.0)
+        created = batch.get("created_at", 0.0)
+        if created:
+            out[F.ACCOUNT_AGE_DAYS] = max(0.0, (now - created) / 86400.0)
         out[F.TX_COUNT_1M] = int(c1)
         out[F.TX_COUNT_5M] = int(c5)
         out[F.TX_COUNT_1H] = int(ch)
