@@ -116,6 +116,9 @@ class RiskServiceConfig:
     # "native" forces C++ (fails fast if unavailable); "python" forces the
     # in-memory reference implementation.
     feature_store: str = "auto"
+    # Serving mesh: shard the scoring batch over this many devices (DP
+    # axis). 0 = single device; -1 = all visible devices.
+    mesh_devices: int = 0
     scoring: ScoringConfig = field(default_factory=ScoringConfig)
     batcher: BatcherConfig = field(default_factory=BatcherConfig)
 
@@ -137,6 +140,7 @@ class RiskServiceConfig:
                 "BATCH_FEATURE_INTERVAL_S", d.batch_feature_interval_s
             ),
             feature_store=getenv_str("FEATURE_STORE", d.feature_store),
+            mesh_devices=getenv_int("MESH_DEVICES", d.mesh_devices),
             scoring=ScoringConfig.from_env(),
             batcher=BatcherConfig(
                 batch_size=getenv_int("BATCH_SIZE", 256),

@@ -72,6 +72,22 @@ class RiskServer:
                     "model path %s not found; using mock scorer", self.config.fraud_model_path
                 )
 
+        # Serving mesh from config: MESH_DEVICES=N shards the scoring batch
+        # over the first N devices (DP over ICI); -1 takes every visible
+        # device. Default stays single-chip.
+        if mesh is None and self.config.mesh_devices:
+            import jax
+
+            from igaming_platform_tpu.parallel.mesh import MeshSpec, create_mesh
+
+            devs = jax.devices()
+            n = len(devs) if self.config.mesh_devices == -1 else self.config.mesh_devices
+            if n > len(devs):
+                raise RuntimeError(f"MESH_DEVICES={n} but only {len(devs)} devices visible")
+            if n > 1:
+                mesh = create_mesh(MeshSpec(data=n), devices=devs[:n])
+                logger.info("serving mesh: data=%d over %s", n, devs[:n])
+
         # Feature store: the native C++ core by default (SURVEY.md §2.2's
         # native ingest bridge), Python fallback when the build is absent.
         feature_store = None

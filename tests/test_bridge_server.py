@@ -162,3 +162,32 @@ def test_risk_server_assembled():
         assert "risk_grpc_requests_total" in text
     finally:
         server.shutdown(grace=1)
+
+
+def test_risk_server_with_multi_device_mesh(monkeypatch):
+    """MESH_DEVICES=-1 builds a DP serving mesh over all visible devices
+    (8 virtual CPU devices in tests) and scoring works over gRPC."""
+    import grpc
+
+    from igaming_platform_tpu.core.config import RiskServiceConfig
+    from igaming_platform_tpu.proto_gen.risk.v1 import risk_pb2
+    from igaming_platform_tpu.serve.grpc_server import make_risk_stub
+    from igaming_platform_tpu.serve.server import RiskServer
+
+    monkeypatch.setenv("MESH_DEVICES", "-1")
+    monkeypatch.setenv("BATCH_SIZE", "64")
+    monkeypatch.setenv("GRPC_PORT", "0")
+    monkeypatch.setenv("HTTP_PORT", "0")
+    server = RiskServer(RiskServiceConfig.from_env())
+    try:
+        import jax
+        assert server.engine._mesh is not None
+        assert server.engine._mesh.shape["data"] == len(jax.devices())
+        channel = grpc.insecure_channel(f"localhost:{server.grpc_port}")
+        stub = make_risk_stub(channel)
+        r = stub.ScoreTransaction(risk_pb2.ScoreTransactionRequest(
+            account_id="mesh-acct", amount=5_000, transaction_type="deposit"))
+        assert 0 <= r.score <= 100
+        channel.close()
+    finally:
+        server.shutdown(grace=1.0)
