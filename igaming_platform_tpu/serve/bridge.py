@@ -13,6 +13,7 @@ bench, BASELINE config 2) via ``replay()``.
 from __future__ import annotations
 
 import logging
+from collections import OrderedDict
 from typing import Iterable
 
 from igaming_platform_tpu.core.enums import (
@@ -61,6 +62,13 @@ class ScoringBridge:
         self.high_score_threshold = high_score_threshold
         self.events_processed = 0
         self.events_skipped = 0
+        self.events_deduped = 0
+        # The outbox relay delivers at-least-once — dedupe on the event
+        # envelope id so a replayed delivery can't double-count velocity
+        # features. Bounded FIFO (duplicates arrive close to the original:
+        # crash-replay or broker redelivery, not arbitrarily late).
+        self._seen_ids: OrderedDict[str, None] = OrderedDict()
+        self._seen_capacity = 65_536
         self._consumer = Consumer(broker)
         self._consumer.subscribe(QUEUE_RISK_SCORING, self._handle_event)
 
@@ -117,7 +125,20 @@ class ScoringBridge:
         self._ingest(event, req)
         return True
 
+    def _is_duplicate(self, event: Event) -> bool:
+        if not event.id:
+            return False
+        if event.id in self._seen_ids:
+            return True
+        self._seen_ids[event.id] = None
+        if len(self._seen_ids) > self._seen_capacity:
+            self._seen_ids.popitem(last=False)
+        return False
+
     def _handle_event(self, event: Event) -> None:
+        if self._is_duplicate(event):
+            self.events_deduped += 1
+            return
         req = self._event_to_request(event)
         if req is None:
             if self._ingest_only(event):

@@ -253,3 +253,40 @@ def test_published_total_counts_partial_drains():
     broker.inner.publish_raw = orig
     assert relay.flush() == 1
     assert relay.published_total == 3
+
+
+def test_bridge_dedupes_at_least_once_redelivery():
+    """The scoring bridge must not double-count features when the outbox
+    relay re-delivers an event (crash between publish and mark)."""
+    import numpy as np
+
+    from igaming_platform_tpu.core.config import BatcherConfig
+    from igaming_platform_tpu.core.enums import QUEUE_RISK_SCORING
+    from igaming_platform_tpu.core.features import F, NUM_FEATURES
+    from igaming_platform_tpu.serve.bridge import ScoringBridge
+    from igaming_platform_tpu.serve.events import default_broker, new_transaction_event
+    from igaming_platform_tpu.serve.scorer import TPUScoringEngine
+
+    broker = default_broker()
+    engine = TPUScoringEngine(batcher_config=BatcherConfig(batch_size=8, max_wait_ms=1.0))
+    bridge = ScoringBridge(engine, broker, publish_risk_events=False)
+    try:
+        event = new_transaction_event("transaction.completed", {
+            "id": "tx-1", "account_id": "dup-acct", "type": "deposit",
+            "amount": 5_000, "status": "completed",
+        })
+        raw = event.to_json()
+        # At-least-once: the same serialized event arrives twice.
+        broker.publish_raw(EXCHANGE_WALLET, event.type, raw)
+        broker.publish_raw(EXCHANGE_WALLET, event.type, raw)
+        bridge.drain()
+
+        assert bridge.events_processed == 1
+        assert bridge.events_deduped == 1
+        row = np.zeros(NUM_FEATURES, dtype=np.float32)
+        engine.features.fill_row(row, "dup-acct", 0, "bet")
+        assert row[F.DEPOSIT_COUNT] == 1          # counted once
+        assert row[F.TX_COUNT_1H] == 1
+        assert broker.queue_depth(QUEUE_RISK_SCORING) == 0
+    finally:
+        engine.close()
