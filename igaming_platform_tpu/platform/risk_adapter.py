@@ -63,7 +63,7 @@ class GrpcRiskGate:
         self, account_id: str, amount: int, tx_type: str,
         game_id: str = "", ip: str = "", device_id: str = "", fingerprint: str = "",
     ) -> tuple[int, str, list[str]]:
-        from risk.v1 import risk_pb2
+        from igaming_platform_tpu.proto_gen.risk.v1 import risk_pb2
 
         stub = self._ensure_stub()
         resp = stub.ScoreTransaction(
