@@ -63,6 +63,8 @@ class GrpcRiskGate:
         self, account_id: str, amount: int, tx_type: str,
         game_id: str = "", ip: str = "", device_id: str = "", fingerprint: str = "",
     ) -> tuple[int, str, list[str]]:
+        # Explicit package path; the sys.path alias (`from risk.v1 import
+        # risk_pb2`) also resolves once igaming_platform_tpu is imported.
         from igaming_platform_tpu.proto_gen.risk.v1 import risk_pb2
 
         stub = self._ensure_stub()
