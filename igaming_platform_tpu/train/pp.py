@@ -1,0 +1,114 @@
+"""Pipeline-parallel training — gradients through the GPipe schedule.
+
+`parallel.pipeline.pipeline_apply` gives the forward microbatch schedule;
+this module closes the loop for training: loss -> grad -> optimizer
+update differentiated THROUGH the shard_map/ppermute pipeline, so each
+device computes exactly its own stage's gradients (activations flow
+forward along the ring, activation-gradients flow back along the reverse
+permutation — JAX transposes the ppermute automatically).
+
+Scope: stage-uniform trunks (d -> d dense stages, classic GPipe). The
+multitask fraud/LTV model's trunk fits this shape; input projection and
+task heads stay replicated outside the pipeline. Parity with sequential
+training is pinned in tests/test_pp_training.py on the 8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from igaming_platform_tpu.parallel.mesh import AXIS_MODEL
+from igaming_platform_tpu.parallel.pipeline import (
+    mlp_stage_fn,
+    pipeline_apply,
+    stack_stage_params,
+)
+
+
+@dataclass(frozen=True)
+class PPTrainConfig:
+    d_model: int = 64
+    learning_rate: float = 1e-2
+    num_microbatches: int = 4
+    seed: int = 0
+
+
+def init_pp_params(key: jax.Array, n_stages: int, d_model: int, in_dim: int, stacked: bool = True):
+    """Input projection (replicated) + n_stages d->d stages + scalar head."""
+    keys = jax.random.split(key, n_stages + 2)
+    proj = {
+        "w": jax.random.normal(keys[0], (in_dim, d_model), jnp.float32) / jnp.sqrt(in_dim),
+        "b": jnp.zeros((d_model,), jnp.float32),
+    }
+    stages = [
+        {
+            "w": jax.random.normal(keys[1 + s], (d_model, d_model), jnp.float32) / jnp.sqrt(d_model),
+            "b": jnp.zeros((d_model,), jnp.float32),
+        }
+        for s in range(n_stages)
+    ]
+    head = {
+        "w": jax.random.normal(keys[-1], (d_model, 1), jnp.float32) / jnp.sqrt(d_model),
+        "b": jnp.zeros((1,), jnp.float32),
+    }
+    return {
+        "proj": proj,
+        "stages": stack_stage_params(stages) if stacked else stages,
+        "head": head,
+    }
+
+
+def _forward(params: Any, x: jnp.ndarray, mesh: Mesh | None, num_microbatches: int) -> jnp.ndarray:
+    h = jax.nn.relu(x @ params["proj"]["w"] + params["proj"]["b"])
+    if mesh is not None:
+        h = pipeline_apply(
+            mlp_stage_fn, params["stages"], h, mesh,
+            num_microbatches=num_microbatches, axis=AXIS_MODEL,
+        )
+    else:  # sequential golden path: same math, stage loop on one device
+        n_stages = jax.tree.leaves(params["stages"])[0].shape[0]
+        for s in range(n_stages):
+            stage = jax.tree.map(lambda p: p[s], params["stages"])
+            h = mlp_stage_fn(stage, h)
+    return (h @ params["head"]["w"] + params["head"]["b"])[..., 0]
+
+
+class PPTrainer:
+    """Regression trainer whose trunk runs pipeline-parallel over `model`.
+
+    mesh=None runs the mathematically identical sequential path — the
+    golden reference the parity tests compare against.
+    """
+
+    def __init__(self, cfg: PPTrainConfig, in_dim: int, n_stages: int, mesh: Mesh | None = None):
+        self.cfg = cfg
+        self.mesh = mesh
+        if mesh is not None and int(mesh.shape[AXIS_MODEL]) != n_stages:
+            raise ValueError(
+                f"n_stages {n_stages} != mesh '{AXIS_MODEL}' axis {int(mesh.shape[AXIS_MODEL])}"
+            )
+        self.optimizer = optax.sgd(cfg.learning_rate)
+        self.params = init_pp_params(jax.random.key(cfg.seed), n_stages, cfg.d_model, in_dim)
+        self.opt_state = self.optimizer.init(self.params)
+
+        def loss_fn(params, x, y):
+            pred = _forward(params, x, mesh, cfg.num_microbatches)
+            return jnp.mean((pred - y) ** 2)
+
+        def step(params, opt_state, x, y):
+            loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._step = jax.jit(step)
+        self.loss_fn = jax.jit(loss_fn)
+
+    def train_step(self, x: jnp.ndarray, y: jnp.ndarray) -> float:
+        self.params, self.opt_state, loss = self._step(self.params, self.opt_state, x, y)
+        return float(loss)
