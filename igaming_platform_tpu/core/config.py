@@ -92,6 +92,10 @@ class BatcherConfig:
     # Max device batches with results still in flight (launch/readback
     # overlap); 1 = fully synchronous.
     pipeline_depth: int = 4
+    # Transient device failures (preemption, link hiccups): replay the
+    # in-flight batch this many times before failing its requests — the
+    # requeue semantics SURVEY.md §5 requires of a preempted slice.
+    device_retries: int = 1
 
 
 @dataclass(frozen=True)

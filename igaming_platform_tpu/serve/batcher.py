@@ -171,6 +171,7 @@ class ContinuousBatcher:
         self._started = False
         self.batches_run = 0
         self.rows_scored = 0
+        self.batches_replayed = 0
 
     def start(self) -> "ContinuousBatcher":
         if not self._started:
@@ -242,14 +243,23 @@ class ContinuousBatcher:
                         if not it.future.done():
                             it.future.set_exception(exc)
             else:
-                try:
-                    results = self._runner([it.payload for it in items])
-                    for it, res in zip(items, results):
-                        it.future.set_result(res)
-                except Exception as exc:  # noqa: BLE001 — propagate to callers
+                results, exc = None, None
+                for attempt in range(1 + max(0, self.cfg.device_retries)):
+                    try:
+                        results = self._runner([it.payload for it in items])
+                        if attempt:
+                            self.batches_replayed += 1
+                        exc = None
+                        break
+                    except Exception as e:  # noqa: BLE001 — retry then propagate
+                        exc = e
+                if exc is not None:
                     for it in items:
                         if not it.future.done():
                             it.future.set_exception(exc)
+                else:
+                    for it, res in zip(items, results):
+                        it.future.set_result(res)
             self.batches_run += 1
             self.rows_scored += len(items)
 
@@ -265,16 +275,37 @@ class ContinuousBatcher:
     def _finalize_batch(self, item) -> None:
         """Collector-side: blocking readback, then resolve futures. Never
         raises — request errors belong to the request futures, not the
-        pipeline."""
+        pipeline.
+
+        A collect failure (device preempted mid-step, link hiccup) REPLAYS
+        the whole batch synchronously up to ``cfg.device_retries`` times —
+        the preempted slice's in-flight batch requeues instead of failing
+        its requests (SURVEY.md §5). Replay is safe: scoring is pure on
+        the gathered features; the feature write-back happens elsewhere.
+        """
         items, handle = item
+        exc: Exception | None = None
+        results = None
         try:
             results = self._collect(handle)
-            for it, res in zip(items, results):
-                it.future.set_result(res)
-        except Exception as exc:  # noqa: BLE001 — propagate to callers
+        except Exception as first:  # noqa: BLE001
+            exc = first
+            for _ in range(max(0, self.cfg.device_retries)):
+                try:
+                    handle = self._dispatch([it.payload for it in items])
+                    results = self._collect(handle)
+                    self.batches_replayed += 1
+                    exc = None
+                    break
+                except Exception as nxt:  # noqa: BLE001
+                    exc = nxt
+        if exc is not None:
             for it in items:
                 if not it.future.done():
                     it.future.set_exception(exc)
+            return
+        for it, res in zip(items, results):
+            it.future.set_result(res)
 
 
 def _now() -> float:

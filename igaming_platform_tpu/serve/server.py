@@ -155,7 +155,36 @@ class RiskServer:
             self.batch_refresh.start()
 
         self._stopped = threading.Event()
+
+        # Device-liveness probe (SURVEY.md §5: "health gate tied to device
+        # liveness"): one tiny compiled op, pre-warmed here so /ready never
+        # pays a compile.
+        import concurrent.futures as _futures
+
+        import jax as _jax
+        import numpy as _np
+
+        self._probe_fn = _jax.jit(lambda v: v + 1)
+        _jax.block_until_ready(self._probe_fn(_np.int32(0)))
+        self._probe_pool = _futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="device-probe"
+        )
+
         logger.info("risk server up: grpc=%d http=%d", self.grpc_port, self.http_port)
+
+    def device_alive(self, timeout_s: float = 2.0) -> bool:
+        """Run the pre-compiled probe op with a deadline; a hung or lost
+        device turns /ready false instead of hanging the health check."""
+        import jax as _jax
+
+        def probe() -> bool:
+            _jax.block_until_ready(self._probe_fn(1))
+            return True
+
+        try:
+            return self._probe_pool.submit(probe).result(timeout=timeout_s)
+        except Exception:  # noqa: BLE001 — timeout or device error
+            return False
 
     # -- HTTP sidecar (main.go:160-202 equivalent) ---------------------------
 
@@ -181,7 +210,11 @@ class RiskServer:
                     self._send(200, '{"status":"healthy"}')
                 elif self.path == "/ready":
                     ready = not server_ref._stopped.is_set()
-                    self._send(200 if ready else 503, json.dumps({"ready": ready}))
+                    device_ok = server_ref.device_alive() if ready else False
+                    self._send(
+                        200 if (ready and device_ok) else 503,
+                        json.dumps({"ready": ready and device_ok, "device": device_ok}),
+                    )
                 elif self.path == "/debug/thresholds":
                     block, review = server_ref.engine.get_thresholds()
                     self._send(200, json.dumps({"block": block, "review": review}))

@@ -191,3 +191,32 @@ def test_risk_server_with_multi_device_mesh(monkeypatch):
         channel.close()
     finally:
         server.shutdown(grace=1.0)
+
+
+def test_ready_reflects_device_liveness(monkeypatch):
+    import json
+    import urllib.request
+
+    from igaming_platform_tpu.core.config import RiskServiceConfig
+    from igaming_platform_tpu.serve.server import RiskServer
+
+    monkeypatch.setenv("BATCH_SIZE", "16")
+    monkeypatch.setenv("GRPC_PORT", "0")
+    monkeypatch.setenv("HTTP_PORT", "0")
+    monkeypatch.delenv("MESH_DEVICES", raising=False)
+    server = RiskServer(RiskServiceConfig.from_env())
+    try:
+        body = json.load(urllib.request.urlopen(
+            f"http://localhost:{server.http_port}/ready", timeout=5))
+        assert body == {"ready": True, "device": True}
+
+        # Device probe failing -> 503, not a hang.
+        server.device_alive = lambda timeout_s=2.0: False
+        try:
+            urllib.request.urlopen(f"http://localhost:{server.http_port}/ready", timeout=5)
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert json.load(e) == {"ready": False, "device": False}
+    finally:
+        server.shutdown(grace=1.0)

@@ -192,3 +192,75 @@ def test_engine_ip_flags_raise_score():
         assert resp.rule_score >= 15
     finally:
         eng.close()
+
+
+def test_batcher_replays_batch_on_transient_device_failure():
+    """A collect failure (device preempted mid-step) replays the in-flight
+    batch instead of failing its requests (SURVEY.md §5 requeue)."""
+    from igaming_platform_tpu.core.config import BatcherConfig
+    from igaming_platform_tpu.serve.batcher import ContinuousBatcher
+
+    state = {"collects": 0}
+
+    def dispatch(payloads):
+        return list(payloads)
+
+    def collect(handle):
+        state["collects"] += 1
+        if state["collects"] == 1:
+            raise RuntimeError("device preempted")
+        return [p * 10 for p in handle]
+
+    b = ContinuousBatcher(
+        cfg=BatcherConfig(batch_size=4, max_wait_ms=5.0, device_retries=1),
+        dispatch=dispatch, collect=collect,
+    ).start()
+    try:
+        assert b.score_sync(7, timeout=10.0) == 70   # succeeded via replay
+        assert b.batches_replayed == 1
+    finally:
+        b.stop()
+
+
+def test_batcher_fails_requests_after_retries_exhausted():
+    import pytest
+    from igaming_platform_tpu.core.config import BatcherConfig
+    from igaming_platform_tpu.serve.batcher import ContinuousBatcher
+
+    def dispatch(payloads):
+        return payloads
+
+    def collect(handle):
+        raise RuntimeError("device gone")
+
+    b = ContinuousBatcher(
+        cfg=BatcherConfig(batch_size=4, max_wait_ms=5.0, device_retries=2),
+        dispatch=dispatch, collect=collect,
+    ).start()
+    try:
+        with pytest.raises(RuntimeError, match="device gone"):
+            b.score_sync(1, timeout=10.0)
+    finally:
+        b.stop()
+
+
+def test_one_phase_runner_also_retries():
+    from igaming_platform_tpu.core.config import BatcherConfig
+    from igaming_platform_tpu.serve.batcher import ContinuousBatcher
+
+    calls = {"n": 0}
+
+    def runner(payloads):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient")
+        return [p + 1 for p in payloads]
+
+    b = ContinuousBatcher(
+        runner, BatcherConfig(batch_size=4, max_wait_ms=5.0, device_retries=1)
+    ).start()
+    try:
+        assert b.score_sync(5, timeout=10.0) == 6
+        assert b.batches_replayed == 1
+    finally:
+        b.stop()
