@@ -35,6 +35,10 @@ class TrainConfig:
     ltv_loss_weight: float = 0.5
     churn_loss_weight: float = 0.5
     trunk: tuple[int, ...] = (256, 256)
+    # Rematerialize the forward in the backward pass (jax.checkpoint):
+    # trades recompute FLOPs for activation memory — the lever that lets
+    # batch_size grow past HBM on big trunks (SURVEY.md hardware notes).
+    remat: bool = False
     seed: int = 0
 
 
@@ -46,9 +50,11 @@ class TrainState:
 
 
 def make_loss_fn(cfg: TrainConfig):
+    forward = jax.checkpoint(multitask_forward) if cfg.remat else multitask_forward
+
     def loss_fn(params, x_raw, fraud_t, ltv_t, churn_t):
         xn = standardize_for_model(normalize(x_raw))
-        out = multitask_forward(params, xn)
+        out = forward(params, xn)
         # Soft-target BCE for fraud/churn, scaled Huber for LTV.
         fraud_loss = jnp.mean(optax.sigmoid_binary_cross_entropy(out["fraud_logit"], fraud_t))
         churn_loss = jnp.mean(optax.sigmoid_binary_cross_entropy(out["churn_logit"], churn_t))

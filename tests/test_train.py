@@ -100,3 +100,19 @@ def test_trained_params_hot_swap_into_serving():
         assert 0.0 <= resp2.ml_score <= 1.0
     finally:
         eng.close()
+
+
+def test_remat_training_matches_plain():
+    """jax.checkpoint changes memory scheduling, not math: losses match
+    step for step."""
+    from igaming_platform_tpu.train.data import make_stream
+    from igaming_platform_tpu.train.trainer import TrainConfig, Trainer
+
+    plain = Trainer(TrainConfig(batch_size=64, trunk=(32, 32), seed=5))
+    remat = Trainer(TrainConfig(batch_size=64, trunk=(32, 32), seed=5, remat=True))
+    stream_a = make_stream(64, seed=9)
+    stream_b = make_stream(64, seed=9)
+    for _ in range(5):
+        ma = plain.train_step(next(stream_a))
+        mb = remat.train_step(next(stream_b))
+        assert abs(ma["loss"] - mb["loss"]) < 1e-5
