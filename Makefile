@@ -22,6 +22,9 @@ bench:
 bench-all:
 	$(PY) benchmarks/run_all.py
 
+soak:
+	$(PY) benchmarks/soak.py
+
 # Native runtime pieces (C++ feature store).
 native:
 	sh native/build.sh

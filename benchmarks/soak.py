@@ -1,0 +1,85 @@
+"""Concurrent-load soak: N client threads through the continuous batcher.
+
+Complements the throughput benches (which drive arrays or replays) with
+the contended single-transaction path: many callers blocking on
+`engine.score()` simultaneously, exercising the batcher's coalescing,
+future fan-out, and the collector pipeline under load. Prints one JSON
+line; exits non-zero on any request error.
+
+Note on latency: on a tunneled dev chip every batch readback pays the
+tunnel RTT (~65 ms), which bounds p50 for ALL requests in the batch; on
+directly-attached TPU the floor is the batching window + PCIe readback.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> None:
+    from igaming_platform_tpu.core.config import BatcherConfig
+    from igaming_platform_tpu.serve.scorer import ScoreRequest, TPUScoringEngine
+
+    n_threads = int(os.environ.get("SOAK_THREADS", 16))
+    n_requests = int(os.environ.get("SOAK_REQUESTS_PER_THREAD", 150))
+    batch_size = int(os.environ.get("SOAK_BATCH", 512))
+
+    engine = TPUScoringEngine(
+        batcher_config=BatcherConfig(batch_size=batch_size, max_wait_ms=2.0)
+    )
+    errors: list[str] = []
+    latencies: list[float] = []
+    lock = threading.Lock()
+
+    def client(tid: int) -> None:
+        lat = []
+        for i in range(n_requests):
+            t0 = time.perf_counter()
+            try:
+                r = engine.score(ScoreRequest(
+                    f"soak-{tid}-{i % 40}", amount=1_000 + i,
+                    tx_type=("deposit", "bet", "withdraw")[i % 3],
+                ))
+                assert 0 <= r.score <= 100
+            except Exception as exc:  # noqa: BLE001 — recorded, fails the run
+                with lock:
+                    errors.append(repr(exc)[:120])
+                continue
+            lat.append((time.perf_counter() - t0) * 1e3)
+        with lock:
+            latencies.extend(lat)
+
+    threads = [threading.Thread(target=client, args=(t,)) for t in range(n_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    engine.close()
+
+    lat = np.array(latencies)
+    print(json.dumps({
+        "metric": "soak_concurrent_score_rps",
+        "value": round(len(lat) / wall, 1),
+        "unit": "req/s",
+        "requests": int(lat.size),
+        "errors": len(errors),
+        "threads": n_threads,
+        "p50_ms": round(float(np.percentile(lat, 50)), 1) if lat.size else None,
+        "p99_ms": round(float(np.percentile(lat, 99)), 1) if lat.size else None,
+        "batches_replayed": engine._batcher.batches_replayed,
+    }))
+    if errors:
+        print("errors:", errors[:5], file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
