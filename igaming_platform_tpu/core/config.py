@@ -123,6 +123,9 @@ class RiskServiceConfig:
     # Serving mesh: shard the scoring batch over this many devices (DP
     # axis). 0 = single device; -1 = all visible devices.
     mesh_devices: int = 0
+    # Sequence-parallel axis for the abuse detector (ring attention over
+    # `seq`); must divide mesh_devices. 1 = no sequence sharding.
+    mesh_seq: int = 1
     scoring: ScoringConfig = field(default_factory=ScoringConfig)
     batcher: BatcherConfig = field(default_factory=BatcherConfig)
 
@@ -145,6 +148,7 @@ class RiskServiceConfig:
             ),
             feature_store=getenv_str("FEATURE_STORE", d.feature_store),
             mesh_devices=getenv_int("MESH_DEVICES", d.mesh_devices),
+            mesh_seq=getenv_int("MESH_SEQ", d.mesh_seq),
             scoring=ScoringConfig.from_env(),
             batcher=BatcherConfig(
                 batch_size=getenv_int("BATCH_SIZE", 256),

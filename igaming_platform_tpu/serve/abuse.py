@@ -54,6 +54,9 @@ class SequenceAbuseDetector:
         self._lock = threading.RLock()
 
         mode = seq_mode if mesh is not None else "dense"
+        self._batch_multiple = (
+            int(mesh.shape.get("data", 1)) if (mesh is not None and mode != "dense") else 1
+        )
         self._fn = jax.jit(
             lambda p, x: sequence_forward(p, x, self.cfg, mesh=mesh, seq_mode=mode)["abuse"]
         )
@@ -106,7 +109,14 @@ class SequenceAbuseDetector:
     def check_batch(self, account_ids: list[str], seq_len: int | None = None) -> np.ndarray:
         seq_len = seq_len or min(self.max_history, 64)
         x = self._history_matrix(account_ids, seq_len)
-        return np.asarray(self._fn(self.params, x))
+        # On a mesh, the batch axis shards over `data`: pad to a multiple
+        # of the axis size (fixed-shape discipline, same as the scorer's
+        # batcher) and slice the padding back off.
+        n = x.shape[0]
+        if self._batch_multiple > 1 and n % self._batch_multiple:
+            padded = ((n + self._batch_multiple - 1) // self._batch_multiple) * self._batch_multiple
+            x = np.concatenate([x, np.zeros((padded - n, *x.shape[1:]), x.dtype)])
+        return np.asarray(self._fn(self.params, x))[:n]
 
     def is_abuser(self, account_id: str) -> bool:
         """BonusEngine RiskChecker seam (bonus_engine.go:139-141)."""

@@ -84,9 +84,12 @@ class RiskServer:
             n = len(devs) if self.config.mesh_devices == -1 else self.config.mesh_devices
             if n > len(devs):
                 raise RuntimeError(f"MESH_DEVICES={n} but only {len(devs)} devices visible")
+            seq = max(1, self.config.mesh_seq)
+            if n % seq != 0:
+                raise RuntimeError(f"MESH_SEQ={seq} must divide MESH_DEVICES={n}")
             if n > 1:
-                mesh = create_mesh(MeshSpec(data=n), devices=devs[:n])
-                logger.info("serving mesh: data=%d over %s", n, devs[:n])
+                mesh = create_mesh(MeshSpec(data=n // seq, seq=seq), devices=devs[:n])
+                logger.info("serving mesh: data=%d seq=%d over %d devices", n // seq, seq, n)
 
         # Feature store: the native C++ core by default (SURVEY.md §2.2's
         # native ingest bridge), Python fallback when the build is absent.
@@ -113,7 +116,13 @@ class RiskServer:
             batcher_config=self.config.batcher,
             feature_store=feature_store,
         )
-        self.abuse = SequenceAbuseDetector()
+        # Sequence-parallel abuse scoring when the mesh has a `seq` axis:
+        # ring attention shards each event history across chips (CP).
+        seq_sharded = mesh is not None and int(mesh.shape.get("seq", 1)) > 1
+        self.abuse = SequenceAbuseDetector(
+            mesh=mesh if seq_sharded else None,
+            seq_mode="ring" if seq_sharded else "dense",
+        )
         self.broker = broker or default_broker()
         self.bridge = ScoringBridge(self.engine, self.broker, abuse_detector=self.abuse)
 

@@ -220,3 +220,40 @@ def test_ready_reflects_device_liveness(monkeypatch):
             assert json.load(e) == {"ready": False, "device": False}
     finally:
         server.shutdown(grace=1.0)
+
+
+def test_risk_server_with_sequence_parallel_abuse(monkeypatch):
+    """MESH_DEVICES + MESH_SEQ builds a data x seq mesh: scoring shards
+    over data, the abuse detector ring-shards histories over seq — both
+    served over gRPC from one process."""
+    import grpc
+
+    from igaming_platform_tpu.core.config import RiskServiceConfig
+    from igaming_platform_tpu.proto_gen.risk.v1 import risk_pb2
+    from igaming_platform_tpu.serve.grpc_server import make_risk_stub
+    from igaming_platform_tpu.serve.server import RiskServer
+
+    monkeypatch.setenv("MESH_DEVICES", "-1")
+    monkeypatch.setenv("MESH_SEQ", "2")
+    monkeypatch.setenv("BATCH_SIZE", "64")
+    monkeypatch.setenv("GRPC_PORT", "0")
+    monkeypatch.setenv("HTTP_PORT", "0")
+    server = RiskServer(RiskServiceConfig.from_env())
+    try:
+        import jax
+        assert server.engine._mesh.shape["seq"] == 2
+        assert server.engine._mesh.shape["data"] == len(jax.devices()) // 2
+        channel = grpc.insecure_channel(f"localhost:{server.grpc_port}")
+        stub = make_risk_stub(channel)
+        # Feed a history, then run the sequence detector over the wire.
+        for i in range(8):
+            server.abuse.record_event("sp-acct", 1_000 + i, "bet", timestamp=float(i))
+        r = stub.CheckBonusAbuse(risk_pb2.CheckBonusAbuseRequest(
+            account_id="sp-acct", bonus_id="b1"))
+        assert 0.0 <= r.abuse_score <= 1.0
+        s = stub.ScoreTransaction(risk_pb2.ScoreTransactionRequest(
+            account_id="sp-acct", amount=2_000, transaction_type="deposit"))
+        assert 0 <= s.score <= 100
+        channel.close()
+    finally:
+        server.shutdown(grace=1.0)
