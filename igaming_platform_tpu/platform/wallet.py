@@ -328,12 +328,24 @@ class WalletService:
                      event_type=EventType.BONUS_AWARDED)
         return OpResult(tx, account.balance + new_bonus)
 
-    def forfeit_bonus_balance(self, account_id: str) -> int:
-        """Zero the bonus balance (early-withdrawal forfeiture support)."""
+    def forfeit_bonus_balance(self, account_id: str, reason: str = "") -> int:
+        """Zero the bonus balance (early-withdrawal forfeiture support).
+
+        Runs as a real ADJUSTMENT transaction through the commit pipeline
+        so the double-entry ledger records the debit — forfeited money
+        must leave the books, not vanish from them (the reconciliation
+        sweep would flag a bare balance overwrite as a mismatch).
+        """
         account = self.accounts.get_by_id(account_id)
         forfeited = account.bonus
         if forfeited:
-            self.accounts.update_balance(account.id, account.balance, 0, account.version)
+            tx = self._pending_tx(
+                account, f"forfeit:{new_id()}", TxType.ADJUSTMENT, forfeited,
+                f"bonus-forfeiture:{reason}" if reason else "bonus-forfeiture",
+            )
+            tx.balance_before = account.balance + account.bonus
+            tx.balance_after = account.balance
+            self._commit(account, tx, account.balance, 0, "Bonus forfeiture", None)
             self._audit("account", account_id, "bonus_forfeiture",
                         old=str(forfeited), new="0")
         return forfeited
