@@ -127,3 +127,44 @@ def test_random_op_sequences_hold_invariants(backend, seed, tmp_path):
     assert replay_checks > 0  # the replay arm actually exercised
     if store is not None:
         store.close()
+
+
+def test_rule_scores_monotone_in_risk_direction():
+    """Property: pushing any rule feature toward 'riskier' never LOWERS
+    the rule score (a vectorization sign/threshold error would).
+
+    Directions per engine.go:420-483: velocity, devices, IPs, VPN flags,
+    withdrawals, bonus claims increase risk; account age decreases it.
+    """
+    import numpy as np
+
+    from igaming_platform_tpu.core.config import ScoringConfig
+    from igaming_platform_tpu.core.features import F
+    from igaming_platform_tpu.models.rules import apply_rules
+    from igaming_platform_tpu.train.data import sample_features
+
+    cfg = ScoringConfig()
+    rng = np.random.default_rng(42)
+    x = sample_features(rng, 512)
+    bl = np.zeros((512,), dtype=bool)
+    base = np.asarray(apply_rules(x, bl, cfg)[0])
+
+    riskier_up = [F.TX_COUNT_1M, F.UNIQUE_DEVICES_24H, F.UNIQUE_IPS_24H,
+                  F.IS_VPN, F.IS_PROXY, F.IS_TOR, F.TOTAL_WITHDRAWALS,
+                  F.BONUS_CLAIM_COUNT, F.TX_AMOUNT]
+    for f in riskier_up:
+        x2 = x.copy()
+        x2[:, f] = x2[:, f] * 10 + 100  # push well past any threshold
+        s2 = np.asarray(apply_rules(x2, bl, cfg)[0])
+        assert np.all(s2 >= base), f"score dropped when increasing feature {f}"
+
+    # Younger accounts are riskier: age -> 0 must not lower the score.
+    x3 = x.copy()
+    x3[:, F.ACCOUNT_AGE_DAYS] = 0.0
+    s3 = np.asarray(apply_rules(x3, bl, cfg)[0])
+    assert np.all(s3 >= base)
+
+    # Blacklisting dominates: +KNOWN_FRAUDSTER weight, never a decrease.
+    s_bl = np.asarray(apply_rules(x, np.ones((512,), dtype=bool), cfg)[0])
+    assert np.all(s_bl >= base)
+    assert np.all(s_bl >= np.minimum(base + 50, 100) - (base >= 100) * 50)
