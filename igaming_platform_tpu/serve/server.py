@@ -94,7 +94,12 @@ class RiskServer:
         # Feature store: the native C++ core by default (SURVEY.md §2.2's
         # native ingest bridge), Python fallback when the build is absent.
         feature_store = None
-        if self.config.feature_store in ("auto", "native"):
+        if self.config.feature_store == "redis":
+            from igaming_platform_tpu.serve.redis_store import RedisFeatureStore
+
+            feature_store = RedisFeatureStore(self.config.redis_url)
+            logger.info("using Redis feature store at %s", self.config.redis_url)
+        elif self.config.feature_store in ("auto", "native"):
             from igaming_platform_tpu.serve.native_store import native_available
 
             if native_available():
