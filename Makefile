@@ -42,6 +42,10 @@ run-risk:
 run-wallet:
 	$(PY) -m igaming_platform_tpu.platform.server
 
+# LTV batch job: wallet DB -> per-player segments (one device pass).
+ltv-job:
+	$(PY) -m igaming_platform_tpu.serve.ltv_job $(DB)
+
 # Multi-chip sharding validation on virtual CPU devices.
 dryrun:
 	$(CPU_ENV) $(PY) __graft_entry__.py
