@@ -66,6 +66,7 @@ def ltv_features_from_wallet(db_path: str, now: float | None = None) -> tuple[li
         bet = per_type.get("bet", (0, 0, 0, 0.0))
         win = per_type.get("win", (0, 0, 0, 0.0))
         wd = per_type.get("withdraw", (0, 0, 0, 0.0))
+        bonus = per_type.get("bonus_grant", (0, 0, 0, 0.0))
 
         age_days = max(0.0, (now - created_at) / _SECONDS_PER_DAY)
         x[i, L.DAYS_SINCE_REGISTRATION] = age_days
@@ -78,7 +79,9 @@ def ltv_features_from_wallet(db_path: str, now: float | None = None) -> tuple[li
         x[i, L.TOTAL_ACTIVE_DAYS] = active.get(account_id, 0)
         x[i, L.TOTAL_DEPOSITS] = dep[1] / 100.0          # cents -> dollars
         x[i, L.TOTAL_WITHDRAWALS] = wd[1] / 100.0
-        x[i, L.NET_REVENUE] = (bet[1] - win[1]) / 100.0  # GGR
+        # net_revenue = deposits - withdrawals - bonuses (ltv.go:50) — the
+        # quantity LTV projection and segmentation key on; NOT bets-wins.
+        x[i, L.NET_REVENUE] = (dep[1] - wd[1] - bonus[1]) / 100.0
         x[i, L.AVG_DEPOSIT_AMOUNT] = (dep[1] / dep[0] / 100.0) if dep[0] else 0.0
         x[i, L.DEPOSIT_FREQUENCY] = dep[0] / max(age_days / 30.0, 1.0)  # per month
         x[i, L.LARGEST_DEPOSIT] = dep[2] / 100.0

@@ -40,15 +40,19 @@ def test_feature_matrix_from_wallet_scan(tmp_path):
     path = seeded_db(tmp_path)
     ids, x = ltv_features_from_wallet(path)
     assert len(ids) == 3 and x.shape == (3, 25)
+    # Key rows by account id (row order from SQLite is unspecified).
+    store = SQLiteStore(path)
+    whale_id = store.accounts.get_by_player_id("whale").id
+    ghost_id = store.accounts.get_by_player_id("ghost").id
+    store.close()
     by_id = dict(zip(ids, x))
-    whale = next(v for k, v in by_id.items())  # order matches insertion
-    whale = x[0]
+    whale = by_id[whale_id]
     assert whale[L.TOTAL_DEPOSITS] == 10 * 5_000.0     # dollars
     assert whale[L.BET_COUNT] == 30
     assert np.isclose(whale[L.WIN_RATE], 10 / 30)
     assert whale[L.LARGEST_DEPOSIT] == 5_000.0
-    ghost = x[2]
-    assert ghost[L.TOTAL_DEPOSITS] == 0.0
+    assert whale[L.NET_REVENUE] == 10 * 5_000.0        # deposits - withdrawals
+    assert by_id[ghost_id][L.TOTAL_DEPOSITS] == 0.0
 
 
 def test_batch_job_segments_whales_above_casuals(tmp_path):
@@ -58,7 +62,10 @@ def test_batch_job_segments_whales_above_casuals(tmp_path):
     assert result["count"] == 3
     recs = {r["account_id"]: r for r in result["players"]}
     ids, _ = ltv_features_from_wallet(path)
-    whale, casual, ghost = ids
+    store = SQLiteStore(path)
+    whale = store.accounts.get_by_player_id("whale").id
+    casual = store.accounts.get_by_player_id("casual").id
+    store.close()
     assert recs[whale]["predicted_ltv"] > recs[casual]["predicted_ltv"]
     assert recs[whale]["segment"] <= recs[casual]["segment"]  # 1=VIP .. 5=churning
     assert recs[whale]["next_best_action"] in (
