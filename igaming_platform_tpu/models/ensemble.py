@@ -129,6 +129,12 @@ def make_score_fn(
             from igaming_platform_tpu.models.multitask import fraud_predict
 
             ml = fraud_predict(params["multitask"], xn)
+        elif ml_backend == "multitask_int8":
+            # Quantized fraud path of a trained multitask checkpoint
+            # (ops.quantize.quantize_multitask_fraud).
+            from igaming_platform_tpu.ops.quantize import mlp_predict_int8
+
+            ml = mlp_predict_int8(params["multitask_int8"], xn)
         else:
             raise ValueError(f"unknown ml backend: {ml_backend}")
 

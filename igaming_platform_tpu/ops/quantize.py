@@ -63,6 +63,20 @@ def quantize_mlp(params: Params, calibration_x: jnp.ndarray | None = None) -> Pa
     return {"layers": layers, "input_scale": input_scale, "quantized": True}
 
 
+def quantize_multitask_fraud(params: Params, calibration_x: jnp.ndarray | None = None) -> Params:
+    """Quantize a TRAINED multitask checkpoint's fraud path.
+
+    The fraud view of the multitask net is exactly an MLP — trunk ReLU
+    stack + fraud head (models/multitask.fraud_predict) — so the trained
+    train-loop checkpoint quantizes for serving with no re-training and no
+    export format: hand the result to ml_backend="multitask_int8".
+    """
+    return quantize_mlp(
+        {"layers": [*params["trunk"]["layers"], params["fraud_head"]]},
+        calibration_x=calibration_x,
+    )
+
+
 def _quantize_rows(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """[B, D] f32 -> (int8, [B] per-row scales), symmetric absmax."""
     absmax = jnp.max(jnp.abs(x), axis=-1)
