@@ -35,7 +35,7 @@ from igaming_platform_tpu.platform.risk_adapter import InProcessRiskGate
 from igaming_platform_tpu.platform.wallet import WalletConfig, WalletService
 from igaming_platform_tpu.serve.abuse import SequenceAbuseDetector
 from igaming_platform_tpu.serve.bridge import ScoringBridge
-from igaming_platform_tpu.serve.events import Consumer, Event, default_broker
+from igaming_platform_tpu.serve.events import Consumer, DeliveryDeduper, Event, default_broker
 from igaming_platform_tpu.serve.scorer import TPUScoringEngine
 
 DEFAULT_RULES = "igaming_platform_tpu/platform/configs/bonus_rules.yaml"
@@ -106,6 +106,10 @@ class PlatformApp:
         )
         self._bonus_consumer = Consumer(self.broker)
         self._bonus_consumer.subscribe(QUEUE_BONUS_PROCESSOR, self._on_wallet_event)
+        # The outbox relay redelivers on crash-between-publish-and-mark;
+        # process_wager is NOT idempotent (progress accumulates), so the
+        # bonus processor dedupes on envelope id like the scoring bridge.
+        self._wager_dedupe = DeliveryDeduper()
 
     # -- wiring --------------------------------------------------------------
 
@@ -131,9 +135,27 @@ class PlatformApp:
             return
         if event.data.get("type") != "bet":
             return
+        # Atomic claim/release: a claim taken before the side effect stops
+        # both redeliveries AND concurrent duplicate deliveries from
+        # double-counting; releasing on failure keeps the consumer's
+        # nack+requeue retry path alive. Events without an id can't be
+        # deduped — process them unconditionally (bridge.py does the same).
+        claimed = bool(event.id) and self._wager_dedupe.claim(event.id)
+        if event.id and not claimed:
+            return
         account_id = str(event.data.get("account_id", ""))
         amount = int(event.data.get("amount", 0))
-        self.bonus.process_wager(account_id, amount, str(event.data.get("game_category", "slots")))
+        try:
+            # The event carries the bet's real game_category (wallet.py
+            # event_extra); an absent/empty value hits the bonus engine's
+            # default-weight path rather than masquerading as slots.
+            self.bonus.process_wager(
+                account_id, amount, str(event.data.get("game_category", ""))
+            )
+        except BaseException:
+            if claimed:
+                self._wager_dedupe.release(event.id)
+            raise
 
     def _max_bet_gate(self, account_id: str, amount: int) -> None:
         try:

@@ -18,6 +18,7 @@ import time
 from dataclasses import dataclass, field
 
 from igaming_platform_tpu.platform.domain import BalanceSnapshot
+from igaming_platform_tpu.platform.repository import uow_of
 
 
 @dataclass
@@ -54,13 +55,41 @@ class Reconciler:
         self.metrics = metrics
         self.last_report: ReconciliationReport | None = None
 
+    def _read_pair(self, account_id: str):
+        """Read (account, ledger-derived balance) as one consistent snapshot.
+
+        The two reads must not interleave with a committing wallet op, or a
+        perfectly healthy store reports a phantom mismatch. When the store
+        exposes unit_of_work, reading inside it holds the store lock for
+        both calls; otherwise the caller re-checks a mismatch once before
+        believing it.
+        """
+        uow = uow_of(self.accounts)
+        if uow is not None:
+            with uow():
+                return (
+                    self.accounts.get_by_id(account_id),
+                    self.ledger.get_account_balance(account_id),
+                )
+        return (
+            self.accounts.get_by_id(account_id),
+            self.ledger.get_account_balance(account_id),
+        )
+
     def run_once(self, keep_snapshots: bool = False) -> ReconciliationReport:
         start = time.monotonic()
         report = ReconciliationReport(run_at=time.time())
         for account_id in self.accounts.list_ids():
-            acct = self.accounts.get_by_id(account_id)
-            derived = self.ledger.get_account_balance(account_id)
+            acct, derived = self._read_pair(account_id)
             recorded = acct.balance + acct.bonus
+            if derived != recorded and uow_of(self.accounts) is None:
+                # Torn-read defense, only for stores without unit_of_work
+                # (a uow-backed read pair is already consistent): a wallet
+                # op may have committed between the two reads above. An
+                # observed mismatch must survive one re-read before it is
+                # recorded as real.
+                acct, derived = self._read_pair(account_id)
+                recorded = acct.balance + acct.bonus
             report.checked += 1
             if keep_snapshots:
                 report.snapshots.append(BalanceSnapshot(

@@ -224,6 +224,23 @@ class InMemoryLedgerRepository:
         return self.get_account_balance(account_id) == recorded_balance
 
 
+def store_of(repo):
+    """The transactional store backing a repository view, or None.
+
+    SQLite repository views carry their store as ``_s``; in-memory repos
+    have no shared store. Callers use this (and :func:`uow_of`) instead of
+    probing private attributes at each site, so the contract lives in one
+    place next to the classes that define it.
+    """
+    return getattr(repo, "_s", None)
+
+
+def uow_of(repo):
+    """The unit-of-work factory of the store backing ``repo``, or None
+    when the backend cannot run multi-call transactions."""
+    return getattr(store_of(repo), "unit_of_work", None)
+
+
 # ---------------------------------------------------------------------------
 # SQLite implementation (durable single-file deployment)
 # ---------------------------------------------------------------------------
@@ -315,9 +332,19 @@ class SQLiteStore:
         self._conn.close()
 
     def _commit(self) -> None:
-        """Commit unless inside a unit of work (then the UoW commits)."""
+        """Commit unless inside a unit of work (then the UoW commits).
+
+        A COMMIT that raises must roll its pending writes back — on this
+        shared connection they would otherwise ride along with the next
+        unrelated commit, materializing a write whose caller was told
+        failed.
+        """
         if self._tx_depth == 0:
-            self._conn.commit()
+            try:
+                self._conn.commit()
+            except BaseException:
+                self._conn.rollback()
+                raise
 
     @contextlib.contextmanager
     def unit_of_work(self):
@@ -339,7 +366,7 @@ class SQLiteStore:
             else:
                 self._tx_depth -= 1
                 if self._tx_depth == 0:
-                    self._conn.commit()
+                    self._commit()
 
     def audit(self, entity: str, entity_id: str, action: str, old: str = "", new: str = "") -> None:
         with self._lock:
@@ -348,7 +375,7 @@ class SQLiteStore:
                 " VALUES (?,?,?,?,?,?)",
                 (entity, entity_id, action, old, new, time.time()),
             )
-            self._conn.commit()
+            self._commit()
 
     def outbox_add(self, exchange: str, routing_key: str, payload: str) -> None:
         with self._lock:
@@ -357,7 +384,7 @@ class SQLiteStore:
                 " VALUES (?,?,?,0,?)",
                 (exchange, routing_key, payload, time.time()),
             )
-            self._conn.commit()
+            self._commit()
 
     def outbox_drain(self) -> Iterable[tuple[int, str, str, str]]:
         """Yield unpublished outbox rows; caller marks them published."""
@@ -370,7 +397,7 @@ class SQLiteStore:
     def outbox_mark_published(self, row_id: int) -> None:
         with self._lock:
             self._conn.execute("UPDATE event_outbox SET published = 1 WHERE id = ?", (row_id,))
-            self._conn.commit()
+            self._commit()
 
     def outbox_purge_published(self, older_than_s: float = 3600.0) -> int:
         """Delete published rows past the retention window so the table
@@ -380,7 +407,7 @@ class SQLiteStore:
                 "DELETE FROM event_outbox WHERE published = 1 AND created_at < ?",
                 (time.time() - older_than_s,),
             )
-            self._conn.commit()
+            self._commit()
             return cur.rowcount
 
 

@@ -46,6 +46,7 @@ from igaming_platform_tpu.platform.domain import (
     new_id,
 )
 from igaming_platform_tpu.platform.outbox import OutboxPublisher
+from igaming_platform_tpu.platform.repository import store_of, uow_of
 from igaming_platform_tpu.serve.events import Event, Publisher, new_transaction_event
 
 
@@ -222,7 +223,8 @@ class WalletService:
         tx.balance_before = total
         tx.balance_after = new_balance + new_bonus
         self._commit(account, tx, new_balance, new_bonus, "Bet", risk_score,
-                     event_type=EventType.TRANSACTION_COMPLETED)
+                     event_type=EventType.TRANSACTION_COMPLETED,
+                     event_extra={"game_category": game_category})
         return OpResult(tx, new_balance + new_bonus, risk_score, real_deducted, bonus_deducted)
 
     def win(
@@ -416,6 +418,7 @@ class WalletService:
         self, account: Account, tx: Transaction, new_balance: int, new_bonus: int,
         description: str, risk_score: int | None,
         event_type: EventType = EventType.TRANSACTION_COMPLETED,
+        event_extra: dict | None = None,
     ) -> None:
         """Persist the money movement: tx row -> optimistic balance update ->
         ledger -> complete + event.
@@ -428,20 +431,32 @@ class WalletService:
         guarantee level).
         """
         tx.risk_score = risk_score
-        uow = getattr(getattr(self.transactions, "_s", None), "unit_of_work", None)
+        uow = uow_of(self.transactions)
+        deferred_event: Event | None = None
         try:
             with uow() if uow is not None else _null_uow():
                 self.transactions.create(tx)
                 self.accounts.update_balance(account.id, new_balance, new_bonus, account.version)
                 self._ledger_entry(tx, description)
                 tx.complete()
-                self._complete_and_publish(tx, new_transaction_event(event_type.value, {
-                    "id": tx.id, "account_id": tx.account_id, "type": tx.type.value,
-                    "amount": tx.amount, "balance_before": tx.balance_before,
-                    "balance_after": tx.balance_after, "status": tx.status.value,
-                    "game_id": tx.game_id or "", "round_id": tx.round_id or "",
-                    "risk_score": risk_score or 0,
-                }))
+                deferred_event = self._complete_and_publish(
+                    tx,
+                    new_transaction_event(event_type.value, {
+                        "id": tx.id, "account_id": tx.account_id, "type": tx.type.value,
+                        "amount": tx.amount, "balance_before": tx.balance_before,
+                        "balance_after": tx.balance_after, "status": tx.status.value,
+                        "game_id": tx.game_id or "", "round_id": tx.round_id or "",
+                        "risk_score": risk_score or 0,
+                        **(event_extra or {}),
+                    }),
+                    defer_publish=uow is not None,
+                )
+            # A direct-broker publish must not race the database commit: a
+            # rollback after publish would emit a ghost event for a money
+            # movement that never happened. Publish only once the UoW above
+            # has committed.
+            if deferred_event is not None:
+                self._publish(deferred_event)
         except ConcurrentUpdateError:
             # The optimistic-lock loser keeps an auditable FAILED row (the
             # UoW rolled its pending row back, so persist it afresh; the
@@ -467,25 +482,33 @@ class WalletService:
             description=description,
         ))
 
-    def _complete_and_publish(self, tx: Transaction, event: Event) -> None:
+    def _complete_and_publish(
+        self, tx: Transaction, event: Event, *, defer_publish: bool = False
+    ) -> Event | None:
         """Mark the transaction completed and emit its event.
 
         When the event seam is the transactional outbox backed by the SAME
         store as the transaction rows, the completion update and the event
         stage commit atomically (update_with_event) — a crash cannot
         complete the money movement without staging its event. Otherwise
-        (in-memory repos, direct broker) the two steps run sequentially.
+        (in-memory repos, direct broker) the two steps run sequentially;
+        with ``defer_publish`` the event is returned to the caller to
+        publish after its unit of work commits, instead of reaching the
+        broker while the transaction is still uncommitted.
         """
         atomic = (
             isinstance(self.events, OutboxPublisher)
             and hasattr(self.transactions, "update_with_event")
-            and getattr(self.transactions, "_s", None) is self.events.outbox
+            and store_of(self.transactions) is self.events.outbox
         )
         if atomic:
             self.transactions.update_with_event(tx, EXCHANGE_WALLET, event.type, event.to_json())
-        else:
-            self.transactions.update(tx)
-            self._publish(event)
+            return None
+        self.transactions.update(tx)
+        if defer_publish:
+            return event
+        self._publish(event)
+        return None
 
     def _audit(self, entity: str, entity_id: str, action: str, old: str = "", new: str = "") -> None:
         if self.audit is not None:

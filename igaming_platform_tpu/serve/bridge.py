@@ -13,7 +13,6 @@ bench, BASELINE config 2) via ``replay()``.
 from __future__ import annotations
 
 import logging
-from collections import OrderedDict
 from typing import Iterable
 
 from igaming_platform_tpu.core.enums import (
@@ -23,6 +22,7 @@ from igaming_platform_tpu.core.enums import (
 )
 from igaming_platform_tpu.serve.events import (
     Consumer,
+    DeliveryDeduper,
     Event,
     InMemoryBroker,
     Publisher,
@@ -67,8 +67,7 @@ class ScoringBridge:
         # envelope id so a replayed delivery can't double-count velocity
         # features. Bounded FIFO (duplicates arrive close to the original:
         # crash-replay or broker redelivery, not arbitrarily late).
-        self._seen_ids: OrderedDict[str, None] = OrderedDict()
-        self._seen_capacity = 65_536
+        self._dedupe = DeliveryDeduper()
         self._consumer = Consumer(broker)
         self._consumer.subscribe(QUEUE_RISK_SCORING, self._handle_event)
 
@@ -125,20 +124,24 @@ class ScoringBridge:
         self._ingest(event, req)
         return True
 
-    def _is_duplicate(self, event: Event) -> bool:
-        if not event.id:
-            return False
-        if event.id in self._seen_ids:
-            return True
-        self._seen_ids[event.id] = None
-        if len(self._seen_ids) > self._seen_capacity:
-            self._seen_ids.popitem(last=False)
-        return False
-
     def _handle_event(self, event: Event) -> None:
-        if self._is_duplicate(event):
+        # Claim/release dedupe: the claim is taken before the side effects
+        # (so a redelivery or concurrent duplicate can't double-count
+        # velocity features) and released if the handler fails (so the
+        # consumer's nack+requeue retry isn't misread as a duplicate).
+        # Events without an id can't be deduped — processed as-is.
+        claimed = bool(event.id) and self._dedupe.claim(event.id)
+        if event.id and not claimed:
             self.events_deduped += 1
             return
+        try:
+            self._process_event(event)
+        except BaseException:
+            if claimed:
+                self._dedupe.release(event.id)
+            raise
+
+    def _process_event(self, event: Event) -> None:
         req = self._event_to_request(event)
         if req is None:
             if self._ingest_only(event):

@@ -23,6 +23,7 @@ import queue
 import threading
 import time
 import uuid
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -165,6 +166,54 @@ def default_broker() -> InMemoryBroker:
     return b
 
 
+class DeliveryDeduper:
+    """Bounded seen-id set for at-least-once consumers.
+
+    The transactional outbox makes every wallet event at-least-once
+    (outbox.py contract: consumers dedupe on the envelope id). Any handler
+    whose effect is not idempotent — wagering progress, feature updates —
+    must gate on this before acting on a delivery.
+    """
+
+    def __init__(self, capacity: int = 65_536):
+        self._seen: OrderedDict[str, None] = OrderedDict()
+        self._capacity = capacity
+        self._lock = threading.Lock()
+
+    def is_duplicate(self, event_id: str) -> bool:
+        """Record the id; True if it was already seen (redelivery)."""
+        with self._lock:
+            if event_id in self._seen:
+                return True
+            self._record_locked(event_id)
+            return False
+
+    def claim(self, event_id: str) -> bool:
+        """Atomically claim an id for processing; False if already claimed.
+
+        For handlers that can fail after the duplicate check: claim before
+        the side effect, :meth:`release` on failure (so the nack+requeue
+        retry is not misread as a duplicate). The claim is atomic, so two
+        concurrent deliveries of the same envelope cannot both pass the
+        check and double-apply the effect.
+        """
+        with self._lock:
+            if event_id in self._seen:
+                return False
+            self._record_locked(event_id)
+            return True
+
+    def release(self, event_id: str) -> None:
+        """Undo a claim after the handler failed, re-arming the retry."""
+        with self._lock:
+            self._seen.pop(event_id, None)
+
+    def _record_locked(self, event_id: str) -> None:
+        self._seen[event_id] = None
+        if len(self._seen) > self._capacity:
+            self._seen.popitem(last=False)
+
+
 class Publisher:
     """Publisher facade (Publish routes by event type, publisher.go:160-162)."""
 
@@ -265,6 +314,10 @@ def new_transaction_event(event_type: str, tx: dict) -> Event:
             "status": tx.get("status", ""),
             "game_id": tx.get("game_id", ""),
             "round_id": tx.get("round_id", ""),
+            # Carried for the bonus processor: wagering contribution is
+            # weighted per game category (bonus_engine.go:485-514), so the
+            # event must say what was actually played.
+            "game_category": tx.get("game_category", ""),
             "risk_score": tx.get("risk_score", 0),
         },
     )
