@@ -1,16 +1,16 @@
-"""Benchmark harness — fraud-scoring throughput on the live device.
+"""Benchmark harness — fraud-scoring throughput, END-TO-END at the wire.
 
-Runs the flagship serving graph (normalize -> multitask fraud head ->
-vectorized rules -> ensemble -> action, one XLA program) over streamed
-[B, 30] batches, including host->device transfer per batch, and prints ONE
-JSON line:
+Headline: risk.v1 ScoreBatch over a real gRPC socket — request decode,
+native feature-store gather, the compiled device step, native response
+encode — sustained txns/s at ingress (the full request path of
+engine.go:262-323, which the reference's "< 50 ms" claim applies to).
+Device-only figures are reported alongside: the compiled graph's
+streaming throughput and pure device-step time.
 
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
-
-Baseline: the reference publishes no throughput (BASELINE.md) — its path is
-single-sample ONNX-CPU behind CGo. ``vs_baseline`` is measured against the
-north-star target of 100,000 fraud-scored txns/sec (BASELINE.json), so
-vs_baseline >= 1.0 means the target is met.
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ...,
+"vs_baseline": N}. Baseline: the reference publishes no throughput
+(BASELINE.md); vs_baseline is against the north-star 100,000 txns/s
+(BASELINE.json), so vs_baseline >= 1.0 means target met.
 """
 
 import json
@@ -25,7 +25,9 @@ import numpy as np
 TARGET_TXNS_PER_SEC = 100_000.0
 
 
-def main() -> None:
+def device_pipeline_numbers() -> dict:
+    """The compiled serving graph streamed with H2D transfer per batch
+    (pipelined like the batcher), plus pure device-step time."""
     import jax
 
     from igaming_platform_tpu.core.config import ScoringConfig
@@ -36,29 +38,21 @@ def main() -> None:
     batch_size = int(os.environ.get("BENCH_BATCH", 16384))
     warmup_iters = int(os.environ.get("BENCH_WARMUP", 5))
     iters = int(os.environ.get("BENCH_ITERS", 50))
+    pipeline_depth = int(os.environ.get("BENCH_PIPELINE_DEPTH", 4))
 
     cfg = ScoringConfig()
     fn = jax.jit(make_score_fn(cfg, ml_backend="multitask"), donate_argnums=(1,))
     params = {"multitask": init_multitask(jax.random.key(0))}
     thresholds = np.array([cfg.block_threshold, cfg.review_threshold], dtype=np.int32)
 
-    pipeline_depth = int(os.environ.get("BENCH_PIPELINE_DEPTH", 4))
-
     rng = np.random.default_rng(0)
     pool = [sample_features(rng, batch_size) for _ in range(4)]
     blacklisted = np.zeros((batch_size,), dtype=bool)
 
-    # Warm-up: compile + stabilise clocks.
     for i in range(warmup_iters):
         out = fn(params, pool[i % len(pool)].copy(), blacklisted, thresholds)
     jax.block_until_ready(out)
 
-    # Steady state, pipelined like the serving batcher: keep `depth`
-    # batches in flight so host->device copies overlap device compute and
-    # readback (on a tunneled dev chip the link, not the chip, is the
-    # bottleneck — serializing copy/compute/readback would measure tunnel
-    # weather, not the architecture). Per-batch latency is dispatch ->
-    # result-ready for each in-flight slot.
     lat = []
     inflight = []
     start = time.perf_counter()
@@ -75,9 +69,7 @@ def main() -> None:
         lat.append((time.perf_counter() - t0_old) * 1000.0)
     total = time.perf_counter() - start
 
-    # Pure device-step time (device-resident inputs): the architecture
-    # number, insulated from host-link variance. Separate non-donating jit
-    # so the resident input survives reuse.
+    # Pure device-step time with device-resident inputs.
     fn_nd = jax.jit(make_score_fn(cfg, ml_backend="multitask"))
     xd = jax.device_put(pool[0])
     bld = jax.device_put(blacklisted)
@@ -91,23 +83,75 @@ def main() -> None:
     jax.block_until_ready(out)
     device_step_ms = (time.perf_counter() - t0) / dev_iters * 1000.0
 
-    txns_per_sec = batch_size * iters / total
     lat = np.array(lat)
-    result = {
-        "metric": "fraud_score_txns_per_sec",
-        "value": round(float(txns_per_sec), 1),
-        "unit": "txns/s",
-        "vs_baseline": round(float(txns_per_sec / TARGET_TXNS_PER_SEC), 3),
-        "batch_size": batch_size,
-        "iters": iters,
-        "pipeline_depth": pipeline_depth,
-        "p50_batch_ms": round(float(np.percentile(lat, 50)), 3),
-        "p99_batch_ms": round(float(np.percentile(lat, 99)), 3),
+    return {
+        "device_stream_txns_per_sec": round(batch_size * iters / total, 1),
+        "device_stream_p99_batch_ms": round(float(np.percentile(lat, 99)), 3),
         "device_step_ms": round(device_step_ms, 3),
         "device_txns_per_sec": round(batch_size / (device_step_ms / 1000.0), 1),
-        "device": str(jax.devices()[0]),
-        "backend": "multitask-ensemble",
+        "batch_size": batch_size,
+        "pipeline_depth": pipeline_depth,
     }
+
+
+def e2e_numbers() -> dict:
+    """ScoreBatch + ScoreTransaction over a real gRPC socket against the
+    production wiring (native store, multitask backend, native encoder)."""
+    from benchmarks.load_gen import (
+        run_grpc_load,
+        run_single_txn_probe,
+        start_inprocess_server,
+    )
+
+    addr, shutdown = start_inprocess_server(
+        batch_size=int(os.environ.get("BENCH_E2E_BATCH", 8192)),
+    )
+    try:
+        load = run_grpc_load(
+            addr,
+            duration_s=float(os.environ.get("BENCH_E2E_DURATION_S", 8.0)),
+            rows_per_rpc=int(os.environ.get("BENCH_E2E_ROWS_PER_RPC", 8192)),
+            concurrency=int(os.environ.get("BENCH_E2E_CONCURRENCY", 6)),
+        )
+        probe = run_single_txn_probe(addr, n=120)
+        return {
+            "e2e_txns_per_sec": load["value"],
+            "e2e_rpc_p50_ms": load["rpc_p50_ms"],
+            "e2e_rpc_p99_ms": load["rpc_p99_ms"],
+            "e2e_rows_per_rpc": load["rows_per_rpc"],
+            "e2e_concurrency": load["concurrency"],
+            "e2e_rpc_errors": load["errors"],
+            "e2e_single_txn_p50_ms": probe["p50_ms"],
+            "e2e_single_txn_p99_ms": probe["value"],
+        }
+    finally:
+        shutdown()
+
+
+def main() -> None:
+    import jax
+
+    result = {"device": str(jax.devices()[0]), "backend": "multitask-ensemble"}
+    result.update(device_pipeline_numbers())
+
+    try:
+        result.update(e2e_numbers())
+        headline = float(result["e2e_txns_per_sec"])
+        result.update({
+            "metric": "e2e_grpc_fraud_score_txns_per_sec",
+            "value": round(headline, 1),
+            "unit": "txns/s",
+            "vs_baseline": round(headline / TARGET_TXNS_PER_SEC, 3),
+        })
+    except Exception as exc:  # noqa: BLE001 — never lose the device figure
+        headline = float(result["device_stream_txns_per_sec"])
+        result.update({
+            "metric": "fraud_score_txns_per_sec",
+            "value": round(headline, 1),
+            "unit": "txns/s",
+            "vs_baseline": round(headline / TARGET_TXNS_PER_SEC, 3),
+            "e2e_error": f"{type(exc).__name__}: {exc}",
+        })
     print(json.dumps(result))
 
 

@@ -187,7 +187,24 @@ def config5_training_throughput(steps: int = 30, batch_size: int = 4096) -> dict
     }
 
 
+def config0_grpc_e2e() -> dict:
+    """End-to-end ScoreBatch over a real gRPC socket (the headline path —
+    see benchmarks/load_gen.py and bench.py)."""
+    from load_gen import run_grpc_load, run_single_txn_probe, start_inprocess_server
+
+    addr, shutdown = start_inprocess_server(batch_size=8192)
+    try:
+        load = run_grpc_load(addr, duration_s=6.0, rows_per_rpc=8192, concurrency=6)
+        probe = run_single_txn_probe(addr, n=120)
+        load["single_txn_p99_ms"] = probe["value"]
+        load["single_txn_p50_ms"] = probe["p50_ms"]
+        return load
+    finally:
+        shutdown()
+
+
 ALL_CONFIGS = {
+    "grpc_e2e": config0_grpc_e2e,
     "single_txn": config1_single_txn_latency,
     "replay": config2_replay_throughput,
     "sequence": config3_sequence_throughput,

@@ -29,6 +29,22 @@ import grpc
 
 from igaming_platform_tpu.obs.metrics import ServiceMetrics
 from igaming_platform_tpu.obs.tracing import span
+from igaming_platform_tpu.serve.wire import RawProtoMessage, native_wire_available
+
+# Lazily resolved on the first ScoreBatch (native_wire_available may build
+# the .so — that side effect must not run at import). Tri-state: None =
+# undecided, then pinned. Disable with WIRE_FAST_PATH=0 to force the
+# per-row proto path (debug escape hatch).
+_WIRE_FAST_PATH: bool | None = None
+
+
+def _use_wire_fast_path() -> bool:
+    global _WIRE_FAST_PATH
+    if _WIRE_FAST_PATH is None:
+        _WIRE_FAST_PATH = (
+            os.environ.get("WIRE_FAST_PATH", "1") != "0" and native_wire_available()
+        )
+    return _WIRE_FAST_PATH
 
 logger = logging.getLogger(__name__)
 
@@ -149,10 +165,13 @@ def _rpc(metrics: ServiceMetrics, method: str, fn: Callable):
 
 
 def _unary(fn, req_cls, resp_cls):
+    # Duck-typed serializer (not resp_cls.SerializeToString): handlers on
+    # the wire fast path return serve.wire.RawProtoMessage — pre-serialized
+    # bytes from the native batch encoder — through the same seam.
     return grpc.unary_unary_rpc_method_handler(
         fn,
         request_deserializer=req_cls.FromString,
-        response_serializer=resp_cls.SerializeToString,
+        response_serializer=lambda m: m.SerializeToString(),
     )
 
 
@@ -179,6 +198,10 @@ class RiskGrpcService:
         self.abuse_detector = abuse_detector
         self.metrics = metrics or ServiceMetrics("risk")
         self._rate_limiter = _FixedWindowRateLimiter(rate_limit_per_minute)
+        # Resolve (and if needed g++-build) the native codec NOW, at
+        # construction — never inside the first live ScoreBatch RPC, where
+        # a cold build would stall callers for the compile duration.
+        _use_wire_fast_path()
 
     # -- scoring --
 
@@ -250,7 +273,23 @@ class RiskGrpcService:
         return self._score_to_proto(resp)
 
     def ScoreBatch(self, request, context):
-        reqs = [self._request_from_proto(t) for t in request.transactions]
+        txs = request.transactions
+        if _use_wire_fast_path() and hasattr(self.engine, "score_batch_wire"):
+            # Errors propagate: once the codec is confirmed available, any
+            # failure here (device error, encoder bug) is a real serving
+            # failure — silently re-running the batch on the per-row path
+            # would double device load exactly when the device is sick.
+            payload = self.engine.score_batch_wire(
+                [t.account_id for t in txs],
+                [t.amount for t in txs],
+                [t.transaction_type or "deposit" for t in txs],
+                ips=[t.ip_address for t in txs],
+                devices=[t.device_id for t in txs],
+                fingerprints=[t.fingerprint for t in txs],
+            )
+            self.metrics.txns_scored_total.inc(len(txs))
+            return RawProtoMessage(payload)
+        reqs = [self._request_from_proto(t) for t in txs]
         responses = self.engine.score_batch(reqs)
         self.metrics.txns_scored_total.inc(len(responses))
         return risk_pb2.ScoreBatchResponse(results=[self._score_to_proto(r) for r in responses])

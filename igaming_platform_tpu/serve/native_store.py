@@ -251,9 +251,12 @@ class NativeFeatureStore:
 
     def _fill(self, out: np.ndarray, account_ids, amounts, tx_types, now=None) -> None:
         n = out.shape[0]
-        idxs = np.array([self._idx(a, create=False) for a in account_ids], dtype=np.int32)
+        # One lock hold for the whole id resolution (not one per row).
+        with self._ids_lock:
+            get = self._ids.get
+            idxs = np.fromiter((get(a, -1) for a in account_ids), np.int32, n)
         amts = np.asarray(amounts, dtype=np.int64)
-        types = np.array([_TX_TYPE_CODES.get(t, 4) for t in tx_types], dtype=np.int32)
+        types = np.fromiter((_TX_TYPE_CODES.get(t, 4) for t in tx_types), np.int32, n)
         self._lib.fs_fill_rows(self._handle, n, idxs, amts, types, now or time.time(), out)
 
     def gather_batch(self, requests, now: float | None = None):
@@ -281,9 +284,12 @@ class NativeFeatureStore:
     # -- columnar fast path (replay/ingest: no per-row request objects) ------
 
     def gather_columns(self, account_ids, amounts, tx_types,
-                       ips=None, devices=None, now: float | None = None):
+                       ips=None, devices=None, fingerprints=None,
+                       now: float | None = None):
         """[B,30] gather straight from parallel columns — the per-row
-        ScoreRequest objects of gather_batch() skipped entirely."""
+        ScoreRequest objects of gather_batch() skipped entirely. The
+        blacklist check covers the same three keys as check_blacklist
+        (device / fingerprint / ip, redis_store.go:267-293)."""
         n = len(account_ids)
         x = np.zeros((n, NUM_FEATURES), dtype=np.float32)
         self._fill(x, account_ids, amounts, tx_types, now)
@@ -291,10 +297,16 @@ class NativeFeatureStore:
         if any(self._blacklists.values()):
             dev_bl = self._blacklists["device"]
             ip_bl = self._blacklists["ip"]
+            fp_bl = self._blacklists["fingerprint"]
             for i in range(n):
                 d = devices[i] if devices is not None else ""
                 p = ips[i] if ips is not None else ""
-                bl[i] = (bool(d) and d in dev_bl) or (bool(p) and p in ip_bl)
+                f = fingerprints[i] if fingerprints is not None else ""
+                bl[i] = (
+                    (bool(d) and d in dev_bl)
+                    or (bool(f) and f in fp_bl)
+                    or (bool(p) and p in ip_bl)
+                )
         return x, bl
 
     def update_columns(self, account_ids, amounts, tx_types, ips, devices, timestamps) -> None:

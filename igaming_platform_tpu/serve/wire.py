@@ -1,0 +1,154 @@
+"""Native wire encoding for the serving hot path.
+
+``encode_score_batch`` serializes a whole risk.v1.ScoreBatchResponse from
+the device result arrays in one C++ call (native/wire_codec.cpp) —
+replacing per-row Python proto construction, which dominates the host cost
+at wire-path throughput (the per-row response struct of engine.go:56-64,
+built once per transaction, re-designed as one batch encode).
+
+``RawProtoMessage`` lets a gRPC handler return pre-serialized bytes
+through the normal serializer seam; byte-parity with the Python
+protobuf serializer is pinned in tests/test_wire_codec.py.
+
+Falls back to reporting unavailable when the native toolchain/.so is
+missing — callers keep the per-row path in that case.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from igaming_platform_tpu.core.enums import REASON_BIT_ORDER
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native"
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "lib", "libwire_codec.so")
+
+_build_lock = threading.Lock()
+_lib = None
+_load_failed = False
+
+# Reason-code string table in bit order, concatenated + offsets — the C
+# encoder expands the in-graph bitmask to repeated string fields directly.
+_REASONS_BUF = b"".join(code.value.encode() for code in REASON_BIT_ORDER)
+_REASONS_OFF = np.zeros((len(REASON_BIT_ORDER) + 1,), dtype=np.int32)
+np.cumsum(
+    [len(code.value.encode()) for code in REASON_BIT_ORDER], out=_REASONS_OFF[1:]
+)
+
+
+def _load():
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _build_lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            if not os.path.exists(_LIB_PATH):
+                subprocess.run(
+                    ["sh", os.path.join(_NATIVE_DIR, "build.sh")],
+                    check=True, capture_output=True, timeout=120,
+                )
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.encode_score_batch.restype = ctypes.c_int64
+            lib.encode_score_batch.argtypes = [
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),  # score
+                ctypes.POINTER(ctypes.c_int32),  # action
+                ctypes.POINTER(ctypes.c_int32),  # reason_mask
+                ctypes.POINTER(ctypes.c_int32),  # rule_score
+                ctypes.POINTER(ctypes.c_float),  # ml_score
+                ctypes.POINTER(ctypes.c_int64),  # rtms
+                ctypes.c_void_p,                 # features (nullable)
+                ctypes.c_char_p,                 # reasons_buf
+                ctypes.POINTER(ctypes.c_int32),  # reasons_off
+                ctypes.c_int32,                  # n_reasons
+                ctypes.POINTER(ctypes.c_uint8),  # out
+                ctypes.c_int64,                  # out_cap
+            ]
+            _lib = lib
+        except Exception:  # noqa: BLE001 — toolchain absent => fallback
+            _load_failed = True
+    return _lib
+
+
+def native_wire_available() -> bool:
+    return _load() is not None
+
+
+class RawProtoMessage:
+    """Pre-serialized proto bytes behind the SerializeToString seam."""
+
+    __slots__ = ("_payload",)
+
+    def __init__(self, payload: bytes):
+        self._payload = payload
+
+    def SerializeToString(self, deterministic: bool = False) -> bytes:  # noqa: N802
+        return self._payload
+
+
+def _i32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def encode_score_batch(
+    score: np.ndarray,
+    action: np.ndarray,
+    reason_mask: np.ndarray,
+    rule_score: np.ndarray,
+    ml_score: np.ndarray,
+    response_time_ms: np.ndarray,
+    features: np.ndarray | None,
+) -> bytes:
+    """Serialize a ScoreBatchResponse from result arrays (one C call).
+
+    ``features`` is the raw [N, 30] gather matrix (first 26 columns mirror
+    the wire FeatureVector) or None to omit the echo.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native wire codec unavailable")
+    n = int(score.shape[0])
+    score = np.ascontiguousarray(score, dtype=np.int32)
+    action = np.ascontiguousarray(action, dtype=np.int32)
+    reason_mask = np.ascontiguousarray(reason_mask, dtype=np.int32)
+    rule_score = np.ascontiguousarray(rule_score, dtype=np.int32)
+    ml_score = np.ascontiguousarray(ml_score, dtype=np.float32)
+    rtms = np.ascontiguousarray(response_time_ms, dtype=np.int64)
+    if features is not None:
+        features = np.ascontiguousarray(features, dtype=np.float32)
+        feat_ptr = features.ctypes.data_as(ctypes.c_void_p)
+    else:
+        feat_ptr = ctypes.c_void_p(0)
+
+    # First try with a generous estimate; on -needed, retry exact.
+    cap = 64 * n + 256 * (1 if features is not None else 0) * n + 1024
+    buf = ctypes.create_string_buffer(cap)
+    written = lib.encode_score_batch(
+        n, _i32(score), _i32(action), _i32(reason_mask), _i32(rule_score),
+        ml_score.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        rtms.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        feat_ptr, _REASONS_BUF, _i32(_REASONS_OFF), len(REASON_BIT_ORDER),
+        ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8)), cap,
+    )
+    if written < 0:
+        cap = -written
+        buf = ctypes.create_string_buffer(cap)
+        written = lib.encode_score_batch(
+            n, _i32(score), _i32(action), _i32(reason_mask), _i32(rule_score),
+            ml_score.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            rtms.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            feat_ptr, _REASONS_BUF, _i32(_REASONS_OFF), len(REASON_BIT_ORDER),
+            ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8)), cap,
+        )
+    if written < 0:
+        raise RuntimeError("wire codec sizing failed")
+    return buf.raw[:written]

@@ -5,3 +5,5 @@ cd "$(dirname "$0")"
 mkdir -p lib
 g++ -O3 -march=native -std=c++17 -shared -fPIC -o lib/libfeature_store.so feature_store.cpp
 echo "built native/lib/libfeature_store.so"
+g++ -O3 -march=native -std=c++17 -shared -fPIC -o lib/libwire_codec.so wire_codec.cpp
+echo "built native/lib/libwire_codec.so"

@@ -45,7 +45,16 @@ constexpr double kSessionTtl = 1800.0, kHllTtl = 86400.0;
 
 struct Hll {
   std::vector<uint8_t> regs;
-  explicit Hll(int precision) : regs(size_t(1) << precision, 0) {}
+  // Incrementally maintained Σ 2^-reg and zero-register count, so
+  // estimate() is O(1) — fill_rows calls it per scored row, and a
+  // register scan per row (2^p × 2 HLLs) would dominate the gather.
+  double sum_inv;
+  size_t zeros;
+
+  explicit Hll(int precision)
+      : regs(size_t(1) << precision, 0),
+        sum_inv(double(size_t(1) << precision)),
+        zeros(size_t(1) << precision) {}
 
   void add(uint64_t hash, int p) {
     const uint64_t idx = hash >> (64 - p);
@@ -53,7 +62,12 @@ struct Hll {
     // rank = leading zeros of the remaining (64-p)-bit word + 1
     int rank = w == 0 ? (64 - p + 1) : (__builtin_clzll(w) + 1);
     if (rank > 64 - p + 1) rank = 64 - p + 1;
-    if (uint8_t(rank) > regs[idx]) regs[idx] = uint8_t(rank);
+    const uint8_t old = regs[idx];
+    if (uint8_t(rank) > old) {
+      regs[idx] = uint8_t(rank);
+      sum_inv += 1.0 / double(uint64_t(1) << rank) - 1.0 / double(uint64_t(1) << old);
+      if (old == 0) --zeros;
+    }
   }
 
   double estimate() const {
@@ -63,20 +77,18 @@ struct Hll {
     else if (m == 64) alpha = 0.709;
     else if (m == 32) alpha = 0.697;
     else alpha = 0.673;
-    double sum = 0.0;
-    size_t zeros = 0;
-    for (uint8_t r : regs) {
-      sum += 1.0 / double(uint64_t(1) << r);
-      if (r == 0) ++zeros;
-    }
-    double est = alpha * double(m) * double(m) / sum;
+    double est = alpha * double(m) * double(m) / sum_inv;
     if (est <= 2.5 * double(m) && zeros > 0) {
       est = double(m) * std::log(double(m) / double(zeros));
     }
     return est;
   }
 
-  void reset() { std::fill(regs.begin(), regs.end(), 0); }
+  void reset() {
+    std::fill(regs.begin(), regs.end(), 0);
+    sum_inv = double(regs.size());
+    zeros = regs.size();
+  }
 };
 
 struct AccountState {

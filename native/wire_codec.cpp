@@ -1,0 +1,264 @@
+// Native protobuf wire encoder for risk.v1.ScoreBatchResponse.
+//
+// The serving hot path scores fixed-shape device batches; what remains on
+// the host is turning result arrays into wire bytes. Python protobuf
+// builds one message object per row (engine.go's response struct,
+// re-serialized per call) — at 100k+ txns/s that is the bottleneck, not
+// the device. This encoder emits the serialized ScoreBatchResponse
+// directly from the result arrays in one pass: no per-row Python objects,
+// no per-field reflection, just the proto3 wire format
+// (field numbers/types from proto/risk/v1/risk.proto:59-78,179-211).
+//
+// Layout encoded per result row (ScoreTransactionResponse):
+//   1: int32 score            varint
+//   2: Action action          varint enum
+//   3: repeated string reason_codes   (expanded from the in-graph bitmask)
+//   4: int32 rule_score       varint
+//   5: float ml_score         fixed32
+//   6: int64 response_time_ms varint
+//   7: FeatureVector features submessage (26 fields from the [30] row;
+//      indices per core/features.F, onnx_model.go:133-166 ordering)
+//
+// Compiled by native/build.sh into libwire_codec.so; loaded via ctypes
+// (serve/wire.py), with a numpy fallback when the toolchain is absent.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+inline uint8_t* put_varint(uint8_t* p, uint64_t v) {
+  while (v >= 0x80) {
+    *p++ = static_cast<uint8_t>(v) | 0x80;
+    v >>= 7;
+  }
+  *p++ = static_cast<uint8_t>(v);
+  return p;
+}
+
+inline size_t varint_size(uint64_t v) {
+  size_t n = 1;
+  while (v >= 0x80) {
+    v >>= 7;
+    ++n;
+  }
+  return n;
+}
+
+// tag = varint of (field_number << 3) | wire_type — 1 byte for fields
+// 1-15, 2 bytes for 16-26 (the FeatureVector tail).
+constexpr uint8_t kVarint = 0;
+constexpr uint8_t kFixed32 = 5;
+constexpr uint8_t kLenDelim = 2;
+
+inline uint32_t tag_value(uint32_t field, uint8_t wt) { return (field << 3) | wt; }
+
+inline uint8_t* put_tag(uint8_t* p, uint32_t field, uint8_t wt) {
+  return put_varint(p, tag_value(field, wt));
+}
+
+inline size_t tag_size(uint32_t field) { return field < 16 ? 1 : 2; }
+
+// Writes "tag + varint" only when v != 0 (proto3 default-skipping — the
+// Python protobuf serializer does the same, so bytes compare equal).
+inline uint8_t* put_int_field(uint8_t* p, uint32_t field, int64_t v) {
+  if (v == 0) return p;
+  p = put_tag(p, field, kVarint);
+  return put_varint(p, static_cast<uint64_t>(v));  // negative -> 10-byte two's complement
+}
+
+inline uint8_t* put_float_field(uint8_t* p, uint32_t field, float v) {
+  if (v == 0.0f) return p;
+  p = put_tag(p, field, kFixed32);
+  std::memcpy(p, &v, 4);
+  return p + 4;
+}
+
+inline uint8_t* put_bool_field(uint8_t* p, uint32_t field, bool v) {
+  if (!v) return p;
+  p = put_tag(p, field, kVarint);
+  *p++ = 1;
+  return p;
+}
+
+inline size_t int_field_size(uint32_t field, int64_t v) {
+  return v == 0 ? 0 : tag_size(field) + varint_size(static_cast<uint64_t>(v));
+}
+
+// FeatureVector proto field -> feature-row index and kind.
+// Kinds: 0 = int varint, 1 = float fixed32, 2 = bool.
+struct FeatSpec {
+  uint32_t field;
+  uint32_t index;
+  uint8_t kind;
+};
+
+constexpr FeatSpec kFeatureSpecs[] = {
+    {1, 0, 0},   // tx_count_1m
+    {2, 1, 0},   // tx_count_5m
+    {3, 2, 0},   // tx_count_1h
+    {4, 3, 0},   // tx_sum_1h (int64)
+    {5, 4, 1},   // tx_avg_1h
+    {6, 5, 0},   // unique_devices_24h
+    {7, 6, 0},   // unique_ips_24h
+    {8, 7, 0},   // ip_country_changes_7d
+    {9, 8, 0},   // device_age_days
+    {10, 9, 0},  // account_age_days
+    {11, 10, 0}, // total_deposits (int64)
+    {12, 11, 0}, // total_withdrawals (int64)
+    {13, 12, 0}, // net_deposit (int64, may be negative)
+    {14, 13, 0}, // deposit_count
+    {15, 14, 0}, // withdraw_count
+    {16, 15, 0}, // time_since_last_tx_sec
+    {17, 16, 0}, // session_duration_sec
+    {18, 17, 1}, // avg_bet_size
+    {19, 18, 1}, // win_rate
+    {20, 19, 2}, // is_vpn
+    {21, 20, 2}, // is_proxy
+    {22, 21, 2}, // is_tor
+    {23, 22, 2}, // disposable_email
+    {24, 23, 0}, // bonus_claim_count
+    {25, 24, 1}, // bonus_wager_completion_rate
+    {26, 25, 2}, // bonus_only_player
+};
+
+size_t feature_msg_size(const float* row) {
+  size_t n = 0;
+  for (const auto& s : kFeatureSpecs) {
+    float v = row[s.index];
+    switch (s.kind) {
+      case 0: {
+        int64_t iv = static_cast<int64_t>(v);
+        n += int_field_size(s.field, iv);
+        break;
+      }
+      case 1:
+        if (v != 0.0f) n += tag_size(s.field) + 4;
+        break;
+      case 2:
+        if (v != 0.0f) n += tag_size(s.field) + 1;
+        break;
+    }
+  }
+  return n;
+}
+
+uint8_t* put_feature_msg(uint8_t* p, const float* row) {
+  for (const auto& s : kFeatureSpecs) {
+    float v = row[s.index];
+    switch (s.kind) {
+      case 0:
+        p = put_int_field(p, s.field, static_cast<int64_t>(v));
+        break;
+      case 1:
+        p = put_float_field(p, s.field, v);
+        break;
+      case 2:
+        p = put_bool_field(p, s.field, v != 0.0f);
+        break;
+    }
+  }
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Serialize a ScoreBatchResponse.
+//
+//   n             rows
+//   score/action/reason_mask/rule_score   int32[n]
+//   ml_score      float[n]
+//   rtms          int64[n]   response_time_ms per row
+//   features      float[n*30] row-major, or nullptr to omit field 7
+//   reasons_buf   concatenated reason-code strings (bit order)
+//   reasons_off   int32[n_reasons+1] offsets into reasons_buf
+//   n_reasons     number of reason-code bits
+//   out           output buffer
+//   out_cap       capacity of out
+//
+// Returns bytes written, or -(needed bytes) when out_cap is too small —
+// callers retry once with the exact size.
+int64_t encode_score_batch(int32_t n, const int32_t* score, const int32_t* action,
+                           const int32_t* reason_mask, const int32_t* rule_score,
+                           const float* ml_score, const int64_t* rtms,
+                           const float* features, const char* reasons_buf,
+                           const int32_t* reasons_off, int32_t n_reasons,
+                           uint8_t* out, int64_t out_cap) {
+  // Pass 1: size every row submessage.
+  // (Two passes beat one pass + memmove: sizes are cheap to compute and the
+  // output stays a single forward write.)
+  int64_t total = 0;
+  for (int32_t i = 0; i < n; ++i) {
+    size_t row = 0;
+    row += int_field_size(1, score[i]);
+    row += int_field_size(2, action[i]);
+    uint32_t mask = static_cast<uint32_t>(reason_mask[i]);
+    for (int32_t b = 0; b < n_reasons; ++b) {
+      if (mask & (1u << b)) {
+        size_t len = reasons_off[b + 1] - reasons_off[b];
+        row += 1 + varint_size(len) + len;
+      }
+    }
+    row += int_field_size(4, rule_score[i]);
+    if (ml_score[i] != 0.0f) row += 5;
+    row += int_field_size(6, rtms[i]);
+    if (features != nullptr) {
+      size_t fsz = feature_msg_size(features + i * 30);
+      row += 1 + varint_size(fsz) + fsz;  // tag 7 even when empty: parity with
+                                          // Python, which always sets features
+    }
+    total += 1 + varint_size(row) + row;  // results field tag(1, len-delim)
+  }
+  if (total > out_cap) return -total;
+
+  // Pass 2: write.
+  uint8_t* p = out;
+  for (int32_t i = 0; i < n; ++i) {
+    size_t row = 0;
+    row += int_field_size(1, score[i]);
+    row += int_field_size(2, action[i]);
+    uint32_t mask = static_cast<uint32_t>(reason_mask[i]);
+    for (int32_t b = 0; b < n_reasons; ++b) {
+      if (mask & (1u << b)) {
+        size_t len = reasons_off[b + 1] - reasons_off[b];
+        row += 1 + varint_size(len) + len;
+      }
+    }
+    row += int_field_size(4, rule_score[i]);
+    if (ml_score[i] != 0.0f) row += 5;
+    row += int_field_size(6, rtms[i]);
+    size_t fsz = 0;
+    if (features != nullptr) {
+      fsz = feature_msg_size(features + i * 30);
+      row += 1 + varint_size(fsz) + fsz;
+    }
+
+    p = put_tag(p, 1, kLenDelim);
+    p = put_varint(p, row);
+    p = put_int_field(p, 1, score[i]);
+    p = put_int_field(p, 2, action[i]);
+    for (int32_t b = 0; b < n_reasons; ++b) {
+      if (mask & (1u << b)) {
+        int32_t off = reasons_off[b];
+        size_t len = reasons_off[b + 1] - off;
+        p = put_tag(p, 3, kLenDelim);
+        p = put_varint(p, len);
+        std::memcpy(p, reasons_buf + off, len);
+        p += len;
+      }
+    }
+    p = put_int_field(p, 4, rule_score[i]);
+    p = put_float_field(p, 5, ml_score[i]);
+    p = put_int_field(p, 6, rtms[i]);
+    if (features != nullptr) {
+      p = put_tag(p, 7, kLenDelim);
+      p = put_varint(p, fsz);
+      p = put_feature_msg(p, features + i * 30);
+    }
+  }
+  return p - out;
+}
+
+}  // extern "C"
