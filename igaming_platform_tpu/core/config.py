@@ -87,6 +87,19 @@ class BatcherConfig:
     """Continuous-batcher knobs: fixed device batch size + flush window."""
 
     batch_size: int = 256
+    # Additional small compiled shapes for latency-sensitive traffic: a
+    # near-empty flush (single-txn probes, trickle load) pads to the
+    # smallest tier >= its row count instead of the full throughput shape,
+    # so one transaction never pays an H2D/step/readback sized for
+    # ``batch_size`` rows. Tiers >= batch_size are ignored; () disables.
+    latency_tiers: tuple[int, ...] = (256, 2048)
+    # Batches whose padded shape is <= this ride a host-CPU executable of
+    # the same score graph instead of the device: trickle traffic gets
+    # sub-millisecond scoring with zero host<->device round-trips (the
+    # reference scores every call on the host CPU via ONNX Runtime —
+    # onnx_model.go:208-255 — this is its latency envelope, kept, while
+    # bulk batches ride the TPU). 0 disables the host tier.
+    host_tier_rows: int = 256
     max_wait_ms: float = 2.0
     max_queue: int = 65536
     # Max device batches with results still in flight (launch/readback
@@ -157,6 +170,7 @@ class RiskServiceConfig:
             batcher=BatcherConfig(
                 batch_size=getenv_int("BATCH_SIZE", 256),
                 max_wait_ms=getenv_float("BATCH_MAX_WAIT_MS", 2.0),
+                host_tier_rows=getenv_int("BATCH_HOST_TIER_ROWS", 256),
             ),
         )
 

@@ -237,10 +237,10 @@ class ScoringBridge:
             nonlocal scored, blocked
             chunk, out = item
             n = len(chunk)
-            host = jax.device_get(out)
-            scores = np.asarray(host["score"][:n])
-            actions = np.asarray(host["action"][:n])
-            masks = np.asarray(host["reason_mask"][:n])
+            host = jax.device_get(out)  # packed [5, B]: one transfer
+            scores = np.asarray(host[0][:n])
+            actions = np.asarray(host[1][:n])
+            masks = np.asarray(host[2][:n])
 
             is_blocked = actions == ACTION_BLOCK
             blocked += int(is_blocked.sum())
@@ -354,7 +354,7 @@ class ScoringBridge:
             chunk, packed = item
             evs, accts, amts, types, ips, devs = chunk
             n = len(evs)
-            host = jax.device_get(packed)  # ONE [3, B] transfer
+            host = jax.device_get(packed)  # ONE packed [5, B] transfer
             scores = np.asarray(host[0][:n])
             actions = np.asarray(host[1][:n])
             masks = np.asarray(host[2][:n])

@@ -164,13 +164,15 @@ def _rpc(metrics: ServiceMetrics, method: str, fn: Callable):
     return handler
 
 
-def _unary(fn, req_cls, resp_cls):
+def _unary(fn, req_cls, resp_cls, raw_request: bool = False):
     # Duck-typed serializer (not resp_cls.SerializeToString): handlers on
     # the wire fast path return serve.wire.RawProtoMessage — pre-serialized
     # bytes from the native batch encoder — through the same seam.
+    # raw_request skips Python protobuf parsing entirely and hands the
+    # handler the request's wire bytes (the native decode path).
     return grpc.unary_unary_rpc_method_handler(
         fn,
-        request_deserializer=req_cls.FromString,
+        request_deserializer=(lambda b: b) if raw_request else req_cls.FromString,
         response_serializer=lambda m: m.SerializeToString(),
     )
 
@@ -201,7 +203,16 @@ class RiskGrpcService:
         # Resolve (and if needed g++-build) the native codec NOW, at
         # construction — never inside the first live ScoreBatch RPC, where
         # a cold build would stall callers for the compile duration.
-        _use_wire_fast_path()
+        # When BOTH native halves exist (request decoder in the feature
+        # store, response encoder in the codec), ScoreBatch skips Python
+        # protobuf entirely: the server hands the handler raw wire bytes.
+        self.raw_request_methods: tuple[str, ...] = ()
+        if (
+            _use_wire_fast_path()
+            and hasattr(engine, "score_batch_wire_bytes")
+            and hasattr(getattr(engine, "features", None), "decode_gather")
+        ):
+            self.raw_request_methods = ("ScoreBatch",)
 
     # -- scoring --
 
@@ -273,6 +284,17 @@ class RiskGrpcService:
         return self._score_to_proto(resp)
 
     def ScoreBatch(self, request, context):
+        if isinstance(request, (bytes, memoryview)):
+            # Fully native path: the server's deserializer was identity
+            # (raw_request_methods), so these are the request's wire bytes.
+            try:
+                payload, n = self.engine.score_batch_wire_bytes(bytes(request))
+            except ValueError as exc:
+                raise RpcAbort(
+                    grpc.StatusCode.INVALID_ARGUMENT, f"bad ScoreBatchRequest: {exc}"
+                ) from exc
+            self.metrics.txns_scored_total.inc(n)
+            return RawProtoMessage(payload)
         txs = request.transactions
         if _use_wire_fast_path() and hasattr(self.engine, "score_batch_wire"):
             # Errors propagate: once the codec is confirmed available, any
@@ -707,8 +729,12 @@ _WALLET_METHODS = {
 
 
 def _generic_handler(service_name: str, servicer, methods: dict, metrics: ServiceMetrics):
+    raw_methods = getattr(servicer, "raw_request_methods", ())
     handlers = {
-        name: _unary(_rpc(metrics, name, getattr(servicer, name)), req, resp)
+        name: _unary(
+            _rpc(metrics, name, getattr(servicer, name)), req, resp,
+            raw_request=name in raw_methods,
+        )
         for name, (req, resp) in methods.items()
     }
     return grpc.method_handlers_generic_handler(service_name, handlers)

@@ -51,13 +51,18 @@ def _hash64(value: str) -> int:
 
 
 def build_native(force: bool = False) -> str | None:
-    """Compile the shared library if needed; returns its path or None."""
+    """Compile the shared library if needed; returns its path or None.
+    A .so older than its source is rebuilt (stale-binary guard)."""
     with _build_lock:
-        if os.path.exists(_LIB_PATH) and not force:
-            return _LIB_PATH
         src = os.path.join(_NATIVE_DIR, "feature_store.cpp")
         if not os.path.exists(src):
-            return None
+            return _LIB_PATH if os.path.exists(_LIB_PATH) else None
+        if (
+            os.path.exists(_LIB_PATH)
+            and not force
+            and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src)
+        ):
+            return _LIB_PATH
         try:
             subprocess.run(
                 ["sh", os.path.join(_NATIVE_DIR, "build.sh")],
@@ -72,7 +77,19 @@ def _load_lib():
     path = build_native()
     if path is None:
         return None
-    lib = ctypes.CDLL(path)
+    try:
+        return _bind(ctypes.CDLL(path))
+    except AttributeError:
+        # A prebuilt .so from before a symbol was added (mtime passed the
+        # staleness guard, or the source is absent). Rebuild for the NEXT
+        # process — re-dlopening the same path in THIS one would return
+        # the already-mapped stale handle (glibc caches by path; ctypes
+        # never dlcloses) — and fall back to the Python store now.
+        build_native(force=True)
+        return None
+
+
+def _bind(lib):
     lib.fs_create.restype = ctypes.c_void_p
     lib.fs_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
     lib.fs_destroy.argtypes = [ctypes.c_void_p]
@@ -109,6 +126,27 @@ def _load_lib():
         ctypes.c_double,
         np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
     ]
+    lib.fs_resolve.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_int,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+    ]
+    lib.fs_num_accounts.restype = ctypes.c_int
+    lib.fs_num_accounts.argtypes = [ctypes.c_void_p]
+    lib.fs_blacklist_add.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int32
+    ]
+    lib.fs_wire_count.restype = ctypes.c_int64
+    lib.fs_wire_count.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.fs_decode_gather.restype = ctypes.c_int64
+    lib.fs_decode_gather.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_double,
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+        ctypes.c_int,
+    ]
     return lib
 
 
@@ -133,10 +171,11 @@ class NativeFeatureStore:
             raise RuntimeError("native feature store unavailable (g++ build failed)")
         self._lib = _lib
         self._handle = self._lib.fs_create(max_accounts, history_capacity, hll_precision)
-        self._ids: dict[str, int] = {}
-        self._ids_lock = threading.Lock()
         self._max_accounts = max_accounts
+        # Python mirror for the string check_blacklist() API; the native
+        # sets (fs_blacklist_add) are the ones the wire decoder consults.
         self._blacklists: dict[str, set[str]] = {"device": set(), "ip": set(), "fingerprint": set()}
+        self._bl_codes = {"device": 0, "ip": 1, "fingerprint": 2}
 
     def __del__(self):
         handle = getattr(self, "_handle", None)
@@ -144,15 +183,21 @@ class NativeFeatureStore:
             self._lib.fs_destroy(handle)
             self._handle = None
 
+    def _resolve_many(self, account_ids, create: bool = True) -> np.ndarray:
+        """Batch string→index resolution in ONE native call. The id map
+        lives in C++ (single source of truth) so the native wire decoder
+        and this path can never disagree on an account's index."""
+        n = len(account_ids)
+        encoded = [a.encode() if isinstance(a, str) else bytes(a) for a in account_ids]
+        offs = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum([len(e) for e in encoded], out=offs[1:])
+        buf = b"".join(encoded)
+        out = np.empty(n, dtype=np.int32)
+        self._lib.fs_resolve(self._handle, n, buf, offs, 1 if create else 0, out)
+        return out
+
     def _idx(self, account_id: str, create: bool = True) -> int:
-        with self._ids_lock:
-            idx = self._ids.get(account_id)
-            if idx is None and create:
-                if len(self._ids) >= self._max_accounts:
-                    return -1
-                idx = len(self._ids)
-                self._ids[account_id] = idx
-            return -1 if idx is None else idx
+        return int(self._resolve_many([account_id], create)[0])
 
     # -- writes -------------------------------------------------------------
 
@@ -174,14 +219,13 @@ class NativeFeatureStore:
         if n == 0:
             return
         now = time.time()
-        idxs = np.empty(n, np.int32)
+        idxs = self._resolve_many([e.account_id for e in events])
         ts = np.empty(n, np.float64)
         amounts = np.empty(n, np.int64)
         types = np.empty(n, np.int32)
         dev = np.empty(n, np.uint64)
         ips = np.empty(n, np.uint64)
         for i, e in enumerate(events):
-            idxs[i] = self._idx(e.account_id)
             ts[i] = e.timestamp or now
             amounts[i] = int(e.amount)
             types[i] = _TX_TYPE_CODES.get(e.tx_type, 4)
@@ -233,6 +277,8 @@ class NativeFeatureStore:
         if list_type not in self._blacklists:
             raise ValueError(f"unknown blacklist type: {list_type}")
         self._blacklists[list_type].add(value)
+        raw = value.encode()
+        self._lib.fs_blacklist_add(self._handle, self._bl_codes[list_type], raw, len(raw))
 
     def check_blacklist(self, device_id: str = "", fingerprint: str = "", ip: str = "") -> bool:
         return (
@@ -251,10 +297,7 @@ class NativeFeatureStore:
 
     def _fill(self, out: np.ndarray, account_ids, amounts, tx_types, now=None) -> None:
         n = out.shape[0]
-        # One lock hold for the whole id resolution (not one per row).
-        with self._ids_lock:
-            get = self._ids.get
-            idxs = np.fromiter((get(a, -1) for a in account_ids), np.int32, n)
+        idxs = self._resolve_many(account_ids, create=False)
         amts = np.asarray(amounts, dtype=np.int64)
         types = np.fromiter((_TX_TYPE_CODES.get(t, 4) for t in tx_types), np.int32, n)
         self._lib.fs_fill_rows(self._handle, n, idxs, amts, types, now or time.time(), out)
@@ -314,7 +357,7 @@ class NativeFeatureStore:
         n = len(account_ids)
         if n == 0:
             return
-        idxs = np.fromiter((self._idx(a) for a in account_ids), np.int32, n)
+        idxs = self._resolve_many(account_ids)
         # Same `timestamp or now` fallback as update()/update_batch(): an
         # unset (zero) event timestamp must not land at epoch 0, where every
         # sliding window would exclude it.
@@ -328,8 +371,33 @@ class NativeFeatureStore:
         self._lib.fs_update_batch(self._handle, n, idxs, ts, amts, types, dev, ip)
 
     def num_accounts(self) -> int:
-        with self._ids_lock:
-            return len(self._ids)
+        return int(self._lib.fs_num_accounts(self._handle))
+
+    # -- native wire decode (ScoreBatchRequest bytes -> gather matrix) -------
+
+    def decode_gather(
+        self, payload: bytes, now: float | None = None, create: bool = False
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """One-call request decode + feature gather: risk.v1
+        ScoreBatchRequest wire bytes -> ([N,30] float32, [N] bool
+        blacklist). The per-RPC host path the VERDICT r02 profile asked
+        for — no Python protobuf parse, no per-row host objects
+        (counterpart of the per-request decode grpc-go does for
+        proto/risk/v1/risk.proto:34-58)."""
+        n = self._lib.fs_wire_count(payload, len(payload))
+        if n < 0:
+            raise ValueError("malformed ScoreBatchRequest")
+        x = np.zeros((int(n), NUM_FEATURES), dtype=np.float32)
+        bl = np.zeros((int(n),), dtype=np.uint8)
+        if n == 0:
+            return x, bl.astype(bool)
+        rc = self._lib.fs_decode_gather(
+            self._handle, payload, len(payload), now or time.time(),
+            int(n), x, bl, 1 if create else 0,
+        )
+        if rc < 0:
+            raise ValueError(f"malformed ScoreBatchRequest (rc={rc})")
+        return x[:rc], bl[:rc].astype(bool)
 
 
 def best_feature_store(**kwargs):

@@ -67,6 +67,44 @@ class ScoreResponse:
     features: FeatureVector
 
 
+def _mesh_can_shard(batch: int, mesh) -> bool:
+    from igaming_platform_tpu.parallel.mesh import mesh_axis_size
+
+    return batch % mesh_axis_size(mesh, AXIS_DATA) == 0
+
+
+def _pack_outputs(fn):
+    """Wrap a dict-output score fn into one int32 [5, B] output (one D2H
+    transfer). Row order: score, action, reason_mask, rule_score,
+    ml_score as IEEE-754 bits."""
+
+    def packed(params, x, blacklisted, thresholds):
+        out = fn(params, x, blacklisted, thresholds)
+        return jnp.stack([
+            out["score"].astype(jnp.int32),
+            out["action"].astype(jnp.int32),
+            out["reason_mask"].astype(jnp.int32),
+            out["rule_score"].astype(jnp.int32),
+            jax.lax.bitcast_convert_type(
+                out["ml_score"].astype(jnp.float32), jnp.int32
+            ),
+        ])
+
+    return packed
+
+
+def _unpack_host(packed) -> dict:
+    """Host-side view of the packed [5, B] result as the canonical dict."""
+    a = np.asarray(packed)
+    return {
+        "score": a[0],
+        "action": a[1],
+        "reason_mask": a[2],
+        "rule_score": a[3],
+        "ml_score": a[4].view(np.float32),
+    }
+
+
 class TPUScoringEngine:
     def __init__(
         self,
@@ -84,27 +122,93 @@ class TPUScoringEngine:
         self._params = params
         self._params_lock = threading.Lock()
         self.features = feature_store or InMemoryFeatureStore()
-        self.batch_size = (batcher_config or BatcherConfig()).batch_size
+        bcfg = batcher_config or BatcherConfig()
+        self.batch_size = bcfg.batch_size
+        self._pipeline_depth = max(1, bcfg.pipeline_depth)
+        # Compiled shape ladder: the throughput shape plus smaller latency
+        # tiers (VERDICT r02 item 1 — a single-txn flush must not pay the
+        # full-shape H2D + step + readback). jax.jit compiles one
+        # executable per input shape, so the ladder is just which padded
+        # shapes we allow; each is AOT-warmed before SERVING.
+        self._shapes = sorted(
+            {t for t in bcfg.latency_tiers if 0 < t < self.batch_size}
+            | {self.batch_size}
+        )
         self._thresholds = np.array(
             [self.config.block_threshold, self.config.review_threshold], dtype=np.int32
         )
         self._mesh = mesh
 
         fn = make_score_fn(self.config, ml_backend)
+        # The serving executable returns ONE packed int32 [5, B] array
+        # (score / action / reason_mask / rule_score / ml_score-bits)
+        # instead of a five-array dict: on a host link where readback cost
+        # is per-transfer, one D2H copy replaces five (the ml_score float
+        # rides as its IEEE bits via bitcast, recovered with .view on the
+        # host — lossless).
+        packed_fn = _pack_outputs(fn)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             validate_batch_for_mesh(self.batch_size, mesh)
+            # Latency tiers the mesh cannot shard are dropped, not fatal —
+            # they are an optimization, and the defaults must never turn a
+            # previously-valid mesh config into a startup failure.
+            self._shapes = [
+                s for s in self._shapes
+                if s == self.batch_size or _mesh_can_shard(s, mesh)
+            ]
             row = NamedSharding(mesh, P(AXIS_DATA, None))
             vec = NamedSharding(mesh, P(AXIS_DATA))
             repl = NamedSharding(mesh, P())
             self._fn = jax.jit(
                 fn, in_shardings=(None, row, vec, repl), out_shardings=vec
             )
+            self._packed_fn = jax.jit(
+                packed_fn,
+                in_shardings=(None, row, vec, repl),
+                out_shardings=NamedSharding(mesh, P(None, AXIS_DATA)),
+            )
         else:
             self._fn = jax.jit(fn)
+            self._packed_fn = jax.jit(packed_fn)
 
-        self._pack_fn = None
+        # Host latency tier: the SAME score graph compiled for the host
+        # CPU, used for near-empty flushes (n <= host_tier_rows). The
+        # reference scores every transaction on the host (ONNX Runtime,
+        # onnx_model.go:208-255); here trickle traffic gets a host-local
+        # XLA executable — microseconds of compute, zero host<->device
+        # link round-trips — while bulk batches ride the TPU tiers. On a
+        # tunneled/remote device this is the difference between a ~RTT
+        # latency floor and a sub-millisecond one; numerics may differ
+        # from the MXU path by float32 rounding (|ml_score| ~1e-3, score
+        # by at most +-1 — same thresholds, same actions).
+        # Host tier is keyed on ACTUAL row count, capped strictly below the
+        # throughput shape: a full batch_size batch always rides the TPU
+        # (a config with host_tier_rows >= batch_size cannot silently
+        # route bulk traffic to the host), while a near-empty flush — even
+        # at the stock batch_size=256 where no smaller tier compiles —
+        # skips the device link entirely.
+        self._host_tier = (
+            0 if mesh is not None
+            else max(0, min(bcfg.host_tier_rows, self.batch_size - 1))
+        )
+        self._fn_host = None
+        self._params_host = None
+        self._thresholds_host = self._thresholds
+        if self._host_tier > 0 and jax.default_backend() != "cpu":
+            try:
+                cpu = jax.devices("cpu")[0]
+            except RuntimeError:
+                cpu = None
+            if cpu is not None:
+                self._fn_host = jax.jit(packed_fn)
+                # Committed-to-CPU params (and thresholds, for the
+                # params=None mock backend) pin the compile to the host.
+                self._params_host = jax.device_put(params, cpu)
+                self._thresholds_host = jax.device_put(self._thresholds, cpu)
+                self._host_cpu = cpu
+
         self._batcher = ContinuousBatcher(
             cfg=batcher_config,
             dispatch=self._dispatch_requests,
@@ -121,11 +225,17 @@ class TPUScoringEngine:
         the device->host readback path (first real transfer on some
         interconnects is far costlier than steady state) so the first
         request doesn't pay either cost."""
-        x = np.zeros((self.batch_size, NUM_FEATURES), dtype=np.float32)
-        bl = np.zeros((self.batch_size,), dtype=bool)
-        out = self._fn(self._params, x, bl, self._thresholds)
-        jax.block_until_ready(out)
-        jax.device_get(out)
+        for shape in self._shapes:
+            x = np.zeros((shape, NUM_FEATURES), dtype=np.float32)
+            bl = np.zeros((shape,), dtype=bool)
+            out = self._packed_fn(self._params, x, bl, self._thresholds)
+            jax.block_until_ready(out)
+            jax.device_get(out)
+            # Warm every host-tier shape a near-empty flush could pad to.
+            if self._fn_host is not None and shape <= self._pick_shape(self._host_tier):
+                jax.device_get(
+                    self._fn_host(self._params_host, x, bl, self._thresholds_host)
+                )
 
     def close(self) -> None:
         self._batcher.stop()
@@ -133,9 +243,15 @@ class TPUScoringEngine:
     # -- params / thresholds -------------------------------------------------
 
     def swap_params(self, params: Any) -> None:
-        """Atomically install new model parameters (hot-swap from train/)."""
+        """Atomically install new model parameters (hot-swap from train/).
+        The host latency tier gets its own CPU-committed copy."""
+        params_host = (
+            jax.device_put(params, self._host_cpu) if self._fn_host is not None else None
+        )
         with self._params_lock:
             self._params = params
+            if self._fn_host is not None:
+                self._params_host = params_host
 
     def get_thresholds(self) -> tuple[int, int]:
         t = self._thresholds
@@ -144,6 +260,8 @@ class TPUScoringEngine:
     def set_thresholds(self, block: int, review: int) -> None:
         """Runtime threshold tuning (engine.go:498-504) — no recompile."""
         self._thresholds = np.array([block, review], dtype=np.int32)
+        if self._fn_host is not None:
+            self._thresholds_host = jax.device_put(self._thresholds, self._host_cpu)
 
     # -- scoring -------------------------------------------------------------
 
@@ -184,38 +302,45 @@ class TPUScoringEngine:
 
     def _run_device(self, x: np.ndarray, bl: np.ndarray):
         out, n = self._launch_device(x, bl)
-        return jax.device_get(out), n
+        return _unpack_host(jax.device_get(out)), n
+
+    def _pick_shape(self, n: int) -> int:
+        """Smallest compiled shape that fits n rows (latency tiers)."""
+        for shape in self._shapes:
+            if n <= shape:
+                return shape
+        return self.batch_size
 
     def _launch_device(self, x: np.ndarray, bl: np.ndarray):
-        """Dispatch the compiled step and start async D2H copies; returns
-        the on-device output dict WITHOUT blocking on readback."""
+        """Dispatch the compiled step and start the async D2H copy of the
+        packed int32 [5, B] result WITHOUT blocking on readback — one
+        transfer, not five (readback cost is per-array, not per-byte, at
+        these sizes). Near-empty batches (padded shape <= host_tier_rows)
+        run the host-CPU executable of the same graph instead: no device
+        link round-trip at all."""
         n = x.shape[0]
-        xp, _ = pad_batch(x, self.batch_size)
-        blp, _ = pad_batch(bl, self.batch_size)
+        shape = self._pick_shape(n)
+        xp, _ = pad_batch(x, shape)
+        blp, _ = pad_batch(bl, shape)
+        use_host = self._fn_host is not None and n <= self._host_tier
         with self._params_lock:
-            params = self._params
-        out = self._fn(params, xp, blp, self._thresholds)
-        for leaf in jax.tree.leaves(out):
-            if hasattr(leaf, "copy_to_host_async"):
-                leaf.copy_to_host_async()
+            # Snapshot under the lock, dispatch outside it — scoring must
+            # never serialize on the params mutex.
+            params = self._params_host if use_host else self._params
+            thresholds = self._thresholds_host if use_host else self._thresholds
+        if use_host:
+            return self._fn_host(params, xp, blp, thresholds), n
+        out = self._packed_fn(params, xp, blp, thresholds)
+        if hasattr(out, "copy_to_host_async"):
+            out.copy_to_host_async()
         return out, n
 
     def launch_packed(self, x: np.ndarray, bl: np.ndarray):
-        """Dispatch the score step and pack the replay-relevant outputs
-        (score / action / reason_mask) into ONE int32 [3, B] device array
-        with its D2H copy started. On a high-latency host link (tunneled
-        dev chip) one packed transfer replaces five per-array round
-        trips — the readback cost is per-array, not per-byte, at these
-        sizes."""
-        out, n = self._launch_device(x, bl)
-        if self._pack_fn is None:
-            self._pack_fn = jax.jit(
-                lambda s, a, m: jnp.stack((s, a, m)).astype(jnp.int32)
-            )
-        packed = self._pack_fn(out["score"], out["action"], out["reason_mask"])
-        if hasattr(packed, "copy_to_host_async"):
-            packed.copy_to_host_async()
-        return packed, n
+        """Dispatch the score step; returns the packed int32 [5, B] device
+        array (rows: score, action, reason_mask, rule_score, ml bits) with
+        its D2H copy already started — the replay path reads it back in
+        ONE transfer."""
+        return self._launch_device(x, bl)
 
     # Two-phase batcher hooks: dispatch on the launcher thread, collect on
     # the collector thread, so batch k+1 launches while batch k's results
@@ -235,7 +360,7 @@ class TPUScoringEngine:
     def _collect_requests(self, handle) -> list[ScoreResponse]:
         out, x, n = handle
         with span("score.readback", batch=n):
-            host = jax.device_get(out)
+            host = _unpack_host(jax.device_get(out))
         return [self._row_response(host, x, i) for i in range(n)]
 
     def _row_response(self, out: dict, x: np.ndarray, i: int) -> ScoreResponse:
@@ -272,55 +397,91 @@ class TPUScoringEngine:
         (serve/wire.py). Raises RuntimeError when the native codec is
         unavailable — callers fall back to score_batch().
         """
-        from igaming_platform_tpu.serve.wire import encode_score_batch
-
         start = time.monotonic()
         total = len(account_ids)
-        chunks: list[tuple[Any, np.ndarray, int]] = []
+        with span("score.gather", batch=total):
+            if hasattr(self.features, "gather_columns"):
+                x, bl = self.features.gather_columns(
+                    account_ids, amounts, tx_types,
+                    ips=ips, devices=devices, fingerprints=fingerprints,
+                )
+            else:
+                rows = [
+                    ScoreRequest(
+                        account_id=account_ids[i], amount=amounts[i],
+                        tx_type=tx_types[i],
+                        ip=ips[i] if ips else "",
+                        device_id=devices[i] if devices else "",
+                        fingerprint=fingerprints[i] if fingerprints else "",
+                    )
+                    for i in range(total)
+                ]
+                x, bl = self.features.gather_batch(rows)
+        return self._score_rows_encode(x, bl, include_features, start)
+
+    def score_batch_wire_bytes(
+        self, payload: bytes, *, include_features: bool = True
+    ) -> tuple[bytes, int]:
+        """ScoreBatchRequest wire bytes -> ScoreBatchResponse wire bytes.
+
+        The fully native request path (VERDICT r02 item 2): ONE C++ call
+        decodes the proto and gathers the [N, 30] feature matrix + the
+        blacklist flags (native_store.decode_gather), the device scores in
+        pipelined chunks, and ONE C++ call encodes the response. Per-RPC
+        Python work is O(1) in the row count. Returns (bytes, n_rows).
+        Raises ValueError on a malformed request, RuntimeError when the
+        native store/codec are unavailable.
+        """
+        start = time.monotonic()
+        if not hasattr(self.features, "decode_gather"):
+            raise RuntimeError("feature store has no native wire decoder")
+        with span("score.decode"):
+            x, bl = self.features.decode_gather(payload)
+        return self._score_rows_encode(x, bl, include_features, start), x.shape[0]
+
+    def _score_rows_encode(
+        self, x: np.ndarray, bl: np.ndarray, include_features: bool, start: float
+    ) -> bytes:
+        """Pipelined chunked scoring straight to response wire bytes: chunk
+        k's readback overlaps chunk k+1's device step, with at most
+        ``pipeline_depth`` chunks' outputs held (bounded memory for giant
+        RPCs), and per-chunk response_time_ms — each row reports the time
+        ITS chunk became available, not the whole RPC's (the per-call
+        semantics of engine.go:263,312)."""
+        from collections import deque
+
+        from igaming_platform_tpu.serve.wire import encode_score_batch
+
+        total = x.shape[0]
+        if total == 0:
+            return b""
+        keys = ("score", "action", "reason_mask", "rule_score", "ml_score")
+        parts: dict[str, list[np.ndarray]] = {k: [] for k in keys}
+        rtms = np.empty((total,), dtype=np.int64)
+        inflight: deque = deque()
+
+        def read_one() -> None:
+            out, lo, n = inflight.popleft()
+            with span("score.readback", batch=n):
+                host = _unpack_host(jax.device_get(out))
+            for k in keys:
+                parts[k].append(host[k][:n])
+            rtms[lo : lo + n] = int((time.monotonic() - start) * 1000.0)
+
         for lo in range(0, total, self.batch_size):
             hi = min(lo + self.batch_size, total)
-            with span("score.gather", batch=hi - lo):
-                if hasattr(self.features, "gather_columns"):
-                    x, bl = self.features.gather_columns(
-                        account_ids[lo:hi], amounts[lo:hi], tx_types[lo:hi],
-                        ips=ips[lo:hi] if ips else None,
-                        devices=devices[lo:hi] if devices else None,
-                        fingerprints=fingerprints[lo:hi] if fingerprints else None,
-                    )
-                else:
-                    rows = [
-                        ScoreRequest(
-                            account_id=account_ids[i], amount=amounts[i],
-                            tx_type=tx_types[i],
-                            ip=ips[i] if ips else "",
-                            device_id=devices[i] if devices else "",
-                            fingerprint=fingerprints[i] if fingerprints else "",
-                        )
-                        for i in range(lo, hi)
-                    ]
-                    x, bl = self.features.gather_batch(rows)
             with span("score.dispatch", batch=hi - lo), annotate("score_step"):
-                out, n = self._launch_device(x, bl)
-            chunks.append((out, x, n))
+                out, n = self._launch_device(x[lo:hi], bl[lo:hi])
+            inflight.append((out, lo, n))
+            if len(inflight) > self._pipeline_depth:
+                read_one()
+        while inflight:
+            read_one()
 
-        parts = {k: [] for k in ("score", "action", "reason_mask", "rule_score", "ml_score")}
-        feats: list[np.ndarray] = []
-        for out, x, n in chunks:
-            with span("score.readback", batch=n):
-                host = jax.device_get(out)
-            for k, acc in parts.items():
-                acc.append(np.asarray(host[k][:n]))
-            if include_features:
-                feats.append(x[:n])
-        if not chunks:
-            return b""
         cat = {k: np.concatenate(v) if len(v) > 1 else v[0] for k, v in parts.items()}
-        elapsed_ms = int((time.monotonic() - start) * 1000.0)
-        rtms = np.full((total,), elapsed_ms, dtype=np.int64)
         return encode_score_batch(
             cat["score"], cat["action"], cat["reason_mask"], cat["rule_score"],
-            cat["ml_score"], rtms,
-            (np.concatenate(feats) if len(feats) > 1 else feats[0]) if include_features else None,
+            cat["ml_score"], rtms, x if include_features else None,
         )
 
     # -- raw array path (bench / replay) -------------------------------------

@@ -17,10 +17,14 @@
 // pinned by tests/test_native_store.py.
 
 #include <algorithm>
+#include <atomic>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <mutex>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -126,14 +130,52 @@ struct Store {
   int hist_cap;
   int hll_p;
 
+  // Account-id resolution lives HERE (not in a Python dict) so the native
+  // wire decoder can go from request bytes to feature rows without ever
+  // materializing Python strings. Single source of truth for ids.
+  std::unordered_map<std::string, int32_t> id_map;
+  std::mutex id_mu;
+
+  // Blacklists (device / ip / fingerprint — redis_store.go:244-293). The
+  // atomic emptiness flag keeps the common no-blacklist case one load.
+  std::unordered_set<std::string> bl[3];
+  std::mutex bl_mu;
+  std::atomic<bool> bl_nonempty{false};
+
   Store(int max_accounts, int hist_capacity, int hll_precision)
       : locks(64), hist_cap(hist_capacity), hll_p(hll_precision) {
     accounts.reserve(max_accounts);
     for (int i = 0; i < max_accounts; ++i) accounts.emplace_back(hist_capacity, hll_precision);
+    id_map.reserve(size_t(max_accounts) * 2);
   }
 
   std::mutex& lock_for(int idx) { return locks[size_t(idx) % locks.size()]; }
+
+  // -1 when absent (create=false) or at capacity.
+  int32_t resolve(const char* data, size_t len, bool create) {
+    std::string key(data, len);
+    std::lock_guard<std::mutex> g(id_mu);
+    auto it = id_map.find(key);
+    if (it != id_map.end()) return it->second;
+    if (!create || id_map.size() >= accounts.size()) return -1;
+    int32_t idx = int32_t(id_map.size());
+    id_map.emplace(std::move(key), idx);
+    return idx;
+  }
+
+  bool blacklisted(const char* dev, size_t dev_len, const char* fp, size_t fp_len,
+                   const char* ip, size_t ip_len) {
+    if (!bl_nonempty.load(std::memory_order_relaxed)) return false;
+    std::lock_guard<std::mutex> g(bl_mu);
+    return (dev_len && bl[0].count(std::string(dev, dev_len))) ||
+           (ip_len && bl[1].count(std::string(ip, ip_len))) ||
+           (fp_len && bl[2].count(std::string(fp, fp_len)));
+  }
 };
+
+// One [30]-float feature row from account state + tx context (the body of
+// fs_fill_rows, shared with the wire decoder).
+void fill_one(Store* s, int idx, int64_t amount, int tx_type, double now, float* row);
 
 void window_counts(const AccountState& st, double now, int* c1, int* c5, int* ch) {
   *c1 = *c5 = *ch = 0;
@@ -268,52 +310,258 @@ void fs_fill_rows(void* handle, int n, const int32_t* idxs, const int64_t* amoun
                   const int32_t* tx_types, double now, float* out) {
   Store* s = static_cast<Store*>(handle);
   for (int r = 0; r < n; ++r) {
-    float* row = out + size_t(r) * kNumFeatures;
-    std::memset(row, 0, sizeof(float) * kNumFeatures);
-    const int idx = idxs[r];
-    if (idx >= 0 && size_t(idx) < s->accounts.size()) {
-      std::lock_guard<std::mutex> g(s->lock_for(idx));
-      const AccountState& st = s->accounts[size_t(idx)];
-      if (st.initialized) {
-        int c1, c5, ch;
-        window_counts(st, now, &c1, &c5, &ch);
-        row[TX_COUNT_1M] = float(c1);
-        row[TX_COUNT_5M] = float(c5);
-        row[TX_COUNT_1H] = float(ch);
-        const int64_t sum = now <= st.sum_expires_at ? st.sum_1h : 0;
-        row[TX_SUM_1H] = float(sum);
-        row[TX_AVG_1H] = ch > 0 ? float(double(sum) / double(ch)) : 0.0f;
-        if (now <= st.hll_expires_at) {
-          row[UNIQUE_DEVICES_24H] = float(int64_t(st.devices.estimate() + 0.5));
-          row[UNIQUE_IPS_24H] = float(int64_t(st.ips.estimate() + 0.5));
-        }
-        if (st.last_tx_ts > 0.0) row[TIME_SINCE_LAST_TX] = float(now - st.last_tx_ts);
-        if (st.session_start > 0.0 && now <= st.session_expires_at) {
-          row[SESSION_DURATION] = float(now - st.session_start);
-        }
-        row[ACCOUNT_AGE_DAYS] = float((now - st.created_at) / 86400.0);
-        row[TOTAL_DEPOSITS] = float(st.total_deposits);
-        row[TOTAL_WITHDRAWALS] = float(st.total_withdrawals);
-        row[NET_DEPOSIT] = float(st.total_deposits - st.total_withdrawals);
-        row[DEPOSIT_COUNT] = float(st.deposit_count);
-        row[WITHDRAW_COUNT] = float(st.withdraw_count);
-        row[AVG_BET_SIZE] = st.bet_count > 0
-            ? float(double(st.total_bets) / double(st.bet_count)) : 0.0f;
-        row[WIN_RATE] = st.bet_count > 0
-            ? float(double(st.win_count) / double(st.bet_count)) : 0.0f;
-        row[BONUS_CLAIM_COUNT] = float(st.bonus_claim_count);
-        row[BONUS_WAGER_RATE] = st.bonus_wager_rate;
-        if (st.bonus_claim_count > 3 && st.total_deposits < 5000) {
-          row[BONUS_ONLY_PLAYER] = 1.0f;
-        }
-      }
-    }
-    row[TX_AMOUNT] = float(amounts[r]);
-    const int t = tx_types[r];
-    row[TX_TYPE_DEPOSIT] = t == TX_DEPOSIT ? 1.0f : 0.0f;
-    row[TX_TYPE_WITHDRAW] = t == TX_WITHDRAW ? 1.0f : 0.0f;
-    row[TX_TYPE_BET] = t == TX_BET ? 1.0f : 0.0f;
+    fill_one(s, idxs[r], amounts[r], tx_types[r], now, out + size_t(r) * kNumFeatures);
   }
 }
 
+// Batch account-id resolution from concatenated UTF-8 ids + offsets
+// (offs[i]..offs[i+1] is id i). create=0: unknown ids stay -1.
+void fs_resolve(void* handle, int n, const char* buf, const int64_t* offs,
+                int create, int32_t* out_idxs) {
+  Store* s = static_cast<Store*>(handle);
+  for (int i = 0; i < n; ++i) {
+    out_idxs[i] = s->resolve(buf + offs[i], size_t(offs[i + 1] - offs[i]), create != 0);
+  }
+}
+
+int fs_num_accounts(void* handle) {
+  Store* s = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> g(s->id_mu);
+  return int(s->id_map.size());
+}
+
+// type: 0=device 1=ip 2=fingerprint
+void fs_blacklist_add(void* handle, int type, const char* val, int32_t len) {
+  Store* s = static_cast<Store*>(handle);
+  if (type < 0 || type > 2) return;
+  std::lock_guard<std::mutex> g(s->bl_mu);
+  s->bl[type].emplace(val, size_t(len));
+  s->bl_nonempty.store(true, std::memory_order_relaxed);
+}
+
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Wire decode: risk.v1.ScoreBatchRequest bytes -> feature rows, one call.
+//
+// The round-2 e2e profile showed request decode as the dominant host cost:
+// Python protobuf parsed 8192 ScoreTransactionRequest submessages per RPC
+// (VERDICT r02 "what's weak" #2). This parser walks the proto3 wire format
+// directly (field numbers from proto/risk/v1/risk.proto:41-56), resolves
+// account ids against the store's native id map, and emits the [N, 30]
+// gather matrix + blacklist flags without creating ANY per-row host
+// objects. Python sees two ctypes calls per RPC: count, then decode.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Slice {
+  const uint8_t* p = nullptr;
+  size_t len = 0;
+};
+
+// Returns false on malformed varint / overrun.
+inline bool read_varint(const uint8_t*& p, const uint8_t* end, uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (p < end && shift < 64) {
+    const uint8_t b = *p++;
+    v |= uint64_t(b & 0x7f) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+inline bool skip_field(const uint8_t*& p, const uint8_t* end, uint32_t wire_type) {
+  switch (wire_type) {
+    case 0: {  // varint
+      uint64_t v;
+      return read_varint(p, end, &v);
+    }
+    case 1:  // fixed64
+      if (size_t(end - p) < 8) return false;
+      p += 8;
+      return true;
+    case 2: {  // length-delimited
+      uint64_t len;
+      if (!read_varint(p, end, &len) || uint64_t(end - p) < len) return false;
+      p += len;
+      return true;
+    }
+    case 5:  // fixed32
+      if (size_t(end - p) < 4) return false;
+      p += 4;
+      return true;
+    default:  // groups (3/4) unsupported — protoc never emits them here
+      return false;
+  }
+}
+
+inline int tx_type_code(const uint8_t* p, size_t len) {
+  // proto3 default (absent/empty) means "deposit" — grpc_server.py's
+  // `transaction_type or "deposit"` on the Python path.
+  switch (len) {
+    case 0: return TX_DEPOSIT;
+    case 7: return std::memcmp(p, "deposit", 7) == 0 ? TX_DEPOSIT : TX_OTHER;
+    case 8: return std::memcmp(p, "withdraw", 8) == 0 ? TX_WITHDRAW : TX_OTHER;
+    case 3: return std::memcmp(p, "bet", 3) == 0 ? TX_BET
+                 : std::memcmp(p, "win", 3) == 0 ? TX_WIN : TX_OTHER;
+    default: return TX_OTHER;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Count top-level `transactions` entries (field 1) without parsing rows —
+// sizing pass so Python can allocate exact output buffers.
+int64_t fs_wire_count(const uint8_t* buf, int64_t len) {
+  const uint8_t* p = buf;
+  const uint8_t* end = buf + len;
+  int64_t n = 0;
+  while (p < end) {
+    uint64_t tag;
+    if (!read_varint(p, end, &tag)) return -1;
+    if (tag == ((1u << 3) | 2)) {
+      uint64_t sub;
+      if (!read_varint(p, end, &sub) || uint64_t(end - p) < sub) return -1;
+      p += sub;
+      ++n;
+    } else if (!skip_field(p, end, uint32_t(tag & 7))) {
+      return -1;
+    }
+  }
+  return n;
+}
+
+// Decode a ScoreBatchRequest and gather feature rows in one pass.
+//
+//   out_rows  float32[max_rows * 30]
+//   out_bl    uint8[max_rows]  blacklist hit per row
+//   create    1 => unknown account ids are registered (ingest semantics);
+//             0 => unknown ids score as cold rows (idx -1), matching the
+//             Python gather path
+//
+// Returns rows decoded; -1 malformed proto; -2 more than max_rows rows.
+int64_t fs_decode_gather(void* handle, const uint8_t* buf, int64_t len, double now,
+                         int64_t max_rows, float* out_rows, uint8_t* out_bl,
+                         int create) {
+  Store* s = static_cast<Store*>(handle);
+  const uint8_t* p = buf;
+  const uint8_t* end = buf + len;
+  int64_t n = 0;
+  while (p < end) {
+    uint64_t tag;
+    if (!read_varint(p, end, &tag)) return -1;
+    if (tag != ((1u << 3) | 2)) {
+      if (!skip_field(p, end, uint32_t(tag & 7))) return -1;
+      continue;
+    }
+    uint64_t sub_len;
+    if (!read_varint(p, end, &sub_len) || uint64_t(end - p) < sub_len) return -1;
+    if (n >= max_rows) return -2;
+    const uint8_t* sp = p;
+    const uint8_t* send = p + sub_len;
+    p = send;
+
+    Slice account, tx_type, ip, device, fingerprint;
+    int64_t amount = 0;
+    while (sp < send) {
+      uint64_t ftag;
+      if (!read_varint(sp, send, &ftag)) return -1;
+      const uint32_t field = uint32_t(ftag >> 3);
+      const uint32_t wt = uint32_t(ftag & 7);
+      if (wt == 2) {
+        uint64_t flen;
+        if (!read_varint(sp, send, &flen) || uint64_t(send - sp) < flen) return -1;
+        const Slice v{sp, size_t(flen)};
+        sp += flen;
+        switch (field) {
+          case 1: account = v; break;
+          case 4: tx_type = v; break;
+          case 8: ip = v; break;
+          case 9: device = v; break;
+          case 10: fingerprint = v; break;
+          default: break;  // player_id/currency/game_id/... not gathered
+        }
+      } else if (wt == 0) {
+        uint64_t v;
+        if (!read_varint(sp, send, &v)) return -1;
+        if (field == 3) amount = int64_t(v);
+      } else if (!skip_field(sp, send, wt)) {
+        return -1;
+      }
+    }
+
+    // account.p is null when the field is absent (legal proto3: empty
+    // string is never serialized) — std::string(nullptr, 0) would be UB.
+    const int32_t idx = account.len == 0
+        ? s->resolve("", 0, false)
+        : s->resolve(reinterpret_cast<const char*>(account.p), account.len, create != 0);
+    fill_one(s, idx, amount, tx_type_code(tx_type.p, tx_type.len), now,
+             out_rows + size_t(n) * kNumFeatures);
+    out_bl[n] = s->blacklisted(reinterpret_cast<const char*>(device.p), device.len,
+                               reinterpret_cast<const char*>(fingerprint.p), fingerprint.len,
+                               reinterpret_cast<const char*>(ip.p), ip.len)
+                    ? 1
+                    : 0;
+    ++n;
+  }
+  return n;
+}
+
+}  // extern "C"
+
+namespace {
+
+void fill_one(Store* s, int idx, int64_t amount, int tx_type, double now, float* row) {
+  std::memset(row, 0, sizeof(float) * kNumFeatures);
+  if (idx >= 0 && size_t(idx) < s->accounts.size()) {
+    std::lock_guard<std::mutex> g(s->lock_for(idx));
+    const AccountState& st = s->accounts[size_t(idx)];
+    if (st.initialized) {
+      int c1, c5, ch;
+      window_counts(st, now, &c1, &c5, &ch);
+      row[TX_COUNT_1M] = float(c1);
+      row[TX_COUNT_5M] = float(c5);
+      row[TX_COUNT_1H] = float(ch);
+      const int64_t sum = now <= st.sum_expires_at ? st.sum_1h : 0;
+      row[TX_SUM_1H] = float(sum);
+      row[TX_AVG_1H] = ch > 0 ? float(double(sum) / double(ch)) : 0.0f;
+      if (now <= st.hll_expires_at) {
+        row[UNIQUE_DEVICES_24H] = float(int64_t(st.devices.estimate() + 0.5));
+        row[UNIQUE_IPS_24H] = float(int64_t(st.ips.estimate() + 0.5));
+      }
+      if (st.last_tx_ts > 0.0) row[TIME_SINCE_LAST_TX] = float(now - st.last_tx_ts);
+      if (st.session_start > 0.0 && now <= st.session_expires_at) {
+        row[SESSION_DURATION] = float(now - st.session_start);
+      }
+      row[ACCOUNT_AGE_DAYS] = float((now - st.created_at) / 86400.0);
+      row[TOTAL_DEPOSITS] = float(st.total_deposits);
+      row[TOTAL_WITHDRAWALS] = float(st.total_withdrawals);
+      row[NET_DEPOSIT] = float(st.total_deposits - st.total_withdrawals);
+      row[DEPOSIT_COUNT] = float(st.deposit_count);
+      row[WITHDRAW_COUNT] = float(st.withdraw_count);
+      row[AVG_BET_SIZE] = st.bet_count > 0
+          ? float(double(st.total_bets) / double(st.bet_count)) : 0.0f;
+      row[WIN_RATE] = st.bet_count > 0
+          ? float(double(st.win_count) / double(st.bet_count)) : 0.0f;
+      row[BONUS_CLAIM_COUNT] = float(st.bonus_claim_count);
+      row[BONUS_WAGER_RATE] = st.bonus_wager_rate;
+      if (st.bonus_claim_count > 3 && st.total_deposits < 5000) {
+        row[BONUS_ONLY_PLAYER] = 1.0f;
+      }
+    }
+  }
+  row[TX_AMOUNT] = float(amount);
+  row[TX_TYPE_DEPOSIT] = tx_type == TX_DEPOSIT ? 1.0f : 0.0f;
+  row[TX_TYPE_WITHDRAW] = tx_type == TX_WITHDRAW ? 1.0f : 0.0f;
+  row[TX_TYPE_BET] = tx_type == TX_BET ? 1.0f : 0.0f;
+}
+
+}  // namespace

@@ -264,3 +264,49 @@ def test_one_phase_runner_also_retries():
         assert b.batches_replayed == 1
     finally:
         b.stop()
+
+
+def test_latency_tier_shape_selection():
+    """Single-txn traffic pads to the smallest compiled tier, not the
+    throughput shape (VERDICT r02 item 1)."""
+    from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
+    from igaming_platform_tpu.serve.scorer import ScoreRequest, TPUScoringEngine
+
+    engine = TPUScoringEngine(
+        ScoringConfig(),
+        batcher_config=BatcherConfig(batch_size=1024, latency_tiers=(64, 256), max_wait_ms=1.0),
+        warmup=False,
+    )
+    try:
+        assert engine._shapes == [64, 256, 1024]
+        assert engine._pick_shape(1) == 64
+        assert engine._pick_shape(64) == 64
+        assert engine._pick_shape(65) == 256
+        assert engine._pick_shape(1000) == 1024
+        assert engine._pick_shape(1024) == 1024
+        # A real single score rides the smallest tier end to end.
+        out, n = engine._launch_device(
+            *engine.features.gather_batch([ScoreRequest(account_id="t-1", amount=500)])
+        )
+        assert n == 1
+        assert out.shape == (5, 64)  # packed [5, B] at the smallest tier
+        resp = engine.score(ScoreRequest(account_id="t-1", amount=500))
+        assert 0 <= resp.score <= 100
+    finally:
+        engine.close()
+
+
+def test_latency_tiers_disabled_and_oversize():
+    from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
+    from igaming_platform_tpu.serve.scorer import TPUScoringEngine
+
+    engine = TPUScoringEngine(
+        ScoringConfig(),
+        batcher_config=BatcherConfig(batch_size=128, latency_tiers=(), max_wait_ms=1.0),
+        warmup=False,
+    )
+    try:
+        assert engine._shapes == [128]
+        assert engine._pick_shape(1) == 128
+    finally:
+        engine.close()

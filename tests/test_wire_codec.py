@@ -180,3 +180,146 @@ def test_grpc_scorebatch_fast_path_matches_per_row_path():
     finally:
         server.stop(0)
         engine.close()
+
+
+def _native_store_or_skip():
+    from igaming_platform_tpu.serve import native_store
+
+    if not native_store.native_available():
+        pytest.skip("native feature store unavailable")
+    return native_store.NativeFeatureStore()
+
+
+def test_decode_gather_matches_python_parse_path():
+    """Native request decode+gather == Python protobuf parse + columnar
+    gather, element for element (VERDICT r03 item 2 parity pin)."""
+    import time
+
+    from igaming_platform_tpu.serve.feature_store import TransactionEvent
+
+    store = _native_store_or_skip()
+    now = time.time()
+    for a in range(20):
+        for e in range(4):
+            store.update(TransactionEvent(
+                account_id=f"dg-{a}", amount=100 * a + e,
+                tx_type=("deposit", "bet", "win")[e % 3],
+                ip=f"10.0.0.{a}", device_id=f"d-{a % 5}",
+                timestamp=now - 60 * e,
+            ))
+    store.add_to_blacklist("ip", "10.9.9.9")
+    store.add_to_blacklist("device", "bad-dev")
+    store.add_to_blacklist("fingerprint", "fp-bad")
+
+    txs = [
+        risk_pb2.ScoreTransactionRequest(
+            account_id=f"dg-{(i * 7) % 25}",  # some ids unknown to the store
+            amount=1 + 977 * i,
+            transaction_type=["deposit", "bet", "withdraw", "win", "bonus", ""][i % 6],
+            ip_address="10.9.9.9" if i % 7 == 0 else f"10.0.0.{i}",
+            device_id="bad-dev" if i % 11 == 0 else f"d-{i % 5}",
+            fingerprint="fp-bad" if i % 13 == 0 else f"fp-{i}",
+            player_id=f"p-{i}", currency="USD", game_id="g",
+            user_agent="ua", session_id="s",
+        )
+        for i in range(80)
+    ]
+    payload = risk_pb2.ScoreBatchRequest(transactions=txs).SerializeToString()
+
+    x_native, bl_native = store.decode_gather(payload, now=now)
+
+    req = risk_pb2.ScoreBatchRequest.FromString(payload)
+    x_py, bl_py = store.gather_columns(
+        [t.account_id for t in req.transactions],
+        [t.amount for t in req.transactions],
+        [t.transaction_type or "deposit" for t in req.transactions],
+        ips=[t.ip_address for t in req.transactions],
+        devices=[t.device_id for t in req.transactions],
+        fingerprints=[t.fingerprint for t in req.transactions],
+        now=now,
+    )
+    np.testing.assert_array_equal(x_native, x_py)
+    np.testing.assert_array_equal(bl_native, bl_py)
+    assert bl_native.sum() > 0  # blacklist actually exercised
+
+
+def test_decode_gather_malformed_and_empty():
+    store = _native_store_or_skip()
+    with pytest.raises(ValueError):
+        store.decode_gather(b"\x0a\xff\xff\xff\xff\xff")  # bad length
+    x, bl = store.decode_gather(b"")
+    assert x.shape == (0, 30) and bl.shape == (0,)
+
+
+def test_grpc_scorebatch_raw_native_path():
+    """The raw-bytes ScoreBatch route (native decode + native encode, no
+    Python protobuf anywhere) returns the same fields as the per-row
+    path, and rejects malformed requests with INVALID_ARGUMENT."""
+    import grpc
+
+    from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
+    from igaming_platform_tpu.serve import native_store
+    from igaming_platform_tpu.serve.grpc_server import RiskGrpcService, serve_risk
+    from igaming_platform_tpu.serve.scorer import TPUScoringEngine
+
+    if not native_store.native_available():
+        pytest.skip("native feature store unavailable")
+
+    engine = TPUScoringEngine(
+        ScoringConfig(), ml_backend="mock",
+        batcher_config=BatcherConfig(batch_size=64, max_wait_ms=1.0),
+        feature_store=native_store.NativeFeatureStore(),
+    )
+    service = RiskGrpcService(engine)
+    assert service.raw_request_methods == ("ScoreBatch",)
+    server, health, port = serve_risk(service, 0)
+    try:
+        ch = grpc.insecure_channel(f"localhost:{port}")
+        call = ch.unary_unary(
+            "/risk.v1.RiskService/ScoreBatch",
+            request_serializer=risk_pb2.ScoreBatchRequest.SerializeToString,
+            response_deserializer=risk_pb2.ScoreBatchResponse.FromString,
+        )
+        txs = [
+            risk_pb2.ScoreTransactionRequest(
+                account_id=f"raw-{i % 9}", amount=500 + 31 * i,
+                transaction_type=("deposit", "bet", "withdraw")[i % 3],
+                ip_address=f"10.1.0.{i % 251}", device_id=f"dev-{i % 4}",
+            )
+            for i in range(150)  # > batch_size: exercises pipelined chunking
+        ]
+        resp = call(risk_pb2.ScoreBatchRequest(transactions=txs), timeout=30)
+        assert len(resp.results) == 150
+
+        # Same rows through the engine's object path for comparison.
+        from igaming_platform_tpu.serve.scorer import ScoreRequest
+
+        direct = engine.score_batch([
+            ScoreRequest(account_id=t.account_id, amount=t.amount,
+                         tx_type=t.transaction_type, ip=t.ip_address,
+                         device_id=t.device_id)
+            for t in txs
+        ])
+        for rf, rd in zip(resp.results, direct):
+            assert rf.score == rd.score
+            assert rf.rule_score == rd.rule_score
+            assert rf.ml_score == pytest.approx(rd.ml_score, abs=1e-6)
+            assert list(rf.reason_codes) == [c.value for c in rd.reason_codes]
+
+        # Per-chunk response_time_ms: monotonically non-decreasing across
+        # chunk boundaries, not one whole-RPC constant for giant batches.
+        rtms = [r.response_time_ms for r in resp.results]
+        assert rtms[0] <= rtms[-1]
+
+        raw_call = ch.unary_unary(
+            "/risk.v1.RiskService/ScoreBatch",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        with pytest.raises(grpc.RpcError) as exc_info:
+            raw_call(b"\x0a\xff\xff\xff\xff\xff", timeout=30)
+        assert exc_info.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        ch.close()
+    finally:
+        server.stop(0)
+        engine.close()
