@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import signal
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -26,7 +27,7 @@ from igaming_platform_tpu.platform.repository import (
 from igaming_platform_tpu.platform.wallet import WalletConfig, WalletService
 from igaming_platform_tpu.platform.outbox import InMemoryOutbox, OutboxPublisher, OutboxRelay
 from igaming_platform_tpu.platform.reconcile import ReconciliationJob, Reconciler
-from igaming_platform_tpu.serve.events import InMemoryBroker, default_broker
+from igaming_platform_tpu.serve.events import InMemoryBroker, make_relay_target, resolve_transport
 from igaming_platform_tpu.serve.grpc_server import (
     WalletGrpcService,
     graceful_stop,
@@ -48,7 +49,10 @@ class WalletServer:
     ):
         self.config = config or WalletServiceConfig.from_env()
         self.metrics = ServiceMetrics("wallet")
-        self.broker = broker or default_broker()
+        # EVENT_TRANSPORT=amqp routes the outbox relay to the real RabbitMQ
+        # at RABBITMQ_URL (serve/amqp.py wire client); default stays the
+        # in-process broker so single-binary runs need no infra.
+        self.broker = resolve_transport(broker, self.config.rabbitmq_url)
 
         url = self.config.database_url
         if url.startswith("sqlite://") and url != "sqlite://:memory:":
@@ -76,7 +80,7 @@ class WalletServer:
         # (SQLite deployments share the store; in-memory gets the analog) and
         # a background relay delivers them at-least-once.
         self.outbox = self.store if self.store is not None else InMemoryOutbox()
-        self.outbox_relay = OutboxRelay(self.outbox, self.broker)
+        self.outbox_relay = OutboxRelay(self.outbox, make_relay_target(self.broker))
         self.outbox_relay.start()
         self.wallet = WalletService(
             accounts, transactions, ledger,

@@ -21,11 +21,11 @@ from igaming_platform_tpu.core.enums import (
     EventType,
 )
 from igaming_platform_tpu.serve.events import (
-    Consumer,
     DeliveryDeduper,
     Event,
     InMemoryBroker,
-    Publisher,
+    make_consumer,
+    make_publisher,
     new_risk_event,
 )
 from igaming_platform_tpu.serve.feature_store import TransactionEvent
@@ -48,15 +48,18 @@ class ScoringBridge:
     def __init__(
         self,
         engine: TPUScoringEngine,
-        broker: InMemoryBroker,
+        broker: "InMemoryBroker | str",
         *,
         abuse_detector=None,
         publish_risk_events: bool = True,
         high_score_threshold: int = 70,
     ):
+        """``broker``: an in-process InMemoryBroker, or an ``amqp://`` URL
+        for a real RabbitMQ (the consumer goroutine the reference declares
+        at risk/cmd/main.go:218-224 — here over either transport)."""
         self.engine = engine
         self.broker = broker
-        self.publisher = Publisher(broker)
+        self.publisher = make_publisher(broker)
         self.abuse_detector = abuse_detector
         self.publish_risk_events = publish_risk_events
         self.high_score_threshold = high_score_threshold
@@ -68,7 +71,7 @@ class ScoringBridge:
         # features. Bounded FIFO (duplicates arrive close to the original:
         # crash-replay or broker redelivery, not arbitrarily late).
         self._dedupe = DeliveryDeduper()
-        self._consumer = Consumer(broker)
+        self._consumer = make_consumer(broker)
         self._consumer.subscribe(QUEUE_RISK_SCORING, self._handle_event)
 
     def start(self) -> None:
@@ -78,7 +81,11 @@ class ScoringBridge:
         self._consumer.stop()
 
     def drain(self, max_events: int | None = None) -> int:
-        """Synchronously process queued events (tests / replay)."""
+        """Synchronously process queued events (tests / replay). Only the
+        in-process broker supports pull-style draining; the AMQP consumer
+        is push-based — use start()/stop()."""
+        if not hasattr(self._consumer, "drain"):
+            raise RuntimeError("drain() requires the in-process broker transport")
         return self._consumer.drain(QUEUE_RISK_SCORING, max_events=max_events)
 
     # -- event handling ------------------------------------------------------

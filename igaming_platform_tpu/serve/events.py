@@ -147,22 +147,31 @@ class InMemoryBroker:
         self._queues[queue_name].put(payload)
 
 
+# The reference topology as data — 3 exchanges, 4 queues, binding patterns
+# (publisher.go:35-44; SURVEY.md §1 inter-service topology). SHARED between
+# the in-process broker and the AMQP layer so both transports route
+# identically: the risk-scoring queue sees every wallet money movement, the
+# bonus processor reacts to transactions/bets, analytics sees everything,
+# notifications get risk + bonus events.
+CANONICAL_BINDINGS: tuple[tuple[str, str, str], ...] = (
+    (QUEUE_RISK_SCORING, EXCHANGE_WALLET, "#"),
+    (QUEUE_BONUS_PROCESSOR, EXCHANGE_WALLET, "transaction.*"),
+    (QUEUE_BONUS_PROCESSOR, EXCHANGE_WALLET, "bet.*"),
+    (QUEUE_ANALYTICS, EXCHANGE_WALLET, "#"),
+    (QUEUE_ANALYTICS, EXCHANGE_BONUS, "#"),
+    (QUEUE_ANALYTICS, EXCHANGE_RISK, "#"),
+    (QUEUE_NOTIFICATIONS, EXCHANGE_RISK, "#"),
+    (QUEUE_NOTIFICATIONS, EXCHANGE_BONUS, "bonus.*"),
+)
+
+
 def default_broker() -> InMemoryBroker:
-    """The reference topology: 3 exchanges, 4 queues (publisher.go:35-44,
-    binding intent per SURVEY.md §1 inter-service topology)."""
+    """The canonical topology over the in-process broker."""
     b = InMemoryBroker()
     for ex in (EXCHANGE_WALLET, EXCHANGE_BONUS, EXCHANGE_RISK):
         b.declare_exchange(ex)
-    # Risk scoring consumes every wallet money movement.
-    b.bind(QUEUE_RISK_SCORING, EXCHANGE_WALLET, "#")
-    # Bonus processor reacts to completed transactions (bets drive wagering).
-    b.bind(QUEUE_BONUS_PROCESSOR, EXCHANGE_WALLET, "transaction.*")
-    b.bind(QUEUE_BONUS_PROCESSOR, EXCHANGE_WALLET, "bet.*")
-    # Analytics and notifications see everything from all three exchanges.
-    for ex in (EXCHANGE_WALLET, EXCHANGE_BONUS, EXCHANGE_RISK):
-        b.bind(QUEUE_ANALYTICS, ex, "#")
-    b.bind(QUEUE_NOTIFICATIONS, EXCHANGE_RISK, "#")
-    b.bind(QUEUE_NOTIFICATIONS, EXCHANGE_BONUS, "bonus.*")
+    for qname, exchange, pattern in CANONICAL_BINDINGS:
+        b.bind(qname, exchange, pattern)
     return b
 
 
@@ -225,6 +234,9 @@ class Publisher:
 
     def publish_with_routing(self, exchange: str, routing_key: str, event: Event) -> None:
         self.broker.publish_raw(exchange, routing_key, event.to_json())
+
+    def publish_raw(self, exchange: str, routing_key: str, payload: str) -> None:
+        self.broker.publish_raw(exchange, routing_key, payload)
 
 
 class Consumer:
@@ -353,3 +365,68 @@ def new_risk_event(event_type: str, risk: dict) -> Event:
             "reason_codes": risk.get("reason_codes", []),
         },
     )
+
+
+# ---------------------------------------------------------------------------
+# Transport selection: in-process broker vs real AMQP (RabbitMQ)
+# ---------------------------------------------------------------------------
+
+ALL_EXCHANGES = (EXCHANGE_WALLET, EXCHANGE_BONUS, EXCHANGE_RISK)
+
+
+def is_amqp_url(transport) -> bool:
+    return isinstance(transport, str) and transport.startswith("amqp://")
+
+
+def _require_valid_transport(transport) -> None:
+    """A string transport MUST be an amqp:// URL — any other scheme would
+    silently become a broken broker object (the outbox relay would retry
+    an AttributeError forever). Misconfiguration fails loudly, at startup."""
+    if isinstance(transport, str) and not transport.startswith("amqp://"):
+        raise ValueError(
+            f"unsupported event transport URL {transport!r}: only amqp:// is "
+            "supported (amqps:// TLS termination belongs to a sidecar/proxy)"
+        )
+
+
+def make_publisher(transport):
+    """Publisher for a transport: an ``InMemoryBroker`` instance, or an
+    ``amqp://`` URL for a real RabbitMQ (serve/amqp.py wire client).
+    Both results expose publish / publish_with_routing / publish_raw."""
+    _require_valid_transport(transport)
+    if is_amqp_url(transport):
+        from igaming_platform_tpu.serve.amqp import AmqpPublisher
+
+        return AmqpPublisher(transport, ALL_EXCHANGES)
+    return Publisher(transport)
+
+
+def make_consumer(transport, prefetch: int = 64, max_redelivery: int = 5):
+    """Consumer for a transport (same subscribe/start/stop surface on both
+    the in-process and the AMQP implementation)."""
+    _require_valid_transport(transport)
+    if is_amqp_url(transport):
+        from igaming_platform_tpu.serve.amqp import AmqpConsumer
+
+        return AmqpConsumer(transport, prefetch=prefetch, max_redelivery=max_redelivery)
+    return Consumer(transport, prefetch=prefetch, max_redelivery=max_redelivery)
+
+
+def make_relay_target(transport):
+    """The object OutboxRelay publishes through (needs publish_raw)."""
+    _require_valid_transport(transport)
+    return make_publisher(transport) if is_amqp_url(transport) else transport
+
+
+def resolve_transport(broker, rabbitmq_url: str):
+    """Shared server-constructor logic: an explicit broker wins; otherwise
+    EVENT_TRANSPORT=amqp selects the service's RABBITMQ_URL, and the
+    default is a fresh in-process broker with the canonical topology."""
+    import os
+
+    if broker is not None:
+        return broker
+    if os.environ.get("EVENT_TRANSPORT", "memory") == "amqp":
+        _require_valid_transport(rabbitmq_url)
+        return rabbitmq_url
+    return default_broker()

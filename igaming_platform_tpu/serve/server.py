@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import signal
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -20,7 +21,7 @@ from igaming_platform_tpu.core.config import RiskServiceConfig
 from igaming_platform_tpu.obs.metrics import ServiceMetrics
 from igaming_platform_tpu.serve.abuse import SequenceAbuseDetector
 from igaming_platform_tpu.serve.bridge import ScoringBridge
-from igaming_platform_tpu.serve.events import InMemoryBroker, default_broker
+from igaming_platform_tpu.serve.events import InMemoryBroker, resolve_transport
 from igaming_platform_tpu.serve.grpc_server import (
     RiskGrpcService,
     graceful_stop,
@@ -128,7 +129,7 @@ class RiskServer:
             mesh=mesh if seq_sharded else None,
             seq_mode="ring" if seq_sharded else "dense",
         )
-        self.broker = broker or default_broker()
+        self.broker = resolve_transport(broker, self.config.rabbitmq_url)
         self.bridge = ScoringBridge(self.engine, self.broker, abuse_detector=self.abuse)
 
         service = RiskGrpcService(
