@@ -1,0 +1,289 @@
+"""PostgreSQL store of record — the reference's production backend.
+
+Mirrors /root/reference/services/wallet/internal/repository/postgres.go
+(optimistic locking :129-148, idempotency lookup :229-240, daily stats
+:285-308, ledger verify :358-390, UnitOfWork :393-443) and the schema +
+trigger backstops of /root/reference/deploy/init-db.sql (CHECK balance>=0
+:17-18, version-increment trigger :224-236, auto updated_at :211-221),
+over the pure-Python wire client (platform/pgwire.py — no driver ships in
+this image).
+
+The repository views are the SAME classes as the SQLite backend
+(repository.py): PgConnection.execute translates '?' placeholders to $n
+and coerces result types by OID, so the SQL and the semantics live in one
+place and both backends run the same unit suites. Postgres-specific
+overrides are exactly the dialect edges: unique-violation mapping,
+BIGSERIAL insertion-order tiebreaks, and the DDL.
+
+Connection discipline matches the SQLite store: one connection, all calls
+serialized by the store lock, multi-call operations wrapped by
+unit_of_work() (BEGIN..COMMIT with rollback on error).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+from igaming_platform_tpu.platform.domain import DuplicateTransactionError, Transaction
+from igaming_platform_tpu.platform.pgwire import (
+    UNIQUE_VIOLATION,
+    PgConnection,
+    PgError,
+)
+from igaming_platform_tpu.platform.repository import (
+    _SQLiteAccounts,
+    _SQLiteLedger,
+    _SQLiteTransactions,
+)
+
+_PG_SCHEMA = """
+CREATE TABLE IF NOT EXISTS accounts (
+    id TEXT PRIMARY KEY,
+    player_id TEXT UNIQUE NOT NULL,
+    currency TEXT NOT NULL DEFAULT 'USD',
+    balance BIGINT NOT NULL DEFAULT 0 CHECK (balance >= 0),
+    bonus BIGINT NOT NULL DEFAULT 0 CHECK (bonus >= 0),
+    status TEXT NOT NULL DEFAULT 'active',
+    version BIGINT NOT NULL DEFAULT 1,
+    created_at DOUBLE PRECISION NOT NULL,
+    updated_at DOUBLE PRECISION NOT NULL
+);
+CREATE TABLE IF NOT EXISTS transactions (
+    id TEXT PRIMARY KEY,
+    account_id TEXT NOT NULL REFERENCES accounts(id),
+    idempotency_key TEXT,
+    type TEXT NOT NULL,
+    amount BIGINT NOT NULL CHECK (amount > 0),
+    balance_before BIGINT NOT NULL,
+    balance_after BIGINT NOT NULL,
+    status TEXT NOT NULL DEFAULT 'pending',
+    reference TEXT NOT NULL DEFAULT '',
+    game_id TEXT,
+    round_id TEXT,
+    risk_score BIGINT,
+    created_at DOUBLE PRECISION NOT NULL,
+    completed_at DOUBLE PRECISION,
+    seq BIGSERIAL
+);
+CREATE UNIQUE INDEX IF NOT EXISTS idx_tx_idem
+    ON transactions(account_id, idempotency_key)
+    WHERE status != 'failed' AND idempotency_key IS NOT NULL;
+CREATE INDEX IF NOT EXISTS idx_tx_account ON transactions(account_id, created_at DESC);
+CREATE TABLE IF NOT EXISTS ledger_entries (
+    id TEXT PRIMARY KEY,
+    transaction_id TEXT NOT NULL REFERENCES transactions(id),
+    account_id TEXT NOT NULL REFERENCES accounts(id),
+    entry_type TEXT NOT NULL CHECK (entry_type IN ('debit','credit')),
+    amount BIGINT NOT NULL CHECK (amount > 0),
+    balance_after BIGINT NOT NULL,
+    description TEXT NOT NULL DEFAULT '',
+    created_at DOUBLE PRECISION NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_ledger_account ON ledger_entries(account_id);
+CREATE TABLE IF NOT EXISTS event_outbox (
+    id BIGSERIAL PRIMARY KEY,
+    exchange TEXT NOT NULL,
+    routing_key TEXT NOT NULL,
+    payload TEXT NOT NULL,
+    published INTEGER NOT NULL DEFAULT 0,
+    created_at DOUBLE PRECISION NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_outbox_unpublished ON event_outbox(published) WHERE published = 0;
+CREATE TABLE IF NOT EXISTS audit_log (
+    id BIGSERIAL PRIMARY KEY,
+    entity TEXT NOT NULL,
+    entity_id TEXT NOT NULL,
+    action TEXT NOT NULL,
+    old_value TEXT,
+    new_value TEXT,
+    created_at DOUBLE PRECISION NOT NULL
+);
+"""
+
+# DB-trigger backstop: a concurrent update that slips past the optimistic
+# WHERE version=$n (e.g. a buggy write path setting version directly) is
+# rejected by the database itself — init-db.sql:224-236.
+_PG_TRIGGERS = """
+CREATE OR REPLACE FUNCTION accounts_version_backstop() RETURNS trigger AS $$
+BEGIN
+    IF NEW.version IS DISTINCT FROM OLD.version
+       AND NEW.version IS DISTINCT FROM OLD.version + 1 THEN
+        RAISE EXCEPTION 'version must increment by exactly 1 (got % -> %)',
+            OLD.version, NEW.version USING ERRCODE = '40001';
+    END IF;
+    RETURN NEW;
+END $$ LANGUAGE plpgsql;
+DROP TRIGGER IF EXISTS trg_accounts_version ON accounts;
+CREATE TRIGGER trg_accounts_version BEFORE UPDATE ON accounts
+    FOR EACH ROW EXECUTE FUNCTION accounts_version_backstop();
+"""
+
+
+class _PgConnAdapter:
+    """sqlite3-connection-shaped facade over PgConnection, so the SQLite
+    repository views run unchanged (they call conn.execute(sql, params)
+    and read cursor.rowcount/fetchone/fetchall). A dead connection is
+    reconnected and the statement retried ONCE — but only outside a unit
+    of work (a mid-transaction retry would silently split the
+    transaction; the UoW aborts and the caller retries whole)."""
+
+    def __init__(self, store: "PostgresStore"):
+        self._store = store
+
+    def execute(self, sql: str, params: tuple = ()):
+        from igaming_platform_tpu.platform.pgwire import PgProtocolError
+
+        try:
+            return self._store._pg.execute(sql, tuple(params))
+        except PgProtocolError:
+            if self._store._tx_depth > 0:
+                raise
+            self._store._reconnect()
+            return self._store._pg.execute(sql, tuple(params))
+
+
+class _PgTransactions(_SQLiteTransactions):
+    """Dialect overrides: explicit column list (the PG table has a
+    trailing BIGSERIAL seq), seq as the insertion-order tiebreak, and
+    SQLSTATE-based duplicate mapping (postgres.go:446-453)."""
+
+    def create(self, t: Transaction) -> None:
+        with self._s._lock:
+            try:
+                self._s._conn.execute(
+                    "INSERT INTO transactions (id, account_id, idempotency_key, type, amount,"
+                    " balance_before, balance_after, status, reference, game_id, round_id,"
+                    " risk_score, created_at, completed_at) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                    (t.id, t.account_id, t.idempotency_key or None, t.type.value, t.amount,
+                     t.balance_before, t.balance_after, t.status.value, t.reference,
+                     t.game_id, t.round_id, t.risk_score, t.created_at, t.completed_at),
+                )
+                self._s._commit()
+            except PgError as exc:
+                if exc.sqlstate == UNIQUE_VIOLATION:
+                    raise DuplicateTransactionError(t.idempotency_key) from exc
+                raise
+
+    def list_by_account(self, account_id, limit=50, offset=0, *, types=None,
+                        from_ts=None, to_ts=None, game_id=None):
+        where, params = self._filter_sql(types, from_ts, to_ts, game_id)
+        with self._s._lock:
+            rows = self._s._conn.execute(
+                "SELECT id, account_id, idempotency_key, type, amount, balance_before,"
+                " balance_after, status, reference, game_id, round_id, risk_score,"
+                f" created_at, completed_at FROM transactions WHERE account_id=? {where}"
+                " ORDER BY created_at DESC, seq DESC LIMIT ? OFFSET ?",
+                (account_id, *params, limit, offset),
+            ).fetchall()
+        return [self._row_to_tx(r) for r in rows]
+
+
+class PostgresStore:
+    """Same surface as SQLiteStore over a real PostgreSQL."""
+
+    def __init__(self, url: str, *, bootstrap: bool = True):
+        self._url = url
+        self._pg = PgConnection(url)
+        self._pg.connect()
+        self._conn = _PgConnAdapter(self)
+        self._lock = threading.RLock()
+        self._tx_depth = 0
+        if bootstrap:
+            self._bootstrap()
+        self.accounts = _SQLiteAccounts(self)
+        self.transactions = _PgTransactions(self)
+        self.ledger = _SQLiteLedger(self)
+
+    def _reconnect(self) -> None:
+        """Replace a dead connection (PG restart, network blip) — the
+        store of record must heal like the AMQP publisher does."""
+        try:
+            self._pg.close()
+        except Exception:  # noqa: BLE001 — already dead
+            pass
+        self._pg = PgConnection(self._url)
+        self._pg.connect()
+
+    def _bootstrap(self) -> None:
+        for stmt in _PG_SCHEMA.split(";"):
+            if stmt.strip():
+                self._pg.execute(stmt)
+        # plpgsql bodies contain semicolons — run as one simple-query batch.
+        self._pg._simple(_PG_TRIGGERS)
+
+    def close(self) -> None:
+        self._pg.close()
+
+    def _commit(self) -> None:
+        # Outside a unit of work each statement autocommits at Sync;
+        # inside one, the UoW's COMMIT finishes the explicit transaction.
+        pass
+
+    @contextlib.contextmanager
+    def unit_of_work(self):
+        """BEGIN..COMMIT across several repository calls (the UnitOfWork
+        wrapper of postgres.go:393-443); reentrant like the SQLite one."""
+        with self._lock:
+            if self._tx_depth == 0:
+                self._pg.begin()
+            self._tx_depth += 1
+            try:
+                yield self
+            except BaseException:
+                self._tx_depth -= 1
+                if self._tx_depth == 0:
+                    try:
+                        self._pg.rollback()
+                    except Exception:  # noqa: BLE001 — dead socket: the
+                        # server aborts the tx anyway; reconnect for the
+                        # next operation and surface the ORIGINAL error.
+                        try:
+                            self._reconnect()
+                        except Exception:  # noqa: BLE001
+                            pass
+                raise
+            else:
+                self._tx_depth -= 1
+                if self._tx_depth == 0:
+                    self._pg.commit()
+
+    def audit(self, entity: str, entity_id: str, action: str, old: str = "", new: str = "") -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO audit_log (entity, entity_id, action, old_value, new_value, created_at)"
+                " VALUES (?,?,?,?,?,?)",
+                (entity, entity_id, action, old, new, time.time()),
+            )
+            self._commit()
+
+    def outbox_add(self, exchange: str, routing_key: str, payload: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO event_outbox (exchange, routing_key, payload, published, created_at)"
+                " VALUES (?,?,?,0,?)",
+                (exchange, routing_key, payload, time.time()),
+            )
+            self._commit()
+
+    def outbox_drain(self):
+        with self._lock:
+            return self._conn.execute(
+                "SELECT id, exchange, routing_key, payload FROM event_outbox"
+                " WHERE published = 0 ORDER BY id"
+            ).fetchall()
+
+    def outbox_mark_published(self, row_id: int) -> None:
+        with self._lock:
+            self._conn.execute("UPDATE event_outbox SET published = 1 WHERE id = ?", (row_id,))
+            self._commit()
+
+    def outbox_purge_published(self, older_than_s: float = 3600.0) -> int:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM event_outbox WHERE published = 1 AND created_at < ?",
+                (time.time() - older_than_s,),
+            )
+            self._commit()
+            return cur.rowcount

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import json
 import logging
-import os
 import signal
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -55,7 +54,16 @@ class WalletServer:
         self.broker = resolve_transport(broker, self.config.rabbitmq_url)
 
         url = self.config.database_url
-        if url.startswith("sqlite://") and url != "sqlite://:memory:":
+        if url.startswith(("postgres://", "postgresql://")):
+            # Production store of record (postgres.go over the pure-Python
+            # wire client; schema + trigger backstops bootstrapped).
+            from igaming_platform_tpu.platform.pg_store import PostgresStore
+
+            self.store = PostgresStore(url)
+            accounts, transactions, ledger = (
+                self.store.accounts, self.store.transactions, self.store.ledger
+            )
+        elif url.startswith("sqlite://") and url != "sqlite://:memory:":
             self.store = SQLiteStore(url.removeprefix("sqlite://"))
             accounts, transactions, ledger = (
                 self.store.accounts, self.store.transactions, self.store.ledger

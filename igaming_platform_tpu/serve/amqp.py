@@ -419,16 +419,26 @@ class AmqpConnection:
         routing_key, body) or None on timeout."""
         if self._sock is None:
             raise AmqpConnectionClosed("not connected")
-        self._sock.settimeout(timeout)
-        try:
-            cm, r = self.recv_method()
-        except AmqpError as exc:
-            if "read timeout" in str(exc):
+        # The timeout may only fire while ZERO bytes of the next frame have
+        # been consumed — timing out between a frame's header and payload
+        # would desync the stream (the next read would parse mid-payload
+        # bytes as a header). So: one timed recv to learn whether anything
+        # arrived, then fully blocking reads for the complete frame.
+        if not self._recv_buf:
+            self._sock.settimeout(timeout)
+            try:
+                chunk = self._sock.recv(65536)
+            except socket.timeout:
                 return None
-            raise
-        finally:
-            if self._sock is not None:
-                self._sock.settimeout(None)
+            except (OSError, AttributeError) as exc:
+                raise AmqpConnectionClosed(f"recv failed: {exc}") from exc
+            finally:
+                if self._sock is not None:
+                    self._sock.settimeout(None)
+            if not chunk:
+                raise AmqpConnectionClosed("connection closed by peer")
+            self._recv_buf += chunk
+        cm, r = self.recv_method()
         if cm != BASIC_DELIVER:
             raise AmqpError(f"expected basic.deliver, got {cm}")
         r.shortstr()  # consumer tag
@@ -505,6 +515,19 @@ class AmqpPublisher:
         self._conn.connect()
         for ex in self.exchanges:
             self._conn.declare_exchange(ex, "topic", durable=True)
+        # Bootstrap the full canonical topology (queues + bindings), not
+        # just exchanges: a confirm on a bindingless exchange means the
+        # broker ACCEPTED and DISCARDED the message — outbox rows would be
+        # marked published while events emitted before the first consumer
+        # attaches are lost. Durable queues make publish-before-consume
+        # safe on a fresh broker.
+        from igaming_platform_tpu.serve.events import CANONICAL_BINDINGS
+
+        for qname, exchange, pattern in CANONICAL_BINDINGS:
+            if exchange in self.exchanges or not self.exchanges:
+                self._conn.declare_exchange(exchange, "topic", durable=True)
+                self._conn.declare_queue(qname, durable=True)
+                self._conn.bind_queue(qname, exchange, pattern)
         self._conn.confirm_select()
 
     def publish(self, exchange: str, event: Event) -> None:

@@ -426,7 +426,14 @@ def resolve_transport(broker, rabbitmq_url: str):
 
     if broker is not None:
         return broker
-    if os.environ.get("EVENT_TRANSPORT", "memory") == "amqp":
+    mode = os.environ.get("EVENT_TRANSPORT", "memory").strip().lower()
+    if mode == "amqp":
         _require_valid_transport(rabbitmq_url)
         return rabbitmq_url
+    if mode != "memory":
+        # A typo ('AMQP ', 'rabbitmq') must not silently become a private
+        # in-process broker that delivers to nobody.
+        raise ValueError(
+            f"unknown EVENT_TRANSPORT {mode!r}: expected 'memory' or 'amqp'"
+        )
     return default_broker()
