@@ -116,6 +116,10 @@ class WalletServer:
         self.http_server, self.http_port = self._start_http(
             http_port if http_port is not None else self.config.http_port
         )
+        # OTLP span export to Jaeger when OTEL_EXPORTER_OTLP_ENDPOINT set.
+        from igaming_platform_tpu.obs.otlp import exporter_from_env
+
+        self.otlp = exporter_from_env("wallet")
         self._stopped = threading.Event()
         logger.info("wallet server up: grpc=%d http=%d", self.grpc_port, self.http_port)
 
@@ -163,6 +167,8 @@ class WalletServer:
         self.reconcile_job.stop()
         # Final drain before the store closes so accepted ops' events ship.
         self.outbox_relay.stop(drain=True)
+        if self.otlp is not None:
+            self.otlp.stop()
         if self.store is not None:
             self.store.close()
 

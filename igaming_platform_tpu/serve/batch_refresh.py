@@ -41,6 +41,9 @@ class BatchFeatures:
     bet_count: int = 0
     win_count: int = 0
     created_at: float = 0.0
+    # None = source has no bonus view; the store keeps its stream-fed
+    # value (engine.go:137 carries this from ClickHouse when present).
+    bonus_claim_count: int | None = None
 
 
 def wallet_store_source(db_path: str):
@@ -104,6 +107,7 @@ class BatchFeatureRefreshJob:
                 total_wins=bf.total_wins,
                 bet_count=bf.bet_count,
                 win_count=bf.win_count,
+                bonus_claim_count=bf.bonus_claim_count,
                 created_at=bf.created_at or None,
             )
         self.last_refresh_count = len(rows)
@@ -128,4 +132,13 @@ class BatchFeatureRefreshJob:
                 self.refresh_once()
             except Exception:  # noqa: BLE001 — refresh must not die
                 logger.warning("batch feature refresh failed", exc_info=True)
-            self._stop.wait(self.interval_s)
+            # Until the FIRST successful scan, retry fast: an external
+            # source (ClickHouse) that wasn't up when this service booted
+            # must not leave the scorer on empty batch aggregates for a
+            # whole interval (compose gives no cross-profile ordering).
+            wait = (
+                self.interval_s
+                if self.last_refresh_at > 0
+                else min(15.0, self.interval_s)
+            )
+            self._stop.wait(wait)

@@ -150,15 +150,26 @@ class RiskServer:
         # the wallet store — with an immediate first scan so a restarted
         # scorer doesn't serve empty batch features until the first tick.
         self.batch_refresh = None
-        if self.config.batch_feature_db:
-            from igaming_platform_tpu.serve.batch_refresh import (
-                BatchFeatureRefreshJob,
-                wallet_store_source,
-            )
+        batch_source = None
+        if self.config.clickhouse_url.startswith("http"):
+            # External analytical store (engine.go:127-140's schema over
+            # the ClickHouse HTTP interface). tcp:// (the reference's
+            # native-protocol default) is NOT selected automatically —
+            # set CLICKHOUSE_URL=http://host:8123 to opt in.
+            from igaming_platform_tpu.serve.clickhouse import clickhouse_source
+
+            batch_source = clickhouse_source(self.config.clickhouse_url)
+            logger.info("batch features from ClickHouse at %s", self.config.clickhouse_url)
+        elif self.config.batch_feature_db:
+            from igaming_platform_tpu.serve.batch_refresh import wallet_store_source
+
+            batch_source = wallet_store_source(self.config.batch_feature_db)
+        if batch_source is not None:
+            from igaming_platform_tpu.serve.batch_refresh import BatchFeatureRefreshJob
 
             self.batch_refresh = BatchFeatureRefreshJob(
                 self.engine.features,
-                wallet_store_source(self.config.batch_feature_db),
+                batch_source,
                 interval_s=self.config.batch_feature_interval_s,
             )
             try:
@@ -168,6 +179,9 @@ class RiskServer:
                 logger.warning("initial batch-feature refresh failed", exc_info=True)
             self.batch_refresh.start()
 
+        from igaming_platform_tpu.obs.otlp import exporter_from_env
+
+        self.otlp = exporter_from_env("risk")
         self._stopped = threading.Event()
 
         # Device-liveness probe (SURVEY.md §5: "health gate tied to device
@@ -285,6 +299,8 @@ class RiskServer:
         self.bridge.stop()
         graceful_stop(self.grpc_server, self.health, grace)
         self.http_server.shutdown()
+        if self.otlp is not None:
+            self.otlp.stop()
         self.engine.close()
 
     def wait_for_signal(self) -> None:

@@ -1,0 +1,163 @@
+"""ClickHouse batch-feature adapter vs an in-process HTTP endpoint.
+
+Pins the HTTP-interface request (method, auth headers, JSONEachRow
+format) and the response parsing into BatchFeatures, plus the refresh
+job end-to-end into a feature store. Set CLICKHOUSE_URL to run the live
+query shape against a real ClickHouse.
+"""
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from igaming_platform_tpu.serve.clickhouse import (
+    BATCH_FEATURES_SQL,
+    ClickHouseClient,
+    ClickHouseError,
+    clickhouse_source,
+)
+
+
+class _FakeClickHouse:
+    def __init__(self, rows=None, status=200):
+        self.rows = rows or []
+        self.status = status
+        self.requests: list[dict] = []
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                size = int(self.headers.get("Content-Length", 0))
+                fake.requests.append({
+                    "path": self.path,
+                    "sql": self.rfile.read(size).decode(),
+                    "user": self.headers.get("X-ClickHouse-User"),
+                    "key": self.headers.get("X-ClickHouse-Key"),
+                })
+                if fake.status != 200:
+                    self.send_response(fake.status)
+                    self.end_headers()
+                    self.wfile.write(b"Code: 62. DB::Exception: syntax error")
+                    return
+                body = "\n".join(json.dumps(r) for r in fake.rows).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+def test_query_request_shape_and_parse():
+    fake = _FakeClickHouse(rows=[{"ok": 1}])
+    try:
+        client = ClickHouseClient(fake.url, database="risk", user="u", password="p")
+        assert client.ping()
+        req = fake.requests[0]
+        assert "database=risk" in req["path"]
+        assert "default_format=JSONEachRow" in req["path"]
+        assert req["user"] == "u" and req["key"] == "p"
+        assert req["sql"] == "SELECT 1 AS ok"
+    finally:
+        fake.close()
+
+
+def test_source_maps_rows_to_batch_features():
+    rows = [
+        {
+            "account_id": "a-1", "total_deposits": 150_000, "total_withdrawals": 20_000,
+            "deposit_count": 3, "withdraw_count": 1, "total_bets": 90_000,
+            "total_wins": 70_000, "bet_count": 45, "win_count": 20,
+            "account_created_at": 1_700_000_000.0, "bonus_claim_count": 2,
+        },
+        {"account_id": "a-2", "total_deposits": 500, "deposit_count": 1,
+         "account_created_at": 0},
+    ]
+    fake = _FakeClickHouse(rows=rows)
+    try:
+        scan = clickhouse_source(fake.url, table="risk_events")
+        out = scan()
+        assert "FROM risk_events" in fake.requests[0]["sql"]
+        bf = out["a-1"]
+        assert bf.total_deposits == 150_000 and bf.bet_count == 45
+        assert bf.created_at == 1_700_000_000.0
+        assert bf.bonus_claim_count == 2
+        assert out["a-2"].total_deposits == 500
+        assert out["a-2"].bonus_claim_count == 2 or out["a-2"].bonus_claim_count is None
+    finally:
+        fake.close()
+
+
+def test_refresh_job_end_to_end_into_feature_store():
+    """ClickHouse rows land in the scorer's gather matrix via the refresh
+    job — the full path the reference's hourly ticker declares."""
+    from igaming_platform_tpu.core.features import F, NUM_FEATURES
+    from igaming_platform_tpu.serve.batch_refresh import BatchFeatureRefreshJob
+    from igaming_platform_tpu.serve.feature_store import InMemoryFeatureStore
+
+    rows = [{
+        "account_id": "ch-acct", "total_deposits": 250_000, "total_withdrawals": 50_000,
+        "deposit_count": 5, "withdraw_count": 2, "total_bets": 120_000,
+        "total_wins": 60_000, "bet_count": 60, "win_count": 30,
+        "account_created_at": 1_600_000_000.0, "bonus_claim_count": 1,
+    }]
+    fake = _FakeClickHouse(rows=rows)
+    try:
+        store = InMemoryFeatureStore()
+        job = BatchFeatureRefreshJob(store, clickhouse_source(fake.url), interval_s=3600)
+        assert job.refresh_once() == 1
+        row = np.zeros(NUM_FEATURES, dtype=np.float32)
+        store.fill_row(row, "ch-acct", 1000, "deposit")
+        assert row[F.TOTAL_DEPOSITS] == 250_000
+        assert row[F.NET_DEPOSIT] == 200_000
+        assert row[F.DEPOSIT_COUNT] == 5
+        assert row[F.AVG_BET_SIZE] == pytest.approx(2000.0)
+        assert row[F.WIN_RATE] == pytest.approx(0.5)
+        assert row[F.BONUS_CLAIM_COUNT] == 1
+    finally:
+        fake.close()
+
+
+def test_http_error_raises_clickhouse_error():
+    fake = _FakeClickHouse(status=500)
+    try:
+        with pytest.raises(ClickHouseError, match="HTTP 500"):
+            ClickHouseClient(fake.url).query("SELECT broken")
+    finally:
+        fake.close()
+
+
+def test_unreachable_raises_clickhouse_error():
+    with pytest.raises(ClickHouseError, match="unreachable"):
+        ClickHouseClient("http://127.0.0.1:1", timeout_s=0.5).query("SELECT 1")
+
+
+@pytest.mark.skipif(
+    not os.environ.get("CLICKHOUSE_URL", "").startswith("http"),
+    reason="integration: set CLICKHOUSE_URL to a live ClickHouse HTTP endpoint",
+)
+def test_live_clickhouse_query_shape():
+    client = ClickHouseClient(os.environ["CLICKHOUSE_URL"])
+    assert client.ping()
+    client.query(
+        "CREATE TABLE IF NOT EXISTS tpu_it_events"
+        " (account_id String, type String, amount Int64, ts Float64)"
+        " ENGINE = MergeTree ORDER BY account_id"
+    )
+    client.query(
+        "INSERT INTO tpu_it_events VALUES ('it-1', 'deposit', 1000, 1700000000)"
+    )
+    out = clickhouse_source(client, table="tpu_it_events")()
+    assert out["it-1"].total_deposits >= 1000
