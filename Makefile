@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-fast bench bench-all native proto run-risk run-wallet dryrun clean
+.PHONY: all test test-fast bench bench-all eval native proto run-risk run-wallet dryrun clean
 
 all: native test
 
@@ -24,6 +24,12 @@ bench-all:
 
 soak:
 	$(PY) benchmarks/soak.py
+
+# Model quality on labeled synthetic fraud: trains multitask + GBDT and
+# writes EVAL.json (AUC / PR / calibration; trained > mock > rules).
+# The model-validate capability of the reference Makefile:215-225.
+eval:
+	$(PY) -m igaming_platform_tpu.train.eval --out EVAL.json
 
 # Native runtime pieces (C++ feature store).
 native:

@@ -79,11 +79,10 @@ def sample_features(rng: np.random.Generator, n: int) -> np.ndarray:
     return x
 
 
-def make_targets(x: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Teacher targets from reference-semantics functions."""
-    xn = np.asarray(normalize(x, ref_compat=True))
-    fraud = np.asarray(mock_predict(xn), dtype=np.float32)
-
+def make_aux_targets(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(ltv, churn) teacher targets — pure numpy, no model forward.
+    Split out so callers that supply their own fraud labels (train/eval.py)
+    don't pay a mock_predict dispatch per batch just to discard it."""
     # Churn-shaped target: stale accounts with withdrawal-dominated flows.
     stale = np.clip(x[:, F.TIME_SINCE_LAST_TX] / 86_400.0, 0, 1)
     wd_dom = (x[:, F.TOTAL_WITHDRAWALS] > x[:, F.TOTAL_DEPOSITS]).astype(np.float32)
@@ -93,7 +92,15 @@ def make_targets(x: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     net_dollars = x[:, F.NET_DEPOSIT] / 100.0
     engagement = 1.0 - 0.5 * stale
     ltv = np.maximum(net_dollars, 0.0) * (1.0 + engagement)
-    return fraud, ltv.astype(np.float32), churn.astype(np.float32)
+    return ltv.astype(np.float32), churn.astype(np.float32)
+
+
+def make_targets(x: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Teacher targets from reference-semantics functions."""
+    xn = np.asarray(normalize(x, ref_compat=True))
+    fraud = np.asarray(mock_predict(xn), dtype=np.float32)
+    ltv, churn = make_aux_targets(x)
+    return fraud, ltv, churn
 
 
 def make_stream(batch_size: int, seed: int = 0) -> Iterator[Batch]:

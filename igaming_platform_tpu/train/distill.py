@@ -48,9 +48,23 @@ def default_teacher(x_raw: np.ndarray) -> np.ndarray:
     return np.asarray(mock_predict(normalize(x_raw, ref_compat=True)))
 
 
-def distill_gbdt(cfg: DistillConfig = DistillConfig(), teacher: Callable | None = None):
-    """Fit the forest to the teacher; returns (params, final_mae)."""
+def distill_gbdt(
+    cfg: DistillConfig = DistillConfig(),
+    teacher: Callable | None = None,
+    data_fn: Callable | None = None,
+):
+    """Fit the forest via soft-split annealing; returns (params, final_mae).
+
+    ``teacher`` maps raw features to targets (default: the mock scorer).
+    ``data_fn(rng, batch_size) -> (x_raw, y)`` overrides the whole batch
+    source — used by train/eval.py to fit on LABELED fraud data with the
+    SAME optimizer/temperature recipe (one copy of the training loop).
+    """
     teacher = teacher or default_teacher
+    if data_fn is None:
+        def data_fn(rng, batch_size):  # noqa: ANN001
+            x_raw = sample_features(rng, batch_size)
+            return x_raw, np.asarray(teacher(x_raw))
     params = init_gbdt(jax.random.key(cfg.seed), n_trees=cfg.n_trees, depth=cfg.depth)
     # Split structure (feat ids) stays fixed; thresholds + leaves train.
     feat = params["feat"]
@@ -72,8 +86,8 @@ def distill_gbdt(cfg: DistillConfig = DistillConfig(), teacher: Callable | None 
 
     rng = np.random.default_rng(cfg.seed)
     for i in range(cfg.steps):
-        x_raw = sample_features(rng, cfg.batch_size)
-        y = jnp.asarray(teacher(x_raw))
+        x_raw, y = data_fn(rng, cfg.batch_size)
+        y = jnp.asarray(y)
         # Model inputs: production normalization + model-side squash.
         xn = standardize_for_model(normalize(x_raw))
         frac = i / max(cfg.steps - 1, 1)
@@ -81,10 +95,12 @@ def distill_gbdt(cfg: DistillConfig = DistillConfig(), teacher: Callable | None 
         trainable, opt_state, _ = step(trainable, opt_state, xn, y, temp)
 
     final = {"feat": feat, **trainable}
-    x_eval = sample_features(np.random.default_rng(cfg.seed + 1), 4096)
+    x_eval, y_eval = data_fn(np.random.default_rng(cfg.seed + 1), 4096)
     from igaming_platform_tpu.models.gbdt import gbdt_predict
 
-    mae = float(jnp.mean(jnp.abs(gbdt_predict(final, standardize_for_model(normalize(x_eval))) - teacher(x_eval))))
+    mae = float(jnp.mean(jnp.abs(
+        gbdt_predict(final, standardize_for_model(normalize(x_eval))) - jnp.asarray(y_eval)
+    )))
     return final, mae
 
 

@@ -1,0 +1,179 @@
+"""Labeled synthetic fraud generator — planted patterns, honest overlap.
+
+The reference declares a training toolchain but ships no data and no
+scripts (/root/reference/Makefile:215-225; services/risk/training/
+absent). Model-quality claims need LABELS, so this generator plants the
+three fraud archetypes the risk rules target, each as a noisy latent
+process rather than a rule-threshold copy:
+
+- **velocity burst** (engine.go's HIGH_VELOCITY family): minutes-scale
+  transaction storms with elevated sums — but with a fraction of bursts
+  below the rule thresholds, so learning beats thresholding;
+- **multi-accounting** (MULTIPLE_DEVICES / MULTIPLE_IPS): device/IP
+  fan-out on young accounts, sometimes paced slowly enough to stay under
+  every velocity rule;
+- **bonus abuse** (BONUS_ABUSE_PATTERN): high claim counts against thin
+  deposits with near-complete wagering and fast withdrawal of winnings.
+
+Clean traffic includes HARD NEGATIVES — legitimate high-rollers (large
+amounts, rule false-positives), device-sharing families, and new players
+— so rules-only and the hand-tuned mock scorer have a real error floor
+and the eval ordering (trained > mock > rules) is earned, not staged.
+
+Returns (x [n,30] raw features, y [n] binary label, kind [n] archetype).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from igaming_platform_tpu.core.features import F, NUM_FEATURES, derive_tx_avg
+
+KIND_CLEAN = 0
+KIND_VELOCITY = 1
+KIND_MULTI_ACCOUNT = 2
+KIND_BONUS_ABUSE = 3
+
+KIND_NAMES = {
+    KIND_CLEAN: "clean",
+    KIND_VELOCITY: "velocity_burst",
+    KIND_MULTI_ACCOUNT: "multi_accounting",
+    KIND_BONUS_ABUSE: "bonus_abuse",
+}
+
+
+def _base_population(rng: np.random.Generator, n: int) -> np.ndarray:
+    """Legitimate-traffic feature process (shared base all kinds mutate)."""
+    x = np.zeros((n, NUM_FEATURES), dtype=np.float32)
+    x[:, F.TX_COUNT_1M] = rng.poisson(1.2, n)
+    x[:, F.TX_COUNT_5M] = x[:, F.TX_COUNT_1M] + rng.poisson(2.0, n)
+    x[:, F.TX_COUNT_1H] = x[:, F.TX_COUNT_5M] + rng.poisson(8.0, n)
+    x[:, F.TX_SUM_1H] = rng.gamma(2.0, 7_000, n)
+    x[:, F.UNIQUE_DEVICES_24H] = 1 + rng.poisson(0.4, n)
+    x[:, F.UNIQUE_IPS_24H] = 1 + rng.poisson(0.8, n)
+    x[:, F.IP_COUNTRY_CHANGES] = rng.poisson(0.05, n)
+    x[:, F.DEVICE_AGE_DAYS] = rng.integers(1, 500, n)
+    x[:, F.ACCOUNT_AGE_DAYS] = rng.integers(0, 800, n)
+    x[:, F.TOTAL_DEPOSITS] = rng.gamma(1.8, 45_000, n)
+    wd = rng.uniform(0.0, 0.85, n)
+    x[:, F.TOTAL_WITHDRAWALS] = x[:, F.TOTAL_DEPOSITS] * wd
+    x[:, F.DEPOSIT_COUNT] = 1 + rng.poisson(6, n)
+    x[:, F.WITHDRAW_COUNT] = rng.poisson(2.5, n)
+    x[:, F.TIME_SINCE_LAST_TX] = rng.integers(120, 86_400 * 3, n)
+    x[:, F.SESSION_DURATION] = rng.integers(30, 10_800, n)
+    x[:, F.AVG_BET_SIZE] = rng.gamma(2.0, 1_200, n)
+    x[:, F.WIN_RATE] = rng.beta(2.2, 3.0, n)
+    x[:, F.IS_VPN] = (rng.random(n) < 0.06).astype(np.float32)
+    x[:, F.IS_PROXY] = (rng.random(n) < 0.02).astype(np.float32)
+    x[:, F.IS_TOR] = (rng.random(n) < 0.004).astype(np.float32)
+    x[:, F.DISPOSABLE_EMAIL] = (rng.random(n) < 0.04).astype(np.float32)
+    x[:, F.BONUS_CLAIM_COUNT] = rng.poisson(0.8, n)
+    x[:, F.BONUS_WAGER_RATE] = rng.beta(2.0, 2.5, n)
+    x[:, F.TX_AMOUNT] = rng.gamma(2.0, 5_500, n)
+    tx_type = rng.integers(0, 3, n)
+    x[:, F.TX_TYPE_DEPOSIT] = tx_type == 0
+    x[:, F.TX_TYPE_WITHDRAW] = tx_type == 1
+    x[:, F.TX_TYPE_BET] = tx_type == 2
+    return x
+
+
+def _harden_negatives(rng: np.random.Generator, x: np.ndarray) -> None:
+    """Plant rule false-positives among the clean rows."""
+    n = x.shape[0]
+    # Legit high-rollers: large single amounts + big hourly sums.
+    hr = rng.random(n) < 0.06
+    x[hr, F.TX_AMOUNT] = rng.gamma(3.0, 90_000, int(hr.sum()))
+    x[hr, F.TX_SUM_1H] = rng.gamma(3.0, 120_000, int(hr.sum()))
+    x[hr, F.TOTAL_DEPOSITS] = rng.gamma(3.0, 400_000, int(hr.sum()))
+    # Device-sharing households / public wifi: several devices or IPs.
+    fam = rng.random(n) < 0.05
+    x[fam, F.UNIQUE_DEVICES_24H] = rng.integers(3, 6, int(fam.sum()))
+    x[fam, F.UNIQUE_IPS_24H] = rng.integers(4, 9, int(fam.sum()))
+    # Brand-new legitimate players.
+    new = rng.random(n) < 0.08
+    x[new, F.ACCOUNT_AGE_DAYS] = rng.integers(0, 7, int(new.sum()))
+
+
+def _plant_velocity(rng: np.random.Generator, x: np.ndarray) -> None:
+    n = x.shape[0]
+    # Burst intensity varies; ~30% stay BELOW the 10-per-minute rule
+    # threshold (slow-burn bots) — learnable from the joint shape, not
+    # from any single cutoff.
+    burst = rng.gamma(2.0, 6.0, n) + 2
+    x[:, F.TX_COUNT_1M] = burst
+    x[:, F.TX_COUNT_5M] = burst * rng.uniform(2.0, 4.0, n)
+    x[:, F.TX_COUNT_1H] = x[:, F.TX_COUNT_5M] * rng.uniform(3.0, 8.0, n)
+    x[:, F.TX_SUM_1H] = rng.gamma(2.5, 45_000, n)
+    x[:, F.TIME_SINCE_LAST_TX] = rng.integers(1, 240, n)
+    x[:, F.SESSION_DURATION] = rng.integers(600, 28_800, n)
+    x[:, F.TX_AMOUNT] = rng.gamma(2.0, 18_000, n)
+    # Stolen-card cashout shape: deposits recent, withdrawals aggressive.
+    x[:, F.TOTAL_WITHDRAWALS] = x[:, F.TOTAL_DEPOSITS] * rng.uniform(0.6, 1.3, n)
+
+
+def _plant_multi_account(rng: np.random.Generator, x: np.ndarray) -> None:
+    n = x.shape[0]
+    x[:, F.UNIQUE_DEVICES_24H] = rng.integers(2, 12, n)
+    x[:, F.UNIQUE_IPS_24H] = rng.integers(3, 18, n)
+    x[:, F.IP_COUNTRY_CHANGES] = rng.poisson(1.5, n)
+    x[:, F.ACCOUNT_AGE_DAYS] = rng.integers(0, 30, n)
+    x[:, F.DEVICE_AGE_DAYS] = rng.integers(0, 20, n)
+    x[:, F.IS_VPN] = (rng.random(n) < 0.45).astype(np.float32)
+    x[:, F.IS_PROXY] = (rng.random(n) < 0.25).astype(np.float32)
+    x[:, F.DISPOSABLE_EMAIL] = (rng.random(n) < 0.5).astype(np.float32)
+    # Paced to dodge velocity rules: NORMAL transaction tempo — resampled
+    # consistently across all three windows (1m <= 5m <= 1h must hold, or
+    # the impossible combination itself becomes a label leak).
+    x[:, F.TX_COUNT_1M] = rng.poisson(1.5, n)
+    x[:, F.TX_COUNT_5M] = x[:, F.TX_COUNT_1M] + rng.poisson(2.0, n)
+    x[:, F.TX_COUNT_1H] = x[:, F.TX_COUNT_5M] + rng.poisson(8.0, n)
+    x[:, F.TOTAL_DEPOSITS] = rng.gamma(1.5, 12_000, n)
+
+
+def _plant_bonus_abuse(rng: np.random.Generator, x: np.ndarray) -> None:
+    n = x.shape[0]
+    x[:, F.BONUS_CLAIM_COUNT] = rng.integers(3, 15, n)
+    x[:, F.BONUS_WAGER_RATE] = rng.beta(8, 1.5, n)  # grind to completion
+    x[:, F.TOTAL_DEPOSITS] = rng.gamma(1.2, 3_000, n)  # thin real money
+    x[:, F.TOTAL_WITHDRAWALS] = x[:, F.TOTAL_DEPOSITS] * rng.uniform(0.8, 2.5, n)
+    x[:, F.AVG_BET_SIZE] = rng.gamma(1.5, 300, n)  # min-bet grinding
+    x[:, F.WIN_RATE] = rng.beta(4, 3, n)
+    x[:, F.ACCOUNT_AGE_DAYS] = rng.integers(0, 60, n)
+    x[:, F.DISPOSABLE_EMAIL] = (rng.random(n) < 0.4).astype(np.float32)
+    x[:, F.UNIQUE_DEVICES_24H] = rng.integers(1, 5, n)
+
+
+def generate_labeled(
+    rng: np.random.Generator, n: int, fraud_rate: float = 0.12
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """n rows; fraud split evenly across the three archetypes."""
+    x = _base_population(rng, n)
+    kind = np.zeros(n, dtype=np.int32)
+    u = rng.random(n)
+    third = fraud_rate / 3.0
+    kind[u < third] = KIND_VELOCITY
+    kind[(u >= third) & (u < 2 * third)] = KIND_MULTI_ACCOUNT
+    kind[(u >= 2 * third) & (u < fraud_rate)] = KIND_BONUS_ABUSE
+
+    clean = kind == KIND_CLEAN
+    # Hard negatives mutate a view of the clean subset in place.
+    xc = x[clean]
+    _harden_negatives(rng, xc)
+    x[clean] = xc
+    for k, planter in (
+        (KIND_VELOCITY, _plant_velocity),
+        (KIND_MULTI_ACCOUNT, _plant_multi_account),
+        (KIND_BONUS_ABUSE, _plant_bonus_abuse),
+    ):
+        m = kind == k
+        if m.any():
+            xk = x[m]
+            planter(rng, xk)
+            x[m] = xk
+
+    x[:, F.NET_DEPOSIT] = x[:, F.TOTAL_DEPOSITS] - x[:, F.TOTAL_WITHDRAWALS]
+    x[:, F.BONUS_ONLY_PLAYER] = (
+        (x[:, F.BONUS_CLAIM_COUNT] > 3) & (x[:, F.TOTAL_DEPOSITS] < 5000)
+    ).astype(np.float32)
+    derive_tx_avg(x)
+    return x, (kind > 0).astype(np.float32), kind

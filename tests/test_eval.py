@@ -1,0 +1,56 @@
+"""Model-quality eval: labeled fraud generator + metric math + ordering."""
+
+import numpy as np
+import pytest
+
+from igaming_platform_tpu.train.eval import (
+    average_precision,
+    expected_calibration_error,
+    roc_auc,
+    run_eval,
+)
+from igaming_platform_tpu.train.fraudgen import generate_labeled
+
+
+def test_metric_math_known_values():
+    y = np.array([0, 0, 1, 1], dtype=np.float32)
+    p_perfect = np.array([0.1, 0.2, 0.8, 0.9])
+    p_anti = 1.0 - p_perfect
+    assert roc_auc(y, p_perfect) == 1.0
+    assert roc_auc(y, p_anti) == 0.0
+    assert roc_auc(y, np.full(4, 0.5)) == 0.5  # ties -> chance
+    assert average_precision(y, p_perfect) == 1.0
+    # Perfectly calibrated: predicted prob == observed rate per bin.
+    y2 = np.array([0, 1] * 50, dtype=np.float32)
+    assert expected_calibration_error(y2, np.full(100, 0.5)) < 1e-9
+    assert expected_calibration_error(y2, np.full(100, 0.95)) > 0.4
+
+
+def test_generator_plants_separable_but_overlapping_patterns():
+    rng = np.random.default_rng(0)
+    x, y, kind = generate_labeled(rng, 20_000, fraud_rate=0.12)
+    assert x.shape == (20_000, 30)
+    rate = float(y.mean())
+    assert 0.10 < rate < 0.14
+    # All three archetypes present in meaningful numbers.
+    for k in (1, 2, 3):
+        assert (kind == k).sum() > 300
+    # Patterns are real (fraud velocity higher on average)...
+    from igaming_platform_tpu.core.features import F
+
+    assert x[kind == 1, F.TX_COUNT_1M].mean() > 3 * x[kind == 0, F.TX_COUNT_1M].mean()
+    # ...but overlapping: some clean rows exceed some velocity-fraud rows
+    # (hard negatives), so thresholding alone cannot be perfect.
+    assert (x[kind == 0, F.TX_SUM_1H].max() > np.percentile(x[kind == 1, F.TX_SUM_1H], 50))
+
+
+def test_eval_ordering_trained_beats_mock_beats_rules():
+    """The committed EVAL.json claim, reproduced at small scale: learning
+    on labels beats the hand-tuned mock, which beats bare rules."""
+    r = run_eval(n_train=8_000, n_test=4_000, steps=100, seed=3)
+    m = r["models"]
+    assert m["mock"]["auc"] > m["rules_only"]["auc"]
+    assert m["multitask_trained"]["auc"] > m["mock"]["auc"] + 0.015
+    assert m["gbdt_trained"]["auc"] > m["mock"]["auc"] + 0.015
+    assert m["multitask_trained"]["average_precision"] > m["mock"]["average_precision"]
+    assert r["ordering"]["trained_beats_mock"]
