@@ -136,12 +136,42 @@ def config3_sequence_throughput(batch: int = 64, seq_len: int = 256, iters: int 
         out = fn(params, x)
     jax.block_until_ready(out)
     elapsed = time.perf_counter() - t0
+
+    # Long-context point: S=2048 event histories through the Pallas
+    # flash-attention core (BASELINE config 3's long-sequence story) —
+    # smaller batch, same model. Reported alongside the short-seq figure.
+    long_s = 2048
+    long_batch = max(8, batch // 8)
+    x_long = np.random.default_rng(1).normal(
+        size=(long_batch, long_s, EVENT_DIM)
+    ).astype(np.float32)
+    jax.block_until_ready(fn(params, x_long))
+    t0 = time.perf_counter()
+    long_iters = max(5, iters // 4)
+    for _ in range(long_iters):
+        out = fn(params, x_long)
+    jax.block_until_ready(out)
+    long_elapsed = time.perf_counter() - t0
+
+    from igaming_platform_tpu.ops.pallas.flash_attention import supports as flash_supports
+
     return {
         "metric": "abuse_sequences_per_sec",
         "value": round(batch * iters / elapsed, 1),
         "unit": "seq/s",
         "seq_len": seq_len,
         "batch": batch,
+        "long_seq_len": long_s,
+        "long_batch": long_batch,
+        "long_sequences_per_sec": round(long_batch * long_iters / long_elapsed, 1),
+        "long_tokens_per_sec": round(long_batch * long_s * long_iters / long_elapsed, 1),
+        # True only when the Pallas kernel actually ran: dispatch also
+        # gates on the TPU backend (sequence.py takes the XLA einsum path
+        # elsewhere), so a CPU run must not attribute its number to flash.
+        "flash_kernel": bool(
+            jax.default_backend() == "tpu"
+            and flash_supports((long_s, cfg.d_model // cfg.n_heads))
+        ),
     }
 
 

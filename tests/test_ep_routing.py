@@ -1,0 +1,147 @@
+"""Routed EP ensemble: all-to-all dispatch parity vs the dense reference.
+
+Runs on the virtual 8-device CPU mesh (conftest) with a real ``expert``
+axis — the all_to_all / psum / switch collectives execute, not just
+compile. SURVEY.md §2.3 EP row.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from igaming_platform_tpu.core.features import NUM_FEATURES, normalize, standardize_for_model
+from igaming_platform_tpu.parallel.ep import (
+    dense_reference,
+    gate_probs,
+    init_router,
+    routed_ensemble_forward,
+)
+from igaming_platform_tpu.parallel.mesh import MeshSpec, create_mesh
+
+
+def _mesh(n_experts: int):
+    return create_mesh(MeshSpec(expert=n_experts), devices=jax.devices()[:n_experts])
+
+
+def _toy_experts(n: int):
+    """n distinct cheap scorers: sigmoid of different feature projections —
+    distinguishable outputs so routing mistakes can't hide."""
+    fns = []
+    params = []
+    for i in range(n):
+        w = np.zeros(NUM_FEATURES, np.float32)
+        w[i % NUM_FEATURES] = 1.0
+        w[(i * 7 + 3) % NUM_FEATURES] = -0.5
+        params.append(jnp.asarray(w))
+        fns.append(lambda p, x: jax.nn.sigmoid(x @ p))
+    return fns, tuple(params)
+
+
+def test_routed_matches_dense_when_capacity_suffices():
+    n_experts = 4
+    mesh = _mesh(n_experts)
+    fns, params = _toy_experts(n_experts)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, NUM_FEATURES)).astype(np.float32)
+    router_w = init_router(jax.random.key(1), NUM_FEATURES, n_experts)
+
+    out = routed_ensemble_forward(
+        router_w, params, x, mesh=mesh, expert_fns=fns, k=2, capacity_factor=4.0,
+    )
+    ref = dense_reference(router_w, params, x, expert_fns=fns, k=2)
+    assert int(out["dropped"]) == 0
+    np.testing.assert_allclose(np.asarray(out["prob"]), np.asarray(ref), atol=1e-5)
+    # Every routed row landed on exactly k experts.
+    assert float(out["load"].sum()) == 64 * 2
+
+
+def test_capacity_drops_renormalize_not_zero():
+    """Overflowed picks drop; surviving gate weights renormalize, so a
+    row that kept only its top-1 expert still gets that expert's score
+    at full weight."""
+    n_experts = 2
+    mesh = _mesh(n_experts)
+    fns, params = _toy_experts(n_experts)
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(32, NUM_FEATURES)).astype(np.float32)
+    # Router heavily biased to expert 0: its buffer overflows at low cap.
+    router_w = np.zeros((NUM_FEATURES, n_experts), np.float32)
+    router_w[:, 0] = 0.3
+
+    out = routed_ensemble_forward(
+        jnp.asarray(router_w), params, x, mesh=mesh, expert_fns=fns,
+        k=2, capacity_factor=0.5,
+    )
+    assert int(out["dropped"]) > 0
+    prob = np.asarray(out["prob"])
+    assert np.isfinite(prob).all()
+    assert (prob >= 0).all() and (prob <= 1).all()
+
+
+def test_routed_under_jit_compiles_once_and_matches():
+    n_experts = 8
+    mesh = _mesh(n_experts)
+    fns, params = _toy_experts(n_experts)
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(128, NUM_FEATURES)).astype(np.float32)
+    router_w = init_router(jax.random.key(3), NUM_FEATURES, n_experts)
+
+    fwd = jax.jit(
+        lambda w, p, xx: routed_ensemble_forward(
+            w, p, xx, mesh=mesh, expert_fns=fns, k=2, capacity_factor=4.0
+        )["prob"]
+    )
+    got = fwd(router_w, params, x)
+    ref = dense_reference(router_w, params, x, expert_fns=fns, k=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+
+def test_heterogeneous_scorer_experts():
+    """The actual ensemble story: mock heuristic, MLP, GBDT, multitask as
+    the four experts — routed output matches the dense mix of the same
+    real scorers."""
+    from igaming_platform_tpu.models.gbdt import gbdt_predict, init_gbdt
+    from igaming_platform_tpu.models.mlp import init_mlp, mlp_predict
+    from igaming_platform_tpu.models.mock_model import mock_predict
+    from igaming_platform_tpu.models.multitask import fraud_predict, init_multitask
+
+    n_experts = 4
+    mesh = _mesh(n_experts)
+
+    def prep(x):
+        return standardize_for_model(normalize(x))
+
+    fns = [
+        lambda p, x: mock_predict(normalize(x, ref_compat=True)),
+        lambda p, x: mlp_predict(p, prep(x)),
+        lambda p, x: gbdt_predict(p, prep(x)),
+        lambda p, x: fraud_predict(p, prep(x)),
+    ]
+    params = (
+        None,
+        init_mlp(jax.random.key(0), hidden=(32, 32)),
+        init_gbdt(jax.random.key(1), n_trees=8, depth=3),
+        init_multitask(jax.random.key(2), trunk=(32, 32)),
+    )
+    from igaming_platform_tpu.train.data import sample_features
+
+    x = sample_features(np.random.default_rng(5), 64)
+    router_w = init_router(jax.random.key(4), NUM_FEATURES, n_experts, scale=0.01)
+
+    out = routed_ensemble_forward(
+        router_w, params, x, mesh=mesh, expert_fns=fns, k=2, capacity_factor=4.0,
+    )
+    ref = dense_reference(router_w, params, x, expert_fns=fns, k=2)
+    assert int(out["dropped"]) == 0
+    np.testing.assert_allclose(np.asarray(out["prob"]), np.asarray(ref), atol=1e-4)
+    probs = np.asarray(out["prob"])
+    assert (probs >= 0).all() and (probs <= 1).all()
+    assert probs.std() > 0.0  # nontrivial outputs
+
+
+def test_gate_probs_normalized():
+    w = init_router(jax.random.key(0), NUM_FEATURES, 4)
+    x = np.random.default_rng(0).normal(size=(16, NUM_FEATURES)).astype(np.float32)
+    g = np.asarray(gate_probs(w, x))
+    np.testing.assert_allclose(g.sum(-1), 1.0, atol=1e-5)
