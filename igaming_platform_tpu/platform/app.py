@@ -35,7 +35,7 @@ from igaming_platform_tpu.platform.risk_adapter import InProcessRiskGate
 from igaming_platform_tpu.platform.wallet import WalletConfig, WalletService
 from igaming_platform_tpu.serve.abuse import SequenceAbuseDetector
 from igaming_platform_tpu.serve.bridge import ScoringBridge
-from igaming_platform_tpu.serve.events import Consumer, DeliveryDeduper, Event, default_broker
+from igaming_platform_tpu.serve.events import Consumer, Event, best_deduper, default_broker
 from igaming_platform_tpu.serve.scorer import TPUScoringEngine
 
 DEFAULT_RULES = "igaming_platform_tpu/platform/configs/bonus_rules.yaml"
@@ -99,8 +99,17 @@ class PlatformApp:
 
         # Bonus: abuse gate via the sequence detector, player data from the
         # feature store.
+        # Durable wagering progress when the store is durable — the claim
+        # (below) and the progress must live in the SAME store, or a
+        # crash leaves a persistent claim guarding volatile state.
+        bonus_repo = None
+        if self.store is not None:
+            from igaming_platform_tpu.platform.bonus import SQLiteBonusRepository
+
+            bonus_repo = SQLiteBonusRepository(self.store)
         self.bonus = BonusEngine(
             self.config.bonus_rules_path,
+            repo=bonus_repo,
             risk_checker=self.abuse.is_abuser,
             player_data=self._player_info,
         )
@@ -108,8 +117,10 @@ class PlatformApp:
         self._bonus_consumer.subscribe(QUEUE_BONUS_PROCESSOR, self._on_wallet_event)
         # The outbox relay redelivers on crash-between-publish-and-mark;
         # process_wager is NOT idempotent (progress accumulates), so the
-        # bonus processor dedupes on envelope id like the scoring bridge.
-        self._wager_dedupe = DeliveryDeduper()
+        # bonus processor dedupes on envelope id — DURABLY when the store
+        # is durable: an in-memory claim set dies with the process at the
+        # exact moment the relay redelivers everything in flight.
+        self._wager_dedupe = best_deduper(self.store)
 
     # -- wiring --------------------------------------------------------------
 
@@ -137,21 +148,30 @@ class PlatformApp:
             return
         # Atomic claim/release: a claim taken before the side effect stops
         # both redeliveries AND concurrent duplicate deliveries from
-        # double-counting; releasing on failure keeps the consumer's
-        # nack+requeue retry path alive. Events without an id can't be
-        # deduped — process them unconditionally (bridge.py does the same).
+        # double-counting. With a durable store, the claim AND the
+        # wagering progress commit in ONE unit of work — a crash between
+        # them can neither double-apply (claim persisted with progress)
+        # nor silently consume the event (claim rolls back with the
+        # progress, so the redelivery retries). Events without an id
+        # can't be deduped — processed unconditionally (bridge.py same).
+        account_id = str(event.data.get("account_id", ""))
+        amount = int(event.data.get("amount", 0))
+        # The event carries the bet's real game_category (wallet.py
+        # event_extra); an absent/empty value hits the bonus engine's
+        # default-weight path rather than masquerading as slots.
+        category = str(event.data.get("game_category", ""))
+        uow = getattr(self.store, "unit_of_work", None) if self.store is not None else None
+        if uow is not None:
+            with uow():
+                if event.id and not self._wager_dedupe.claim(event.id):
+                    return
+                self.bonus.process_wager(account_id, amount, category)
+            return
         claimed = bool(event.id) and self._wager_dedupe.claim(event.id)
         if event.id and not claimed:
             return
-        account_id = str(event.data.get("account_id", ""))
-        amount = int(event.data.get("amount", 0))
         try:
-            # The event carries the bet's real game_category (wallet.py
-            # event_extra); an absent/empty value hits the bonus engine's
-            # default-weight path rather than masquerading as slots.
-            self.bonus.process_wager(
-                account_id, amount, str(event.data.get("game_category", ""))
-            )
+            self.bonus.process_wager(account_id, amount, category)
         except BaseException:
             if claimed:
                 self._wager_dedupe.release(event.id)

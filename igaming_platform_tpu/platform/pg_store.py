@@ -33,6 +33,7 @@ from igaming_platform_tpu.platform.pgwire import (
     PgError,
 )
 from igaming_platform_tpu.platform.repository import (
+    DedupeStoreMixin,
     _SQLiteAccounts,
     _SQLiteLedger,
     _SQLiteTransactions,
@@ -98,6 +99,10 @@ CREATE TABLE IF NOT EXISTS audit_log (
     action TEXT NOT NULL,
     old_value TEXT,
     new_value TEXT,
+    created_at DOUBLE PRECISION NOT NULL
+);
+CREATE TABLE IF NOT EXISTS processed_deliveries (
+    event_id TEXT PRIMARY KEY,
     created_at DOUBLE PRECISION NOT NULL
 );
 """
@@ -180,7 +185,7 @@ class _PgTransactions(_SQLiteTransactions):
         return [self._row_to_tx(r) for r in rows]
 
 
-class PostgresStore:
+class PostgresStore(DedupeStoreMixin):
     """Same surface as SQLiteStore over a real PostgreSQL."""
 
     def __init__(self, url: str, *, bootstrap: bool = True):
@@ -287,3 +292,15 @@ class PostgresStore:
             )
             self._commit()
             return cur.rowcount
+
+    # -- durable delivery dedupe (release/purge from DedupeStoreMixin) -------
+
+    def dedupe_claim(self, event_id: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "INSERT INTO processed_deliveries (event_id, created_at)"
+                " VALUES (?, ?) ON CONFLICT (event_id) DO NOTHING",
+                (event_id, time.time()),
+            )
+            self._commit()
+            return cur.rowcount == 1

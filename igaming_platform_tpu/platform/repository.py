@@ -224,6 +224,30 @@ class InMemoryLedgerRepository:
         return self.get_account_balance(account_id) == recorded_balance
 
 
+class DedupeStoreMixin:
+    """release/purge halves of the durable-dedupe contract — identical SQL
+    on every backend; only the claim INSERT is dialect-specific."""
+
+    def dedupe_release(self, event_id: str) -> None:
+        """Undo a claim whose handler failed (the retry must not be
+        misread as a duplicate)."""
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM processed_deliveries WHERE event_id = ?", (event_id,)
+            )
+            self._commit()
+
+    def dedupe_purge(self, older_than_s: float = 7 * 86400.0) -> int:
+        """Drop claims past the redelivery horizon (bounded table)."""
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM processed_deliveries WHERE created_at < ?",
+                (time.time() - older_than_s,),
+            )
+            self._commit()
+            return cur.rowcount
+
+
 def store_of(repo):
     """The transactional store backing a repository view, or None.
 
@@ -308,10 +332,17 @@ CREATE TABLE IF NOT EXISTS audit_log (
     new_value TEXT,
     created_at REAL NOT NULL
 );
+-- Durable at-least-once dedupe: consumer claims on envelope id survive
+-- process restart (the in-memory DeliveryDeduper forgets on crash,
+-- exactly when the outbox relay redelivers).
+CREATE TABLE IF NOT EXISTS processed_deliveries (
+    event_id TEXT PRIMARY KEY,
+    created_at REAL NOT NULL
+);
 """
 
 
-class SQLiteStore:
+class SQLiteStore(DedupeStoreMixin):
     """One connection-per-store with the full schema (init-db.sql analog).
 
     Exposes the three repository views plus the transactional outbox
@@ -409,6 +440,21 @@ class SQLiteStore:
             )
             self._commit()
             return cur.rowcount
+
+    # -- durable delivery dedupe (events.StoreDeliveryDeduper backend) -------
+
+    def dedupe_claim(self, event_id: str) -> bool:
+        """Atomically claim an envelope id; False if already claimed —
+        including by a previous incarnation of this process. Inside a
+        unit_of_work the claim commits WITH the handler's effect."""
+        with self._lock:
+            cur = self._conn.execute(
+                "INSERT OR IGNORE INTO processed_deliveries (event_id, created_at)"
+                " VALUES (?, ?)",
+                (event_id, time.time()),
+            )
+            self._commit()
+            return cur.rowcount == 1
 
 
 class _SQLiteAccounts:

@@ -437,3 +437,45 @@ def resolve_transport(broker, rabbitmq_url: str):
             f"unknown EVENT_TRANSPORT {mode!r}: expected 'memory' or 'amqp'"
         )
     return default_broker()
+
+
+class StoreDeliveryDeduper:
+    """DeliveryDeduper persisted in the transactional store.
+
+    The in-memory deduper's claims die with the process — exactly the
+    moment the outbox relay redelivers everything in flight, so a
+    crash-restart could double-apply non-idempotent handlers (wagering
+    progress). Backing the claims by the store of record
+    (processed_deliveries table; SQLiteStore / PostgresStore both
+    implement the claim/release/purge contract) makes the at-least-once
+    dedupe hold across restarts AND across replicas sharing the store.
+    """
+
+    def __init__(self, store, purge_every: int = 4096,
+                 retention_s: float = 7 * 86400.0):
+        self._store = store
+        self._retention_s = retention_s
+        self._purge_every = purge_every
+        self._ops = 0
+
+    def claim(self, event_id: str) -> bool:
+        self._ops += 1
+        if self._ops % self._purge_every == 0:
+            try:
+                self._store.dedupe_purge(self._retention_s)
+            except Exception:  # noqa: BLE001 — purge is best-effort
+                pass
+        return self._store.dedupe_claim(event_id)
+
+    def release(self, event_id: str) -> None:
+        self._store.dedupe_release(event_id)
+
+    def is_duplicate(self, event_id: str) -> bool:
+        return not self.claim(event_id)
+
+
+def best_deduper(store=None) -> "StoreDeliveryDeduper | DeliveryDeduper":
+    """Store-backed dedupe when a durable store exists, in-memory else."""
+    if store is not None and hasattr(store, "dedupe_claim"):
+        return StoreDeliveryDeduper(store)
+    return DeliveryDeduper()

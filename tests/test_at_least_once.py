@@ -203,3 +203,80 @@ def test_audit_inside_uow_joins_the_transaction():
     n_outbox = store._conn.execute("SELECT COUNT(*) FROM event_outbox").fetchone()[0]
     n_audit = store._conn.execute("SELECT COUNT(*) FROM audit_log").fetchone()[0]
     assert n_outbox == 0 and n_audit == 0
+
+
+def test_store_deduper_survives_restart(tmp_path):
+    """Claims persist in the store: a redelivery after process death is
+    still recognized as a duplicate (the in-memory deduper's blind spot)."""
+    from igaming_platform_tpu.platform.repository import SQLiteStore
+    from igaming_platform_tpu.serve.events import StoreDeliveryDeduper, best_deduper
+
+    db = str(tmp_path / "wallet.db")
+    store = SQLiteStore(db)
+    d = best_deduper(store)
+    assert isinstance(d, StoreDeliveryDeduper)
+    assert d.claim("ev-1") is True
+    assert d.claim("ev-1") is False      # duplicate in-process
+    assert d.claim("ev-2") is True
+    d.release("ev-2")                    # handler failed: retry allowed
+    assert d.claim("ev-2") is True
+    store.close()
+
+    # "Restart": fresh store over the same file.
+    store2 = SQLiteStore(db)
+    d2 = StoreDeliveryDeduper(store2)
+    assert d2.claim("ev-1") is False     # still claimed across restart
+    assert d2.claim("ev-2") is False
+    assert d2.claim("ev-3") is True
+    assert store2.dedupe_purge(older_than_s=0.0) >= 3  # purge drops them
+    assert d2.claim("ev-1") is True
+    store2.close()
+
+
+def test_best_deduper_falls_back_in_memory():
+    from igaming_platform_tpu.serve.events import DeliveryDeduper, best_deduper
+
+    d = best_deduper(None)
+    assert isinstance(d, DeliveryDeduper)
+
+
+def test_wager_claim_and_progress_commit_atomically(tmp_path):
+    """Durable path: a handler failure rolls the claim back WITH the
+    wagering progress (retry still possible); success commits both, so a
+    post-commit redelivery is a no-op. Neither double-apply nor silent
+    loss across the crash window."""
+    from igaming_platform_tpu.platform.app import AppConfig, PlatformApp
+    from igaming_platform_tpu.serve.events import Event
+
+    app = PlatformApp(AppConfig(sqlite_path=str(tmp_path / "p.db"), batch_size=8))
+    try:
+        acct = app.wallet.create_account("atomic-p1")
+        app.deposit(acct.id, 20_000, "dep-1")
+        bonus = app.bonus.award_bonus(acct.id, "welcome_bonus_100", deposit_amount=20_000)
+        before = app.bonus.repo.get_active_by_account(acct.id)[0].wagering_progress
+
+        ev = Event(type="transaction.completed",
+                   data={"type": "bet", "account_id": acct.id, "amount": 500,
+                         "game_category": "slots"})
+
+        # Simulated crash inside the handler: claim must roll back too.
+        orig = app.bonus.process_wager
+        app.bonus.process_wager = lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("crash mid-handler"))
+        try:
+            with __import__("pytest").raises(RuntimeError):
+                app._on_wallet_event(ev)
+        finally:
+            app.bonus.process_wager = orig
+        assert app.store.dedupe_claim(ev.id) is True  # claim was rolled back
+        app.store.dedupe_release(ev.id)
+
+        # Successful delivery applies progress and persists the claim.
+        app._on_wallet_event(ev)
+        mid = app.bonus.repo.get_active_by_account(acct.id)[0].wagering_progress
+        assert mid == before + 500
+        # Redelivery of the same envelope: no double-count.
+        app._on_wallet_event(ev)
+        assert app.bonus.repo.get_active_by_account(acct.id)[0].wagering_progress == mid
+    finally:
+        app.close()
