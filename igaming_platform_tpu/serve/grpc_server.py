@@ -213,6 +213,10 @@ class RiskGrpcService:
             and hasattr(getattr(engine, "features", None), "decode_gather")
         ):
             self.raw_request_methods = ("ScoreBatch",)
+        if hasattr(engine, "score_observer"):
+            # Batch paths feed the score-distribution histogram vectorized
+            # (per-row observe() would be a Python loop on the hot path).
+            engine.score_observer = self.metrics.score_distribution.observe_many
 
     # -- scoring --
 
@@ -314,6 +318,9 @@ class RiskGrpcService:
         reqs = [self._request_from_proto(t) for t in txs]
         responses = self.engine.score_batch(reqs)
         self.metrics.txns_scored_total.inc(len(responses))
+        # Metric parity with the fast path: the per-row fallback feeds the
+        # score histogram too (WIRE_FAST_PATH=0 must not flatline it).
+        self.metrics.score_distribution.observe_many([r.score for r in responses])
         return risk_pb2.ScoreBatchResponse(results=[self._score_to_proto(r) for r in responses])
 
     # -- LTV --

@@ -125,6 +125,10 @@ class TPUScoringEngine:
         bcfg = batcher_config or BatcherConfig()
         self.batch_size = bcfg.batch_size
         self._pipeline_depth = max(1, bcfg.pipeline_depth)
+        # Optional batch-scores hook (set by the gRPC layer): the wire
+        # fast path never materializes per-row response objects, so the
+        # score-distribution histogram is fed vectorized from here.
+        self.score_observer: Any = None
         # Compiled shape ladder: the throughput shape plus smaller latency
         # tiers (VERDICT r02 item 1 — a single-txn flush must not pay the
         # full-shape H2D + step + readback). jax.jit compiles one
@@ -479,6 +483,18 @@ class TPUScoringEngine:
             read_one()
 
         cat = {k: np.concatenate(v) if len(v) > 1 else v[0] for k, v in parts.items()}
+        if self.score_observer is not None:
+            try:
+                self.score_observer(cat["score"])
+            except Exception:  # noqa: BLE001 — metrics must not fail scoring
+                if not getattr(self, "_observer_warned", False):
+                    self._observer_warned = True
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "score_observer failed; score histogram will be "
+                        "empty for wire batches", exc_info=True,
+                    )
         return encode_score_batch(
             cat["score"], cat["action"], cat["reason_mask"], cat["rule_score"],
             cat["ml_score"], rtms, x if include_features else None,

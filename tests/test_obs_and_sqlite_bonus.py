@@ -208,3 +208,60 @@ def test_grafana_dashboards_are_valid_and_reference_real_series():
                         continue
                     if re.fullmatch(r"(risk|wallet)_[a-z0-9_]+", name):
                         assert name in valid, f"{path.name}: unknown series {name}"
+
+
+def test_histogram_observe_many_matches_scalar_observe():
+    import numpy as np
+
+    from igaming_platform_tpu.obs.metrics import Histogram
+
+    buckets = (10, 25, 50, 75, 90, 100)
+    h1 = Histogram("a", buckets=buckets)
+    h2 = Histogram("b", buckets=buckets)
+    vals = np.random.default_rng(0).integers(0, 101, 500)
+    for v in vals:
+        h1.observe(float(v))
+    h2.observe_many(vals)
+    assert h1._counts[()] == h2._counts[()]
+    assert h1._totals[()] == h2._totals[()]
+    assert abs(h1._sums[()] - h2._sums[()]) < 1e-6
+    h2.observe_many([])  # no-op
+
+
+def test_wire_batch_feeds_score_distribution():
+    """The raw ScoreBatch path records the score histogram (the per-row
+    proto path's metric parity)."""
+    import grpc
+    import pytest as _pytest
+
+    from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
+    from igaming_platform_tpu.proto_gen.risk.v1 import risk_pb2
+    from igaming_platform_tpu.serve import native_store
+    from igaming_platform_tpu.serve.grpc_server import RiskGrpcService, serve_risk
+    from igaming_platform_tpu.serve.scorer import TPUScoringEngine
+
+    if not native_store.native_available():
+        _pytest.skip("native feature store unavailable")
+    engine = TPUScoringEngine(
+        ScoringConfig(), batcher_config=BatcherConfig(batch_size=32, max_wait_ms=1.0),
+        feature_store=native_store.NativeFeatureStore(),
+    )
+    service = RiskGrpcService(engine)
+    server, health, port = serve_risk(service, 0)
+    try:
+        ch = grpc.insecure_channel(f"localhost:{port}")
+        call = ch.unary_unary(
+            "/risk.v1.RiskService/ScoreBatch",
+            request_serializer=risk_pb2.ScoreBatchRequest.SerializeToString,
+            response_deserializer=risk_pb2.ScoreBatchResponse.FromString,
+        )
+        txs = [risk_pb2.ScoreTransactionRequest(account_id=f"h-{i}", amount=100 + i)
+               for i in range(20)]
+        call(risk_pb2.ScoreBatchRequest(transactions=txs), timeout=30)
+        # Both routes must feed the histogram — raw native path (when the
+        # codec built) and the per-row fallback alike.
+        assert service.metrics.score_distribution.count() == 20
+        ch.close()
+    finally:
+        server.stop(0)
+        engine.close()
