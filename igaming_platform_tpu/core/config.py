@@ -143,6 +143,14 @@ class RiskServiceConfig:
     # Sequence-parallel axis for the abuse detector (ring attention over
     # `seq`); must divide mesh_devices. 1 = no sequence sharding.
     mesh_seq: int = 1
+    # Expert-parallel axis for the routed ensemble (ml_backend="routed"):
+    # 4 shards the mock/MLP/GBDT/multitask experts one per shard with
+    # all-to-all sub-batch routing. 1 = no expert sharding.
+    mesh_expert: int = 1
+    # Override the serving ML backend (default: multitask when a
+    # checkpoint loads, else mock). "routed" additionally needs params
+    # carrying router/mlp/gbdt/multitask.
+    ml_backend: str = ""
     scoring: ScoringConfig = field(default_factory=ScoringConfig)
     batcher: BatcherConfig = field(default_factory=BatcherConfig)
 
@@ -166,6 +174,8 @@ class RiskServiceConfig:
             feature_store=getenv_str("FEATURE_STORE", d.feature_store),
             mesh_devices=getenv_int("MESH_DEVICES", d.mesh_devices),
             mesh_seq=getenv_int("MESH_SEQ", d.mesh_seq),
+            mesh_expert=getenv_int("MESH_EXPERT", d.mesh_expert),
+            ml_backend=getenv_str("ML_BACKEND", d.ml_backend),
             scoring=ScoringConfig.from_env(),
             batcher=BatcherConfig(
                 batch_size=getenv_int("BATCH_SIZE", 256),

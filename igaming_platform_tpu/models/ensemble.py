@@ -7,11 +7,14 @@ action (:301-310). The reference crosses the CGo boundary per sample; here
 the entire pipeline is ONE jittable function over a [B, 30] batch — the
 goroutine fan-out of engine.go:331-409 becomes XLA fusion.
 
-Expert routing note (SURVEY.md §2.3 EP): the ensemble members (rule scorer,
-mock/MLP/GBDT) are the framework's "experts". At this model scale all
-experts run on every row (dense routing — cheaper than all-to-all for
-30-dim features); the `expert` mesh axis becomes load-bearing for the
-sequence-model ensemble in models/sequence.py.
+Expert routing note (SURVEY.md §2.3 EP): the ensemble members (mock
+heuristic, MLP, GBDT, multitask) are the framework's "experts". The
+default backends run one expert densely on every row (cheaper than
+all-to-all for 30-dim features); ``ml_backend="routed"`` runs the full
+expert set as a routed mixture — a learned top-k router, all-to-all
+sub-batch dispatch over the ``expert`` mesh axis, each shard executing
+only its own expert (parallel/ep.py) — with an unsharded dense fallback
+when no expert mesh is present.
 """
 
 from __future__ import annotations
@@ -77,9 +80,53 @@ def combine(
     return final, action, reason_mask
 
 
+def routed_experts() -> tuple[list, tuple[str, ...]]:
+    """The ensemble's expert set for ``ml_backend="routed"``: each fn maps
+    (params_i, RAW [B,30]) -> [B] probability, handling its own
+    normalization (the mock was tuned against ref-compat normalize; the
+    trained experts use the production pipeline)."""
+    from igaming_platform_tpu.models.multitask import fraud_predict
+
+    def prep(x):
+        return standardize_for_model(normalize(x))
+
+    fns = [
+        lambda p, x: mock_predict(normalize(x, ref_compat=True)),
+        lambda p, x: mlp_mod.mlp_predict(p, prep(x)),
+        lambda p, x: gbdt_mod.gbdt_predict(p, prep(x)),
+        lambda p, x: fraud_predict(p, prep(x)),
+    ]
+    return fns, ("mock", "mlp", "gbdt", "multitask")
+
+
+ROUTED_PARAM_KEYS = ("router", "mlp", "gbdt", "multitask")
+
+
+def init_routed_params(key, *, mlp_hidden=(128, 128), n_trees=64, depth=4,
+                       trunk=(256, 256)) -> dict:
+    """A fresh params bundle for ``ml_backend="routed"`` (dev/test boot;
+    production bundles come from trained checkpoints carrying the same
+    keys). The mock expert needs no params."""
+    from igaming_platform_tpu.core.features import NUM_FEATURES
+    from igaming_platform_tpu.models.gbdt import init_gbdt
+    from igaming_platform_tpu.models.mlp import init_mlp
+    from igaming_platform_tpu.models.multitask import init_multitask
+    from igaming_platform_tpu.parallel.ep import init_router
+
+    k0, k1, k2, k3 = jax.random.split(key, 4)
+    return {
+        "router": init_router(k0, NUM_FEATURES, len(routed_experts()[0]), scale=0.01),
+        "mock": None,
+        "mlp": init_mlp(k1, hidden=mlp_hidden),
+        "gbdt": init_gbdt(k2, n_trees=n_trees, depth=depth),
+        "multitask": init_multitask(k3, trunk=trunk),
+    }
+
+
 def make_score_fn(
     cfg: ScoringConfig,
     ml_backend: str = "mock",
+    mesh=None,
 ) -> Callable[..., dict[str, jnp.ndarray]]:
     """Build the jittable scoring step for a given ML backend.
 
@@ -89,6 +136,11 @@ def make_score_fn(
       - "gbdt":  oblivious-forest GBDT
       - "mlp+gbdt": mean of MLP and GBDT probabilities
       - "multitask": fraud head of the joint fraud+LTV multi-task net
+      - "routed": all four as a routed mixture-of-experts — params must
+        carry {"router", "mlp", "gbdt", "multitask"}; with a mesh whose
+        ``expert`` axis matches the expert count, sub-batches exchange
+        over ICI (parallel/ep.py); otherwise the dense per-row top-k mix
+        runs unsharded (same numbers, no collectives)
 
     The returned fn has signature ``f(params, x_raw, blacklisted)`` with
     ``x_raw`` a [B, 30] float32 raw feature batch and returns a dict of
@@ -135,6 +187,43 @@ def make_score_fn(
             from igaming_platform_tpu.ops.quantize import mlp_predict_int8
 
             ml = mlp_predict_int8(params["multitask_int8"], xn)
+        elif ml_backend == "routed":
+            from igaming_platform_tpu.parallel.ep import (
+                dense_reference,
+                routed_ensemble_forward,
+            )
+            from igaming_platform_tpu.parallel.mesh import AXIS_DATA, AXIS_EXPERT
+
+            fns, keys = routed_experts()
+            eparams = tuple(params.get(k) for k in keys)
+            expert_size = int(mesh.shape.get(AXIS_EXPERT, 1)) if mesh is not None else 1
+            if expert_size > 1 and expert_size != len(fns):
+                # A populated expert axis that can't hold the expert set is
+                # a config error — silently running dense would leave the
+                # operator believing EP is active.
+                raise ValueError(
+                    f"mesh expert axis is {expert_size} but the routed "
+                    f"ensemble has {len(fns)} experts (set MESH_EXPERT={len(fns)})"
+                )
+            if expert_size == len(fns):
+                # Rows split over every populated row axis (GShard
+                # data x expert layout); all_to_all rides the expert axis.
+                # Capacity is sized to the worst case (one shard routing
+                # every pick to a single expert), so no row can silently
+                # lose its ML score to a capacity drop.
+                row_axes = tuple(
+                    a for a in (AXIS_DATA, AXIS_EXPERT)
+                    if int(mesh.shape.get(a, 1)) > 1
+                )
+                ml = routed_ensemble_forward(
+                    params["router"], eparams, x_raw, mesh=mesh,
+                    expert_fns=fns, k=2, capacity_factor=float(len(fns)),
+                    shard_rows_over=row_axes,
+                )["prob"]
+            else:
+                ml = dense_reference(
+                    params["router"], eparams, x_raw, expert_fns=fns, k=2
+                )
         else:
             raise ValueError(f"unknown ml backend: {ml_backend}")
 

@@ -97,24 +97,33 @@ def routed_ensemble_forward(
     expert_fns: Sequence[Callable[[Any, jnp.ndarray], jnp.ndarray]],
     k: int = 2,
     capacity_factor: float = 1.5,
+    shard_rows_over: tuple[str, ...] = (AXIS_EXPERT,),
 ) -> dict[str, jnp.ndarray]:
     """Routed scoring: [B, F] -> per-row probability in [0, 1].
 
     ``expert_fns[i](expert_params[i], x) -> [b]`` — one scorer per expert
     shard; ``len(expert_fns)`` must equal the mesh's ``expert`` axis size,
-    and B must divide by it. Returns {"prob": [B], "load": [E] rows
-    received per expert, "dropped": [] count}.
+    and B must divide by the product of ``shard_rows_over`` axis sizes.
+    ``shard_rows_over``: which mesh axes split the batch's row dimension —
+    pass ``(AXIS_DATA, AXIS_EXPERT)`` on a serving mesh so every device
+    owns distinct rows (the GShard data x expert layout; the all_to_all
+    runs within each data group); the default expert-only split suits an
+    EP-only mesh. Returns {"prob": [B], "load": [E] rows received per
+    expert (per data group), "dropped": [] count}.
     """
     n_experts = int(mesh.shape[AXIS_EXPERT])
     assert len(expert_fns) == n_experts, (
         f"{len(expert_fns)} expert fns for expert axis of {n_experts}"
     )
+    row_split = 1
+    for ax in shard_rows_over:
+        row_split *= int(mesh.shape[ax])
     b_total, feat_dim = x.shape
-    assert b_total % n_experts == 0, (
-        f"batch {b_total} must divide by the expert axis ({n_experts}); "
-        "pad the batch (serving tiers already do)"
+    assert b_total % row_split == 0, (
+        f"batch {b_total} must divide by the row-sharding product "
+        f"({row_split}); pad the batch (serving tiers already do)"
     )
-    b_local = b_total // n_experts
+    b_local = b_total // row_split
     capacity = int(np.ceil(capacity_factor * k * b_local / n_experts))
 
     def shard_fn(router_w, expert_params, x_local):
@@ -139,16 +148,18 @@ def routed_ensemble_forward(
         )  # [E_dst, C] — my rows' scores back from every expert
         prob = jnp.einsum("bec,ec->b", comb, returned)  # [b_local]
         load = jnp.sum(disp, axis=(0, 2))  # rows THIS shard sent per expert
-        load = jax.lax.psum(load, AXIS_EXPERT)  # total per expert
-        dropped = jax.lax.psum(jnp.sum(~kept), AXIS_EXPERT)
+        # Totals must be identical on every device (out_specs P()): sum
+        # over every axis that splits rows, plus expert.
+        stat_axes = tuple(dict.fromkeys((*shard_rows_over, AXIS_EXPERT)))
+        load = jax.lax.psum(load, stat_axes)
+        dropped = jax.lax.psum(jnp.sum(~kept), stat_axes)
         return prob, load, dropped
 
-    spec_batch = P(AXIS_EXPERT, None)
     shard = jax.shard_map(
         shard_fn,
         mesh=mesh,
-        in_specs=(P(), P(), spec_batch),
-        out_specs=(P(AXIS_EXPERT), P(), P()),
+        in_specs=(P(), P(), P(shard_rows_over, None)),
+        out_specs=(P(shard_rows_over), P(), P()),
         check_vma=False,
     )
     prob, load, dropped = shard(router_w, tuple(expert_params), jnp.asarray(x, jnp.float32))

@@ -67,10 +67,15 @@ class ScoreResponse:
     features: FeatureVector
 
 
-def _mesh_can_shard(batch: int, mesh) -> bool:
-    from igaming_platform_tpu.parallel.mesh import mesh_axis_size
+def _row_divisor(mesh, ml_backend: str) -> int:
+    """How many ways the mesh splits a batch's rows: the data axis, times
+    the expert axis for the routed backend (GShard row layout)."""
+    from igaming_platform_tpu.parallel.mesh import AXIS_EXPERT, mesh_axis_size
 
-    return batch % mesh_axis_size(mesh, AXIS_DATA) == 0
+    d = mesh_axis_size(mesh, AXIS_DATA)
+    if ml_backend == "routed":
+        d *= mesh_axis_size(mesh, AXIS_EXPERT)
+    return max(1, d)
 
 
 def _pack_outputs(fn):
@@ -143,7 +148,7 @@ class TPUScoringEngine:
         )
         self._mesh = mesh
 
-        fn = make_score_fn(self.config, ml_backend)
+        fn = make_score_fn(self.config, ml_backend, mesh=mesh)
         # The serving executable returns ONE packed int32 [5, B] array
         # (score / action / reason_mask / rule_score / ml_score-bits)
         # instead of a five-array dict: on a host link where readback cost
@@ -155,12 +160,22 @@ class TPUScoringEngine:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             validate_batch_for_mesh(self.batch_size, mesh)
+            # The routed backend splits rows over data x expert — every
+            # compiled shape must divide by that product, and the
+            # throughput shape failing is a config error HERE, not a raw
+            # assert buried in a jit trace during warmup.
+            divisor = _row_divisor(mesh, ml_backend)
+            if self.batch_size % divisor != 0:
+                raise ValueError(
+                    f"batch {self.batch_size} not divisible by the mesh row "
+                    f"split ({divisor}: data x expert for ml_backend={ml_backend})"
+                )
             # Latency tiers the mesh cannot shard are dropped, not fatal —
             # they are an optimization, and the defaults must never turn a
             # previously-valid mesh config into a startup failure.
             self._shapes = [
                 s for s in self._shapes
-                if s == self.batch_size or _mesh_can_shard(s, mesh)
+                if s == self.batch_size or s % divisor == 0
             ]
             row = NamedSharding(mesh, P(AXIS_DATA, None))
             vec = NamedSharding(mesh, P(AXIS_DATA))

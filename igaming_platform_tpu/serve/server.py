@@ -72,6 +72,26 @@ class RiskServer:
                     "model path %s not found; using mock scorer", self.config.fraud_model_path
                 )
 
+        # Explicit backend override (ML_BACKEND env): wins over the
+        # checkpoint-derived default. "routed" needs the full expert
+        # bundle — fail with a config error, not a trace-time crash.
+        if self.config.ml_backend:
+            ml_backend = self.config.ml_backend
+        if ml_backend == "routed":
+            from igaming_platform_tpu.models.ensemble import ROUTED_PARAM_KEYS
+
+            missing = [
+                k for k in ROUTED_PARAM_KEYS
+                if not isinstance(params, dict) or (k != "mock" and params.get(k) is None)
+            ]
+            if missing:
+                raise RuntimeError(
+                    "ML_BACKEND=routed requires a checkpoint bundle with "
+                    f"params for {ROUTED_PARAM_KEYS}; missing {missing}. "
+                    "Build one from trained checkpoints (or "
+                    "models.ensemble.init_routed_params for dev boots)."
+                )
+
         # Serving mesh from config: MESH_DEVICES=N shards the scoring batch
         # over the first N devices (DP over ICI); -1 takes every visible
         # device. Default stays single-chip.
@@ -85,11 +105,20 @@ class RiskServer:
             if n > len(devs):
                 raise RuntimeError(f"MESH_DEVICES={n} but only {len(devs)} devices visible")
             seq = max(1, self.config.mesh_seq)
-            if n % seq != 0:
-                raise RuntimeError(f"MESH_SEQ={seq} must divide MESH_DEVICES={n}")
+            expert = max(1, self.config.mesh_expert)
+            if n % (seq * expert) != 0:
+                raise RuntimeError(
+                    f"MESH_SEQ({seq}) * MESH_EXPERT({expert}) must divide MESH_DEVICES={n}"
+                )
             if n > 1:
-                mesh = create_mesh(MeshSpec(data=n // seq, seq=seq), devices=devs[:n])
-                logger.info("serving mesh: data=%d seq=%d over %d devices", n // seq, seq, n)
+                mesh = create_mesh(
+                    MeshSpec(data=n // (seq * expert), seq=seq, expert=expert),
+                    devices=devs[:n],
+                )
+                logger.info(
+                    "serving mesh: data=%d seq=%d expert=%d over %d devices",
+                    n // (seq * expert), seq, expert, n,
+                )
 
         # Feature store: the native C++ core by default (SURVEY.md §2.2's
         # native ingest bridge), Python fallback when the build is absent.
