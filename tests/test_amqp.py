@@ -373,3 +373,38 @@ def test_publisher_tolerates_broker_down_at_startup():
     finally:
         pub.close()
         server.close()
+
+
+def test_client_handles_fragmented_frames(server, monkeypatch):
+    """TCP gives no framing guarantees: the client must reassemble frames
+    delivered one byte at a time (header/payload split across recv()s)."""
+    import socket as socket_mod
+
+    from igaming_platform_tpu.serve import amqp as amqp_mod
+
+    real_create = socket_mod.create_connection
+
+    class Dribble:
+        """Socket wrapper that returns at most 3 bytes per recv."""
+
+        def __init__(self, sock):
+            self._s = sock
+
+        def recv(self, n):
+            return self._s.recv(min(n, 3))
+
+        def __getattr__(self, name):
+            return getattr(self._s, name)
+
+    def dribbling_create(*a, **k):
+        return Dribble(real_create(*a, **k))
+
+    monkeypatch.setattr(
+        "igaming_platform_tpu.serve.amqp.socket.create_connection", dribbling_create
+    )
+    pub = AmqpPublisher(server.url, EXCHANGES)
+    try:
+        pub.publish(EXCHANGE_WALLET, Event(type="frag.test", data={"k": "v" * 200}))
+        assert pub.published == 1
+    finally:
+        pub.close()
