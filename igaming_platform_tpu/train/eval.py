@@ -194,6 +194,17 @@ def run_eval(
     t0 = time.time()
     gbdt_params = train_gbdt_on_labels(x_train, y_train, steps=max(150, steps // 2), seed=seed)
     gbdt_s = time.time() - t0
+    t0 = time.time()
+    from igaming_platform_tpu.train.routed import (
+        RoutedTrainConfig,
+        routed_prob,
+        train_routed_on_labels,
+    )
+
+    routed_params = train_routed_on_labels(
+        x_train, y_train, RoutedTrainConfig(steps=steps, seed=seed)
+    )
+    routed_s = time.time() - t0
 
     rules_p = _rules_prob(x_test, cfg)
     mock_p = _mock_prob(x_test)
@@ -210,6 +221,9 @@ def run_eval(
         "gbdt_trained": metrics(y_test, gb_p),
         "multitask_trained": metrics(y_test, mt_p),
         "ensemble_trained": metrics(y_test, rw * rules_p + mw * mt_p),
+        # The routed mixture-of-experts bundle (router + experts trained
+        # jointly — the ml_backend="routed" serving path).
+        "routed_trained": metrics(y_test, routed_prob(routed_params, x_test)),
     }
 
     # Per-archetype recall at the serving review threshold for the trained
@@ -231,6 +245,7 @@ def run_eval(
         "train": {
             "multitask_steps": steps, "multitask_seconds": round(mt_s, 1),
             "gbdt_steps": max(150, steps // 2), "gbdt_seconds": round(gbdt_s, 1),
+            "routed_steps": steps, "routed_seconds": round(routed_s, 1),
         },
         "models": models,
         "trained_ensemble_recall_at_review": per_kind,

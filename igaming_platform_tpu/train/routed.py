@@ -1,0 +1,153 @@
+"""Train the routed ensemble: router + experts jointly on labels.
+
+``ml_backend="routed"`` (models/ensemble.py) serves a top-k mixture of
+the ensemble's experts — but a mixture is only as good as its router.
+This trainer fits the whole bundle on labeled fraud data:
+
+- the ROUTER learns which expert to trust per row (gradients flow
+  through ``lax.top_k``'s selected gate values — the renormalized top-k
+  weights are differentiable in the winning logits);
+- the TRAINABLE experts (MLP, GBDT via its soft-split relaxation,
+  multitask fraud head) learn jointly with it; the mock expert is a
+  frozen heuristic the router can still route to;
+- a Switch-style load-balance auxiliary (fraction-of-rows x mean-gate
+  per expert, stop-gradient on the fraction) keeps the router from
+  collapsing onto one expert.
+
+The result is a params bundle ``{router, mock, mlp, gbdt, multitask}``
+that drops straight into ``TPUScoringEngine(ml_backend="routed")`` —
+and an ``routed_trained`` row in `make eval`'s EVAL.json.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from igaming_platform_tpu.core.features import normalize, standardize_for_model
+from igaming_platform_tpu.models.ensemble import init_routed_params
+from igaming_platform_tpu.models.gbdt import soft_gbdt_predict
+from igaming_platform_tpu.models.mlp import mlp_predict
+from igaming_platform_tpu.models.mock_model import mock_predict
+from igaming_platform_tpu.models.multitask import fraud_predict
+from igaming_platform_tpu.parallel.ep import gate_probs
+
+
+@dataclass(frozen=True)
+class RoutedTrainConfig:
+    steps: int = 400
+    batch_size: int = 1024
+    learning_rate: float = 3e-3
+    k: int = 2
+    load_balance_weight: float = 0.5
+    # GBDT soft-split temperature annealing (train/distill.py recipe).
+    temp_start: float = 5.0
+    temp_end: float = 200.0
+    mlp_hidden: tuple[int, ...] = (64, 64)
+    n_trees: int = 32
+    depth: int = 4
+    trunk: tuple[int, ...] = (64, 64)
+    seed: int = 0
+
+
+def _expert_outputs(params: dict, x_raw: jnp.ndarray, temp) -> jnp.ndarray:
+    """[B, 4] expert probabilities (soft GBDT so gradients flow)."""
+    prep = standardize_for_model(normalize(x_raw))
+    return jnp.stack([
+        mock_predict(normalize(x_raw, ref_compat=True)),
+        mlp_predict(params["mlp"], prep),
+        soft_gbdt_predict(params["gbdt"], prep, temperature=temp),
+        fraud_predict(params["multitask"], prep),
+    ], axis=-1)
+
+
+def routed_mixture(params: dict, x_raw: jnp.ndarray, k: int, temp) -> tuple:
+    """Differentiable top-k mixture + the quantities the aux loss needs."""
+    gates = gate_probs(params["router"], x_raw)  # [B, E]
+    top_vals, top_idx = jax.lax.top_k(gates, k)
+    weights = top_vals / jnp.maximum(top_vals.sum(-1, keepdims=True), 1e-9)
+    outs = _expert_outputs(params, x_raw, temp)  # [B, E]
+    picked = jnp.take_along_axis(outs, top_idx, axis=-1)  # [B, k]
+    mix = jnp.sum(picked * weights, axis=-1)
+    return mix, gates, top_idx
+
+
+def load_balance_loss(gates: jnp.ndarray, top_idx: jnp.ndarray) -> jnp.ndarray:
+    """Switch-transformer aux: E * sum_e f_e * P_e — minimized when both
+    routed fractions and gate mass are uniform. f_e is a count (constant
+    wrt params); gradients reach the router through P_e."""
+    e = gates.shape[-1]
+    top1 = jax.nn.one_hot(top_idx[:, 0], e)
+    f = jax.lax.stop_gradient(jnp.mean(top1, axis=0))
+    p = jnp.mean(gates, axis=0)
+    return e * jnp.sum(f * p)
+
+
+def train_routed_on_labels(
+    x: np.ndarray, y: np.ndarray, cfg: RoutedTrainConfig = RoutedTrainConfig()
+) -> dict:
+    """Fit router + experts on labeled rows; returns the serving bundle."""
+    params = init_routed_params(
+        jax.random.key(cfg.seed), mlp_hidden=cfg.mlp_hidden,
+        n_trees=cfg.n_trees, depth=cfg.depth, trunk=cfg.trunk,
+    )
+    # The GBDT's split structure (feature ids) stays fixed, like distill.
+    frozen_feat = params["gbdt"]["feat"]
+    trainable = {
+        "router": params["router"],
+        "mlp": params["mlp"],
+        "gbdt": {k: v for k, v in params["gbdt"].items() if k != "feat"},
+        "multitask": params["multitask"],
+    }
+    opt = optax.adam(cfg.learning_rate)
+    opt_state = opt.init(trainable)
+
+    def assemble(tr) -> dict:
+        return {
+            "router": tr["router"], "mock": None, "mlp": tr["mlp"],
+            "gbdt": {"feat": frozen_feat, **tr["gbdt"]},
+            "multitask": tr["multitask"],
+        }
+
+    def loss_fn(tr, xb, yb, temp):
+        mix, gates, top_idx = routed_mixture(assemble(tr), xb, cfg.k, temp)
+        eps = 1e-6
+        bce = -jnp.mean(
+            yb * jnp.log(mix + eps) + (1.0 - yb) * jnp.log(1.0 - mix + eps)
+        )
+        return bce + cfg.load_balance_weight * load_balance_loss(gates, top_idx)
+
+    @jax.jit
+    def step(tr, opt_state, xb, yb, temp):
+        loss, grads = jax.value_and_grad(loss_fn)(tr, xb, yb, temp)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(tr, updates), opt_state, loss
+
+    rng = np.random.default_rng(cfg.seed)
+    for i in range(cfg.steps):
+        idx = rng.integers(0, x.shape[0], cfg.batch_size)
+        frac = i / max(cfg.steps - 1, 1)
+        temp = np.float32(cfg.temp_start * (cfg.temp_end / cfg.temp_start) ** frac)
+        trainable, opt_state, _ = step(
+            trainable, opt_state, x[idx], y[idx].astype(np.float32), temp
+        )
+    return assemble(trainable)
+
+
+def routed_prob(params: dict, x_raw: np.ndarray, k: int = 2) -> np.ndarray:
+    """Serving-semantics inference — delegates to the SAME expert stack
+    and dense top-k mix the routed backend serves (hard GBDT), so the
+    eval row cannot drift from what ml_backend="routed" runs."""
+    from igaming_platform_tpu.models.ensemble import routed_experts
+    from igaming_platform_tpu.parallel.ep import dense_reference
+
+    fns, keys = routed_experts()
+    eparams = tuple(params[key] for key in keys)
+    return np.asarray(
+        dense_reference(params["router"], eparams, x_raw, expert_fns=fns, k=k),
+        dtype=np.float64,
+    )

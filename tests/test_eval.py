@@ -54,3 +54,52 @@ def test_eval_ordering_trained_beats_mock_beats_rules():
     assert m["gbdt_trained"]["auc"] > m["mock"]["auc"] + 0.015
     assert m["multitask_trained"]["average_precision"] > m["mock"]["average_precision"]
     assert r["ordering"]["trained_beats_mock"]
+
+
+def test_routed_training_improves_over_untrained_bundle():
+    """Joint router+experts training beats the fresh bundle by a wide
+    margin, the trained router spreads load, and the bundle drops into
+    the serving engine's routed backend."""
+    import jax
+
+    from igaming_platform_tpu.parallel.ep import gate_probs
+    from igaming_platform_tpu.train.routed import (
+        RoutedTrainConfig,
+        routed_prob,
+        train_routed_on_labels,
+    )
+
+    rng = np.random.default_rng(7)
+    x, y, _ = generate_labeled(rng, 8_000)
+    x_test, y_test, _ = generate_labeled(np.random.default_rng(8), 4_000)
+
+    from igaming_platform_tpu.models.ensemble import init_routed_params
+
+    fresh = init_routed_params(jax.random.key(0), mlp_hidden=(64, 64),
+                               n_trees=32, depth=4, trunk=(64, 64))
+    auc_fresh = roc_auc(y_test, routed_prob(fresh, x_test))
+
+    trained = train_routed_on_labels(x, y, RoutedTrainConfig(steps=120, seed=7))
+    auc_trained = roc_auc(y_test, routed_prob(trained, x_test))
+    assert auc_trained > auc_fresh + 0.05
+    assert auc_trained > 0.9
+
+    # Router actually discriminates: no expert monopolizes top-1.
+    gates = np.asarray(gate_probs(trained["router"], x_test[:2000]))
+    top1_share = np.bincount(gates.argmax(-1), minlength=4) / 2000.0
+    assert top1_share.max() < 0.9
+
+    # The bundle serves through the engine's routed backend.
+    from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
+    from igaming_platform_tpu.serve.scorer import ScoreRequest, TPUScoringEngine
+
+    engine = TPUScoringEngine(
+        ScoringConfig(), ml_backend="routed", params=trained,
+        batcher_config=BatcherConfig(batch_size=32, max_wait_ms=1.0),
+    )
+    try:
+        resp = engine.score(ScoreRequest(account_id="rt-1", amount=90_000,
+                                         tx_type="withdraw"))
+        assert 0 <= resp.score <= 100
+    finally:
+        engine.close()
