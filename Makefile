@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-fast bench bench-all eval native proto run-risk run-wallet dryrun clean
+.PHONY: all test test-fast bench bench-all eval native proto run-risk run-wallet dryrun clean soak soak-wire api-test
 
 all: native test
 
@@ -24,6 +24,14 @@ bench-all:
 
 soak:
 	$(PY) benchmarks/soak.py
+
+# Sustained mixed load at the gRPC wire (SOAK_DURATION_S, default 60s).
+soak-wire:
+	$(PY) benchmarks/soak.py --wire
+
+# API smoke against RUNNING services (the reference's grpcurl api-test).
+api-test:
+	$(PY) benchmarks/smoke.py
 
 # Model quality on labeled synthetic fraud: trains multitask + GBDT and
 # writes EVAL.json (AUC / PR / calibration; trained > mock > rules).

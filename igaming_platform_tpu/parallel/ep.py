@@ -166,6 +166,17 @@ def routed_ensemble_forward(
     return {"prob": prob, "load": load, "dropped": dropped}
 
 
+def topk_mix(gates: jnp.ndarray, expert_outs: jnp.ndarray, k: int) -> tuple:
+    """Per-row renormalized top-k gate-weighted mix — THE mixture
+    semantics, shared by serving (dense_reference) and training
+    (train/routed.py) so the two forwards cannot drift. Returns
+    (mix [B], top_idx [B, k])."""
+    top_vals, top_idx = jax.lax.top_k(gates, k)
+    weights = top_vals / jnp.maximum(top_vals.sum(-1, keepdims=True), 1e-9)
+    picked = jnp.take_along_axis(expert_outs, top_idx, axis=-1)  # [B, k]
+    return jnp.sum(picked * weights, axis=-1), top_idx
+
+
 def dense_reference(
     router_w: jnp.ndarray,
     expert_params: tuple,
@@ -178,10 +189,7 @@ def dense_reference(
     gate-weighted mix. Equals the routed forward when capacity drops
     nothing."""
     gates = gate_probs(router_w, x)
-    top_vals, top_idx = jax.lax.top_k(gates, k)
-    weights = top_vals / jnp.maximum(top_vals.sum(-1, keepdims=True), 1e-9)
     all_out = jnp.stack(
         [fn(p, x) for fn, p in zip(expert_fns, expert_params)], axis=-1
     )  # [B, E]
-    picked = jnp.take_along_axis(all_out, top_idx, axis=-1)  # [B, k]
-    return jnp.sum(picked * weights, axis=-1)
+    return topk_mix(gates, all_out, k)[0]

@@ -66,13 +66,13 @@ def _expert_outputs(params: dict, x_raw: jnp.ndarray, temp) -> jnp.ndarray:
 
 
 def routed_mixture(params: dict, x_raw: jnp.ndarray, k: int, temp) -> tuple:
-    """Differentiable top-k mixture + the quantities the aux loss needs."""
+    """Differentiable top-k mixture + the quantities the aux loss needs.
+    The mix itself is ep.topk_mix — the SAME function serving uses."""
+    from igaming_platform_tpu.parallel.ep import topk_mix
+
     gates = gate_probs(params["router"], x_raw)  # [B, E]
-    top_vals, top_idx = jax.lax.top_k(gates, k)
-    weights = top_vals / jnp.maximum(top_vals.sum(-1, keepdims=True), 1e-9)
     outs = _expert_outputs(params, x_raw, temp)  # [B, E]
-    picked = jnp.take_along_axis(outs, top_idx, axis=-1)  # [B, k]
-    mix = jnp.sum(picked * weights, axis=-1)
+    mix, top_idx = topk_mix(gates, outs, k)
     return mix, gates, top_idx
 
 
