@@ -106,3 +106,58 @@ def test_outage_fail_open_deposit_fail_closed_withdraw(stack):
     wd = stub.Withdraw(wallet_pb2.WithdrawRequest(
         account_id=acct.id, amount=1_000, idempotency_key="x-w1"))
     assert wd.new_balance == 20_000
+
+
+def test_wallet_events_reach_risk_bridge_over_amqp(monkeypatch):
+    """The full async topology over a REAL broker socket: wallet deposit ->
+    transactional outbox -> AMQP publisher (confirms) -> risk-scoring
+    queue -> the risk server's bridge consumes, scores, and folds the
+    event into the feature store. EVENT_TRANSPORT=amqp end to end."""
+    import time
+
+    from igaming_platform_tpu.core.config import (
+        BatcherConfig,
+        RiskServiceConfig,
+        WalletServiceConfig,
+    )
+    from igaming_platform_tpu.platform.server import WalletServer
+    from igaming_platform_tpu.serve.amqp_testing import FakeAmqpServer
+    from igaming_platform_tpu.serve.server import RiskServer
+
+    broker = FakeAmqpServer()
+    monkeypatch.setenv("EVENT_TRANSPORT", "amqp")
+    risk = None
+    wallet = None
+    try:
+        risk = RiskServer(
+            RiskServiceConfig(
+                rabbitmq_url=broker.url,
+                batcher=BatcherConfig(batch_size=16, max_wait_ms=1.0),
+            ),
+            grpc_port=0, http_port=0,
+        )
+        wallet = WalletServer(
+            WalletServiceConfig(
+                rabbitmq_url=broker.url,
+                risk_service_addr=f"localhost:{risk.grpc_port}",
+            ),
+            grpc_port=0, http_port=0,
+        )
+        acct = wallet.wallet.create_account("amqp-x-proc")
+        wallet.wallet.deposit(acct.id, 25_000, "dep-amqp-1",
+                              ip="9.9.9.9", device_id="dev-x")
+
+        deadline = time.monotonic() + 10.0
+        while risk.bridge.events_processed < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert risk.bridge.events_processed >= 1
+        # The event crossed the broker and updated velocity features.
+        c1, _, _ = risk.engine.features.velocity(acct.id)
+        assert c1 >= 1
+        assert broker.published_count >= 1
+    finally:
+        if wallet is not None:
+            wallet.shutdown(grace=1)
+        if risk is not None:
+            risk.shutdown(grace=1)
+        broker.close()
