@@ -17,6 +17,7 @@ TPU serving:
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -215,7 +216,12 @@ class TPUScoringEngine:
         self._fn_host = None
         self._params_host = None
         self._thresholds_host = self._thresholds
-        if self._host_tier > 0 and jax.default_backend() != "cpu":
+        # HOST_TIER_FORCE=1 builds the tier even when the default backend
+        # is already CPU — meaningless for performance, but it lets the
+        # CPU-only test suite execute this production path (otherwise the
+        # tier code would only ever run on real TPU hosts).
+        force_tier = os.environ.get("HOST_TIER_FORCE") == "1"
+        if self._host_tier > 0 and (jax.default_backend() != "cpu" or force_tier):
             try:
                 cpu = jax.devices("cpu")[0]
             except RuntimeError:

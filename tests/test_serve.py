@@ -310,3 +310,52 @@ def test_latency_tiers_disabled_and_oversize():
         assert engine._pick_shape(1) == 128
     finally:
         engine.close()
+
+
+def test_host_latency_tier_executes_and_matches(monkeypatch):
+    """The host-CPU latency tier (a TPU-host-only path by default) is
+    forced on and exercised: near-empty flushes ride the host executable,
+    full batches ride the device fn, and both agree on actions/scores."""
+    import numpy as np
+
+    from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
+    from igaming_platform_tpu.serve.scorer import ScoreRequest, TPUScoringEngine
+
+    monkeypatch.setenv("HOST_TIER_FORCE", "1")
+    engine = TPUScoringEngine(
+        ScoringConfig(),
+        batcher_config=BatcherConfig(batch_size=64, latency_tiers=(8,),
+                                     host_tier_rows=8, max_wait_ms=1.0),
+    )
+    try:
+        assert engine._fn_host is not None
+        calls = {"host": 0}
+        real_host_fn = engine._fn_host
+
+        def counting_host_fn(*a, **k):
+            calls["host"] += 1
+            return real_host_fn(*a, **k)
+
+        engine._fn_host = counting_host_fn
+
+        reqs = [ScoreRequest(account_id=f"ht-{i}", amount=120_000 + i,
+                             tx_type="withdraw") for i in range(4)]
+        x, bl = engine.features.gather_batch(reqs)
+        out_host, n = engine._launch_device(x, bl)          # 4 <= tier: host
+        assert calls["host"] == 1 and n == 4
+
+        x64, bl64 = engine.features.gather_batch(
+            [ScoreRequest(account_id=f"ht-{i}", amount=120_000 + i,
+                          tx_type="withdraw") for i in range(64)])
+        out_dev, _ = engine._launch_device(x64, bl64)       # full batch: device
+        assert calls["host"] == 1  # unchanged
+
+        host = np.asarray(out_host)
+        dev = np.asarray(out_dev)
+        # Same rows through both executables: actions and rule scores
+        # identical, ml within float32 rounding (score within 1 point).
+        np.testing.assert_array_equal(host[1, :4], dev[1, :4])   # action
+        np.testing.assert_array_equal(host[3, :4], dev[3, :4])   # rule_score
+        assert np.abs(host[0, :4] - dev[0, :4]).max() <= 1       # score
+    finally:
+        engine.close()
