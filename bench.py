@@ -15,6 +15,7 @@ Prints ONE JSON line: {"metric": ..., "value": N, "unit": ...,
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -23,6 +24,33 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import numpy as np
 
 TARGET_TXNS_PER_SEC = 100_000.0
+
+DEVICE_FALLBACK: str | None = None
+
+
+def _ensure_responsive_device(probe_timeout_s: float = 90.0) -> None:
+    """The tunneled dev chip sometimes wedges so hard that jax.devices()
+    blocks FOREVER in every process. Probe it from a killable subprocess
+    first; if it hangs, pin this process to CPU so the bench still
+    produces an (honestly labeled) artifact instead of hanging the
+    driver. Real TPU hosts pass the probe in a second or two."""
+    global DEVICE_FALLBACK
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        return
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=probe_timeout_s, capture_output=True,
+        )
+        if probe.returncode == 0:
+            return
+    except subprocess.TimeoutExpired:
+        pass
+    DEVICE_FALLBACK = "cpu (device tunnel unresponsive)"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 
 def device_pipeline_numbers() -> dict:
@@ -129,9 +157,12 @@ def e2e_numbers() -> dict:
 
 
 def main() -> None:
+    _ensure_responsive_device()
     import jax
 
     result = {"device": str(jax.devices()[0]), "backend": "multitask-ensemble"}
+    if DEVICE_FALLBACK:
+        result["device_fallback"] = DEVICE_FALLBACK
     result.update(device_pipeline_numbers())
 
     try:
