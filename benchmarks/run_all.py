@@ -20,6 +20,12 @@ from configs import ALL_CONFIGS
 
 
 def main() -> None:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench import _ensure_responsive_device
+
+    # A wedged device tunnel must not hang the matrix: fall back to CPU
+    # (the env var propagates to the per-config subprocesses).
+    _ensure_responsive_device()
     names = sys.argv[1:] or list(ALL_CONFIGS)
     isolate = len(names) > 1 and os.environ.get("BENCH_NO_ISOLATE") != "1"
     for name in names:

@@ -176,8 +176,13 @@ def main_wire() -> None:
     rpc_ms = np.array([ms for _, ms in rpc_done])
     probes = np.array(probe_lat)
     total_txns = len(rpc_done) * rows_per_rpc
+    import bench as _bench
+    import jax
+
     result = {
         "metric": "soak_wire_txns_per_sec",
+        "device": str(jax.devices()[0]),
+        **({"device_fallback": _bench.DEVICE_FALLBACK} if _bench.DEVICE_FALLBACK else {}),
         "value": round(total_txns / duration_s, 1),
         "unit": "txns/s",
         "duration_s": duration_s,
@@ -201,6 +206,9 @@ def main_wire() -> None:
 
 
 if __name__ == "__main__":
+    from bench import _ensure_responsive_device  # repo root on sys.path
+
+    _ensure_responsive_device()
     if "--wire" in sys.argv or os.environ.get("SOAK_WIRE") == "1":
         main_wire()
     else:
