@@ -20,12 +20,13 @@ from configs import ALL_CONFIGS
 
 
 def main() -> None:
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    from bench import _ensure_responsive_device
+    import bench  # repo root is on sys.path via the configs import
 
-    # A wedged device tunnel must not hang the matrix: fall back to CPU
-    # (the env var propagates to the per-config subprocesses).
-    _ensure_responsive_device()
+    # A wedged device tunnel must not hang the matrix: fall back to CPU.
+    # Probe state propagates to per-config subprocesses via env
+    # (BENCH_DEVICE_PROBED / BENCH_DEVICE_FALLBACK) so children neither
+    # re-probe nor lose the fallback label.
+    bench._ensure_responsive_device()
     names = sys.argv[1:] or list(ALL_CONFIGS)
     isolate = len(names) > 1 and os.environ.get("BENCH_NO_ISOLATE") != "1"
     for name in names:
@@ -53,6 +54,11 @@ def main() -> None:
         else:
             result = ALL_CONFIGS[name]()
             result["config"] = name
+            import jax
+
+            result["device"] = str(jax.devices()[0])
+            if bench.DEVICE_FALLBACK:
+                result["device_fallback"] = bench.DEVICE_FALLBACK
             print(json.dumps(result), flush=True)
 
 

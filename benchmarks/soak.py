@@ -73,8 +73,13 @@ def main() -> None:
     engine.close()
 
     lat = np.array(latencies)
+    import bench as _bench
+    import jax
+
     print(json.dumps({
         "metric": "soak_concurrent_score_rps",
+        "device": str(jax.devices()[0]),
+        **({"device_fallback": _bench.DEVICE_FALLBACK} if _bench.DEVICE_FALLBACK else {}),
         "value": round(len(lat) / wall, 1),
         "unit": "req/s",
         "requests": int(lat.size),

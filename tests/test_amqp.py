@@ -111,7 +111,8 @@ def test_handler_error_nacks_and_redelivers(server):
         assert _wait_until(lambda: con.processed == 1)
         assert len(attempts) == 3  # 2 nack+requeue, then success
         assert con.nacked == 2
-        assert server.queue_depth("t.flaky") == 0
+        # The final ack frame races the processed-counter bump; wait for it.
+        assert _wait_until(lambda: server.queue_depth("t.flaky") == 0)
     finally:
         con.stop()
         pub.close()
@@ -130,7 +131,9 @@ def test_poison_payload_rejected_without_requeue(server):
         pub._conn.wait_confirm()
 
         assert _wait_until(lambda: con.rejected == 1)
-        assert server.dead_letters and server.dead_letters[0][0] == "t.poison"
+        # The reject frame races the rejected-counter bump; wait for it.
+        assert _wait_until(lambda: bool(server.dead_letters))
+        assert server.dead_letters[0][0] == "t.poison"
         assert server.queue_depth("t.poison") == 0  # NOT requeued
         assert con.processed == 0
     finally:
@@ -157,7 +160,7 @@ def test_repeated_handler_failure_dead_letters_after_cap(server):
         assert _wait_until(lambda: con.rejected == 1)
         assert calls[0] == 3  # nack, nack, reject
         assert con.nacked == 2
-        assert len(server.dead_letters) == 1
+        assert _wait_until(lambda: len(server.dead_letters) == 1)
     finally:
         con.stop()
         pub.close()
