@@ -323,3 +323,45 @@ def test_grpc_scorebatch_raw_native_path():
     finally:
         server.stop(0)
         engine.close()
+
+
+def test_decode_gather_adversarial_bytes_never_crash():
+    """Deterministic fuzz of the native C++ decoder: every truncation of a
+    valid payload, seeded random byte flips, and pure garbage. Untrusted
+    wire bytes reach fs_decode_gather directly from the raw ScoreBatch
+    route, so the decoder must either raise ValueError or return a
+    well-shaped result — a bounds bug here would segfault the server
+    process, not just one request."""
+    store = _native_store_or_skip()
+    txs = [
+        risk_pb2.ScoreTransactionRequest(
+            account_id=f"fz-{i}", amount=31 * i, transaction_type="bet",
+            ip_address=f"10.1.0.{i}", device_id=f"d{i}", fingerprint=f"f{i}",
+            player_id=f"p{i}", currency="USD", game_id="g", session_id="s",
+        )
+        for i in range(8)
+    ]
+    valid = risk_pb2.ScoreBatchRequest(transactions=txs).SerializeToString()
+
+    def probe(buf: bytes) -> None:
+        try:
+            x, bl = store.decode_gather(buf)
+        except ValueError:
+            return  # rejected cleanly
+        assert x.ndim == 2 and x.shape[1] == 30
+        assert bl.shape == (x.shape[0],)
+        assert np.isfinite(x).all()
+
+    for k in range(len(valid)):  # every truncation point
+        probe(valid[:k])
+
+    rng = np.random.default_rng(0xC0DEC)
+    for _ in range(2000):  # seeded random byte flips over the valid payload
+        buf = bytearray(valid)
+        for _ in range(int(rng.integers(1, 9))):
+            buf[int(rng.integers(0, len(buf)))] = int(rng.integers(0, 256))
+        probe(bytes(buf))
+
+    for _ in range(500):  # unstructured garbage
+        n = int(rng.integers(0, 64))
+        probe(rng.integers(0, 256, size=n, dtype=np.uint8).tobytes())
