@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-fast bench bench-all eval native proto run-risk run-wallet dryrun clean soak soak-wire api-test
+.PHONY: all test test-fast bench bench-all eval native proto run-risk run-wallet dryrun clean soak soak-wire api-test migrate-up migrate-down migrate-status
 
 all: native test
 
@@ -18,7 +18,7 @@ test-fast:
 bench:
 	$(PY) bench.py
 
-# All five BASELINE configs.
+# The full benchmark matrix (five BASELINE configs + wallet pipeline).
 bench-all:
 	$(PY) benchmarks/run_all.py
 
@@ -32,6 +32,16 @@ soak-wire:
 # API smoke against RUNNING services (the reference's grpcurl api-test).
 api-test:
 	$(PY) benchmarks/smoke.py
+
+# Schema migrations for the Postgres store of record (DATABASE_URL).
+migrate-up:
+	$(PY) -m igaming_platform_tpu.platform.migrations '$(DATABASE_URL)' up
+
+migrate-down:
+	$(PY) -m igaming_platform_tpu.platform.migrations '$(DATABASE_URL)' down $(TARGET)
+
+migrate-status:
+	$(PY) -m igaming_platform_tpu.platform.migrations '$(DATABASE_URL)' status
 
 # Model quality on labeled synthetic fraud: trains multitask + GBDT and
 # writes EVAL.json (AUC / PR / calibration; trained > mock > rules).

@@ -1,4 +1,5 @@
-"""The five BASELINE.json benchmark configs, as callable measurements.
+"""The five BASELINE.json benchmark configs plus platform-path configs,
+as callable measurements.
 
 Each function returns a JSON-able dict with a ``metric``/``value``/``unit``
 triple (plus detail fields). `bench.py` at the repo root is the driver's
@@ -9,7 +10,9 @@ headline metric; this module measures the full matrix:
 2. batched fraud scoring over a 10k-txn event replay (RabbitMQ trace);
 3. bonus-abuse sequence detection throughput;
 4. LTV batch prediction over a player table;
-5. DP multi-task training throughput.
+5. DP multi-task training throughput;
+6. wallet money-op pipeline throughput (the platform hot path,
+   wallet_service.go:351-462), store-only and with the risk gate.
 """
 
 from __future__ import annotations
@@ -233,6 +236,103 @@ def config0_grpc_e2e() -> dict:
         shutdown()
 
 
+def config6_wallet_ops(n_threads: int = 8, cycles: int = 120) -> dict:
+    """Money-op pipeline throughput — the reference's platform hot path
+    (WalletService/Bet, SURVEY.md §3.2; wallet_service.go:351-462).
+
+    Two figures from the same op mix (deposit -> bet -> win cycles,
+    unique idempotency keys, per-thread accounts):
+
+    - ``store_ops_per_sec``: WalletService over the durable SQLite store
+      with the risk gate off — tx row, optimistic-lock balance update,
+      double-entry ledger, completion, and outbox staging, one unit of
+      work per op. This is the store-of-record pipeline's capacity.
+    - headline ``value``: the full topology — every deposit/bet scored
+      through the serving engine's continuous batcher before money
+      moves (the Deposit/Bet -> RiskService gate of SURVEY.md §3.1-3.2).
+    """
+    import os
+    import tempfile
+    import threading
+
+    from igaming_platform_tpu.platform.outbox import OutboxPublisher
+    from igaming_platform_tpu.platform.repository import SQLiteStore
+    from igaming_platform_tpu.platform.wallet import WalletService
+
+    def run_mix(wallet, tag: str) -> tuple[np.ndarray, int, float]:
+        errors = [0]
+        lat: list[float] = []
+        lock = threading.Lock()
+
+        def worker(tid: int) -> None:
+            acct = wallet.create_account(f"bench-{tag}-{tid}")
+            wallet.deposit(acct.id, 10_000_000, f"seed-{tag}-{tid}")
+            my_lat = []
+            for i in range(cycles):
+                ops = [
+                    lambda: wallet.deposit(acct.id, 2_000 + i, f"d-{tag}-{tid}-{i}"),
+                    lambda: wallet.bet(acct.id, 100 + (i % 50), f"b-{tag}-{tid}-{i}",
+                                       game_id="slots-1", round_id=f"r{i}"),
+                    lambda: wallet.win(acct.id, 150, f"w-{tag}-{tid}-{i}",
+                                       game_id="slots-1", round_id=f"r{i}"),
+                ]
+                for op in ops:
+                    t0 = time.perf_counter()
+                    try:
+                        op()
+                    except Exception:  # noqa: BLE001 — counted, fails loudly below
+                        with lock:
+                            errors[0] += 1
+                        continue
+                    my_lat.append((time.perf_counter() - t0) * 1e3)
+            with lock:
+                lat.extend(my_lat)
+
+        threads = [threading.Thread(target=worker, args=(t,)) for t in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return np.array(lat), errors[0], wall
+
+    with tempfile.TemporaryDirectory() as tmp:
+        # Store-of-record pipeline only (risk gate off).
+        store = SQLiteStore(os.path.join(tmp, "wallet_store.db"))
+        wallet = WalletService(
+            store.accounts, store.transactions, store.ledger,
+            events=OutboxPublisher(store), audit=store.audit,
+        )
+        store_lat, store_errors, store_wall = run_mix(wallet, "s")
+        store.close()
+
+        # Full topology: risk gate scores deposits/bets through the
+        # serving engine before money moves.
+        from igaming_platform_tpu.platform.app import AppConfig, PlatformApp
+
+        app = PlatformApp(AppConfig(sqlite_path=os.path.join(tmp, "wallet_full.db")))
+        try:
+            full_lat, full_errors, full_wall = run_mix(app.wallet, "f")
+        finally:
+            app.close()
+
+    return {
+        "metric": "wallet_ops_per_sec",
+        "value": round(full_lat.size / full_wall, 1),
+        "unit": "ops/s",
+        "op_p50_ms": round(float(np.percentile(full_lat, 50)), 2),
+        "op_p99_ms": round(float(np.percentile(full_lat, 99)), 2),
+        "errors": full_errors,
+        "store_ops_per_sec": round(store_lat.size / store_wall, 1),
+        "store_op_p50_ms": round(float(np.percentile(store_lat, 50)), 2),
+        "store_op_p99_ms": round(float(np.percentile(store_lat, 99)), 2),
+        "store_errors": store_errors,
+        "threads": n_threads,
+        "ops": int(full_lat.size),
+    }
+
+
 ALL_CONFIGS = {
     "grpc_e2e": config0_grpc_e2e,
     "single_txn": config1_single_txn_latency,
@@ -240,4 +340,5 @@ ALL_CONFIGS = {
     "sequence": config3_sequence_throughput,
     "ltv": config4_ltv_batch_throughput,
     "train": config5_training_throughput,
+    "wallet": config6_wallet_ops,
 }

@@ -1,7 +1,8 @@
 """Run every BASELINE config and print one JSON line per result.
 
 Usage: python benchmarks/run_all.py [config ...]
-Configs: grpc_e2e single_txn replay sequence ltv train (default: all).
+Configs: grpc_e2e single_txn replay sequence ltv train wallet
+(default: all).
 
 Each config runs in its OWN subprocess when several are requested: the
 serving configs leave device queues / batcher threads / allocator state

@@ -39,91 +39,9 @@ from igaming_platform_tpu.platform.repository import (
     _SQLiteTransactions,
 )
 
-_PG_SCHEMA = """
-CREATE TABLE IF NOT EXISTS accounts (
-    id TEXT PRIMARY KEY,
-    player_id TEXT UNIQUE NOT NULL,
-    currency TEXT NOT NULL DEFAULT 'USD',
-    balance BIGINT NOT NULL DEFAULT 0 CHECK (balance >= 0),
-    bonus BIGINT NOT NULL DEFAULT 0 CHECK (bonus >= 0),
-    status TEXT NOT NULL DEFAULT 'active',
-    version BIGINT NOT NULL DEFAULT 1,
-    created_at DOUBLE PRECISION NOT NULL,
-    updated_at DOUBLE PRECISION NOT NULL
-);
-CREATE TABLE IF NOT EXISTS transactions (
-    id TEXT PRIMARY KEY,
-    account_id TEXT NOT NULL REFERENCES accounts(id),
-    idempotency_key TEXT,
-    type TEXT NOT NULL,
-    amount BIGINT NOT NULL CHECK (amount > 0),
-    balance_before BIGINT NOT NULL,
-    balance_after BIGINT NOT NULL,
-    status TEXT NOT NULL DEFAULT 'pending',
-    reference TEXT NOT NULL DEFAULT '',
-    game_id TEXT,
-    round_id TEXT,
-    risk_score BIGINT,
-    created_at DOUBLE PRECISION NOT NULL,
-    completed_at DOUBLE PRECISION,
-    seq BIGSERIAL
-);
-CREATE UNIQUE INDEX IF NOT EXISTS idx_tx_idem
-    ON transactions(account_id, idempotency_key)
-    WHERE status != 'failed' AND idempotency_key IS NOT NULL;
-CREATE INDEX IF NOT EXISTS idx_tx_account ON transactions(account_id, created_at DESC);
-CREATE TABLE IF NOT EXISTS ledger_entries (
-    id TEXT PRIMARY KEY,
-    transaction_id TEXT NOT NULL REFERENCES transactions(id),
-    account_id TEXT NOT NULL REFERENCES accounts(id),
-    entry_type TEXT NOT NULL CHECK (entry_type IN ('debit','credit')),
-    amount BIGINT NOT NULL CHECK (amount > 0),
-    balance_after BIGINT NOT NULL,
-    description TEXT NOT NULL DEFAULT '',
-    created_at DOUBLE PRECISION NOT NULL
-);
-CREATE INDEX IF NOT EXISTS idx_ledger_account ON ledger_entries(account_id);
-CREATE TABLE IF NOT EXISTS event_outbox (
-    id BIGSERIAL PRIMARY KEY,
-    exchange TEXT NOT NULL,
-    routing_key TEXT NOT NULL,
-    payload TEXT NOT NULL,
-    published INTEGER NOT NULL DEFAULT 0,
-    created_at DOUBLE PRECISION NOT NULL
-);
-CREATE INDEX IF NOT EXISTS idx_outbox_unpublished ON event_outbox(published) WHERE published = 0;
-CREATE TABLE IF NOT EXISTS audit_log (
-    id BIGSERIAL PRIMARY KEY,
-    entity TEXT NOT NULL,
-    entity_id TEXT NOT NULL,
-    action TEXT NOT NULL,
-    old_value TEXT,
-    new_value TEXT,
-    created_at DOUBLE PRECISION NOT NULL
-);
-CREATE TABLE IF NOT EXISTS processed_deliveries (
-    event_id TEXT PRIMARY KEY,
-    created_at DOUBLE PRECISION NOT NULL
-);
-"""
-
-# DB-trigger backstop: a concurrent update that slips past the optimistic
-# WHERE version=$n (e.g. a buggy write path setting version directly) is
-# rejected by the database itself — init-db.sql:224-236.
-_PG_TRIGGERS = """
-CREATE OR REPLACE FUNCTION accounts_version_backstop() RETURNS trigger AS $$
-BEGIN
-    IF NEW.version IS DISTINCT FROM OLD.version
-       AND NEW.version IS DISTINCT FROM OLD.version + 1 THEN
-        RAISE EXCEPTION 'version must increment by exactly 1 (got % -> %)',
-            OLD.version, NEW.version USING ERRCODE = '40001';
-    END IF;
-    RETURN NEW;
-END $$ LANGUAGE plpgsql;
-DROP TRIGGER IF EXISTS trg_accounts_version ON accounts;
-CREATE TRIGGER trg_accounts_version BEFORE UPDATE ON accounts
-    FOR EACH ROW EXECUTE FUNCTION accounts_version_backstop();
-"""
+# The DDL lives in platform/migrations.py as a versioned history (the
+# reference's golang-migrate role, Makefile:144-161); boot applies any
+# pending migrations so a fresh database and a migrated one agree.
 
 
 class _PgConnAdapter:
@@ -212,11 +130,9 @@ class PostgresStore(DedupeStoreMixin):
         self._pg.connect()
 
     def _bootstrap(self) -> None:
-        for stmt in _PG_SCHEMA.split(";"):
-            if stmt.strip():
-                self._pg.execute(stmt)
-        # plpgsql bodies contain semicolons — run as one simple-query batch.
-        self._pg._simple(_PG_TRIGGERS)
+        from igaming_platform_tpu.platform.migrations import migrate_up
+
+        migrate_up(self._pg)
 
     def close(self) -> None:
         self._pg.close()

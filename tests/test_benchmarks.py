@@ -11,6 +11,7 @@ from configs import (  # noqa: E402
     config3_sequence_throughput,
     config4_ltv_batch_throughput,
     config5_training_throughput,
+    config6_wallet_ops,
 )
 
 
@@ -38,3 +39,11 @@ def test_config4_runs():
 def test_config5_runs():
     r = config5_training_throughput(steps=3, batch_size=128)
     assert r["value"] > 0
+
+
+def test_config6_runs():
+    r = config6_wallet_ops(n_threads=2, cycles=4)
+    assert r["value"] > 0 and r["unit"] == "ops/s"
+    assert r["errors"] == 0 and r["store_errors"] == 0
+    assert r["store_ops_per_sec"] > 0
+    assert r["ops"] == 2 * 4 * 3  # threads x cycles x ops-per-cycle

@@ -1,0 +1,185 @@
+"""Migration-runner logic against a ledger-simulating fake executor.
+
+The fake models exactly what the runner depends on: the
+schema_migrations ledger (SELECT/INSERT/DELETE) and transaction
+boundaries — committed ledger ops persist, rolled-back ones vanish. DDL
+side effects are not modeled (the live Postgres suite in test_pgwire.py
+covers real application via PostgresStore's boot path, which now runs
+the same migrations).
+"""
+
+import pytest
+
+from igaming_platform_tpu.platform.migrations import (
+    MIGRATIONS,
+    MigrationRunner,
+)
+
+
+class _Cursor:
+    def __init__(self, rows):
+        self._rows = rows
+
+    def fetchall(self):
+        return self._rows
+
+    def fetchone(self):
+        return self._rows[0] if self._rows else None
+
+
+class FakeConn:
+    """PgConnection-shaped executor that simulates only the ledger."""
+
+    def __init__(self, fail_on: str | None = None):
+        self.applied: dict[int, str] = {}
+        self.statements: list[str] = []  # every execute/_simple, in order
+        self.simple_calls: list[str] = []
+        self.fail_on = fail_on
+        self._txn_ops: list[tuple[str, tuple]] = []
+        self._in_txn = False
+        self.commits = 0
+        self.rollbacks = 0
+
+    def execute(self, sql: str, params: tuple = ()):
+        self.statements.append(sql.strip())
+        if self.fail_on and self.fail_on in sql:
+            raise RuntimeError(f"injected failure on {self.fail_on!r}")
+        head = " ".join(sql.split()).upper()
+        if head.startswith("SELECT VERSION FROM SCHEMA_MIGRATIONS"):
+            return _Cursor([(v,) for v in sorted(self.applied)])
+        if head.startswith("INSERT INTO SCHEMA_MIGRATIONS"):
+            self._txn_ops.append(("insert", params))
+        elif head.startswith("DELETE FROM SCHEMA_MIGRATIONS"):
+            self._txn_ops.append(("delete", params))
+        return _Cursor([])
+
+    def _simple(self, sql: str) -> None:
+        self.statements.append(sql.strip())
+        self.simple_calls.append(sql.strip())
+        if self.fail_on and self.fail_on in sql:
+            raise RuntimeError(f"injected failure on {self.fail_on!r}")
+
+    def begin(self) -> None:
+        self._in_txn = True
+        self._txn_ops = []
+
+    def commit(self) -> None:
+        for op, params in self._txn_ops:
+            if op == "insert":
+                self.applied[int(params[0])] = str(params[1])
+            else:
+                self.applied.pop(int(params[0]), None)
+        self._txn_ops = []
+        self._in_txn = False
+        self.commits += 1
+
+    def rollback(self) -> None:
+        self._txn_ops = []
+        self._in_txn = False
+        self.rollbacks += 1
+
+
+def test_history_invariants():
+    versions = [m.version for m in MIGRATIONS]
+    assert versions == sorted(versions)
+    assert len(set(versions)) == len(versions)
+    assert versions[0] == 1
+    assert len({m.name for m in MIGRATIONS}) == len(MIGRATIONS)
+    for m in MIGRATIONS:
+        assert m.up.strip() or m.up_simple.strip(), m.name
+        assert m.down.strip(), m.name  # every migration is revertible
+    # Every table the repository layer touches exists in some migration.
+    all_up = " ".join(m.up + m.up_simple for m in MIGRATIONS)
+    for table in ("accounts", "transactions", "ledger_entries",
+                  "event_outbox", "audit_log", "processed_deliveries"):
+        assert f"CREATE TABLE IF NOT EXISTS {table}" in all_up, table
+
+
+def test_up_applies_all_in_order_once():
+    conn = FakeConn()
+    ran = MigrationRunner(conn).up()
+    assert ran == [m.version for m in MIGRATIONS]
+    assert sorted(conn.applied) == ran
+    assert conn.commits == len(MIGRATIONS)
+    # Idempotent: a second run applies nothing.
+    assert MigrationRunner(conn).up() == []
+
+
+def test_up_resumes_from_partial_state():
+    conn = FakeConn()
+    conn.applied = {1: "core_money_tables", 2: "event_outbox"}
+    assert MigrationRunner(conn).up() == [3, 4, 5]
+
+
+def test_up_to_target_stops_there():
+    conn = FakeConn()
+    assert MigrationRunner(conn).up(target=3) == [1, 2, 3]
+    assert sorted(conn.applied) == [1, 2, 3]
+    with pytest.raises(ValueError):
+        MigrationRunner(conn).up(target=99)
+
+
+def test_down_reverts_in_reverse_order():
+    conn = FakeConn()
+    runner = MigrationRunner(conn)
+    runner.up()
+    assert runner.down(3) == [5, 4]
+    assert sorted(conn.applied) == [1, 2, 3]
+    assert runner.down(0) == [3, 2, 1]
+    assert conn.applied == {}
+    with pytest.raises(ValueError):
+        runner.down(42)
+
+
+def test_failed_migration_rolls_back_and_is_not_recorded():
+    conn = FakeConn(fail_on="audit_log")
+    with pytest.raises(RuntimeError):
+        MigrationRunner(conn).up()
+    # v1 and v2 committed; v3 rolled back, nothing after it attempted.
+    assert sorted(conn.applied) == [1, 2]
+    assert conn.rollbacks == 1
+    # Clearing the fault resumes cleanly from v3.
+    conn.fail_on = None
+    assert MigrationRunner(conn).up() == [3, 4, 5]
+
+
+def test_trigger_migration_uses_simple_protocol():
+    """plpgsql bodies contain ';' — they must go through the simple-query
+    batch, not the split-on-semicolon extended path."""
+    conn = FakeConn()
+    MigrationRunner(conn).up()
+    assert any("accounts_version_backstop" in s for s in conn.simple_calls)
+    # And the split path never saw a bare plpgsql fragment.
+    for s in conn.statements:
+        if s not in conn.simple_calls:
+            assert "LANGUAGE plpgsql" not in s
+
+
+def test_status_reflects_ledger():
+    conn = FakeConn()
+    runner = MigrationRunner(conn)
+    runner.up(target=2)
+    status = runner.status()
+    assert [(v, applied) for v, _, applied in status] == [
+        (1, True), (2, True), (3, False), (4, False), (5, False)]
+
+
+def test_runs_are_bracketed_by_advisory_lock():
+    """Concurrent service boots against one DATABASE_URL must serialize:
+    the run takes the session advisory lock BEFORE reading the ledger and
+    releases it after (golang-migrate's guard for the same race)."""
+    conn = FakeConn()
+    runner = MigrationRunner(conn)
+    runner.up()
+    stmts = conn.statements
+    i_lock = next(i for i, s in enumerate(stmts) if "pg_advisory_lock" in s)
+    i_read = next(i for i, s in enumerate(stmts)
+                  if s.upper().startswith("SELECT VERSION FROM SCHEMA_MIGRATIONS"))
+    i_unlock = next(i for i, s in enumerate(stmts) if "pg_advisory_unlock" in s)
+    assert i_lock < i_read < i_unlock
+    # down() takes the same lock.
+    before = len(conn.statements)
+    runner.down(0)
+    tail = conn.statements[before:]
+    assert any("pg_advisory_lock" in s for s in tail)
+    assert any("pg_advisory_unlock" in s for s in tail)
