@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-fast bench bench-all eval native proto run-risk run-wallet dryrun clean soak soak-wire api-test migrate-up migrate-down migrate-status
+.PHONY: all test test-fast bench bench-all eval native proto run-risk run-wallet dryrun clean soak soak-wire api-test migrate-up migrate-down migrate-status seed
 
 all: native test
 
@@ -43,6 +43,10 @@ migrate-down:
 migrate-status:
 	$(PY) -m igaming_platform_tpu.platform.migrations '$(DATABASE_URL)' status
 
+# Dev fixture accounts through the real pipeline (SQLITE_PATH or DATABASE_URL).
+seed:
+	$(PY) -m igaming_platform_tpu.platform.seed
+
 # Model quality on labeled synthetic fraud: trains multitask + GBDT and
 # writes EVAL.json (AUC / PR / calibration; trained > mock > rules).
 # The model-validate capability of the reference Makefile:215-225.
@@ -57,7 +61,8 @@ native:
 proto:
 	protoc -I proto --python_out=igaming_platform_tpu/proto_gen \
 	  proto/risk/v1/risk.proto proto/wallet/v1/wallet.proto \
-	  proto/grpc/health/v1/health.proto
+	  proto/grpc/health/v1/health.proto \
+	  proto/grpc/reflection/v1alpha/reflection.proto
 
 # Service processes.
 run-risk:

@@ -1,0 +1,83 @@
+"""Dev/demo seed accounts.
+
+The reference seeds three test accounts (one with VIP-scale balances)
+straight into the database (deploy/init-db.sql:243-247 — raw INSERTs,
+so the seeded rows have no transactions or ledger entries behind them).
+Here the same fixture runs through the real service pipeline: accounts
+are created and funded via WalletService, so every seeded balance is
+backed by a transaction row and double-entry ledger entries and the
+store passes reconciliation (`platform/reconcile.py`) from the first
+sweep.
+
+Idempotent: create_account replays on player_id, deposits replay on
+fixed idempotency keys — running `make seed` twice changes nothing.
+
+Usage:
+    python -m igaming_platform_tpu.platform.seed            # in-memory demo
+    SQLITE_PATH=dev.db python -m igaming_platform_tpu.platform.seed
+    DATABASE_URL=postgres://... python -m igaming_platform_tpu.platform.seed
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# player_id -> (currency, opening balance in cents)
+SEED_ACCOUNTS: dict[str, tuple[str, int]] = {
+    "demo-player": ("USD", 75_000),       # $750 regular player
+    "demo-vip": ("USD", 4_200_000),       # $42k VIP
+    "demo-fresh": ("USD", 0),             # brand-new account, never funded
+}
+
+
+def seed(wallet) -> list[tuple[str, str, int]]:
+    """Create/fund the fixture accounts through the service pipeline.
+    Returns (player_id, account_id, total_balance) rows."""
+    out = []
+    for player_id, (currency, opening) in SEED_ACCOUNTS.items():
+        account = wallet.create_account(player_id, currency=currency)
+        if opening > 0:
+            wallet.deposit(account.id, opening, f"seed-{player_id}",
+                           reference="seed fixture")
+        current = wallet.get_balance(account.id)
+        out.append((player_id, account.id, current.balance + current.bonus))
+    return out
+
+
+def main() -> int:
+    from igaming_platform_tpu.platform.outbox import OutboxPublisher
+    from igaming_platform_tpu.platform.wallet import WalletService
+
+    # Same DATABASE_URL contract as the wallet server (platform/server.py):
+    # postgres:// selects the store of record, sqlite://path a file store.
+    url = os.environ.get("DATABASE_URL", "")
+    sqlite_path = os.environ.get("SQLITE_PATH", "")
+    if url.startswith("postgres://") or url.startswith("postgresql://"):
+        from igaming_platform_tpu.platform.pg_store import PostgresStore
+
+        store = PostgresStore(url)
+        label = "postgres"
+    elif url.startswith("sqlite://") and url != "sqlite://:memory:":
+        from igaming_platform_tpu.platform.repository import SQLiteStore
+
+        store = SQLiteStore(url.removeprefix("sqlite://"))
+        label = url
+    else:
+        from igaming_platform_tpu.platform.repository import SQLiteStore
+
+        store = SQLiteStore(sqlite_path or ":memory:")
+        label = sqlite_path or ":memory: (set SQLITE_PATH or DATABASE_URL to persist)"
+    wallet = WalletService(
+        store.accounts, store.transactions, store.ledger,
+        events=OutboxPublisher(store), audit=store.audit,
+    )
+    for player_id, account_id, total in seed(wallet):
+        print(f"{player_id:12s}  {account_id}  balance={total}")
+    print(f"seeded {len(SEED_ACCOUNTS)} accounts into {label}")
+    store.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

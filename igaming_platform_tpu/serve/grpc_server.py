@@ -29,6 +29,7 @@ import grpc
 
 from igaming_platform_tpu.obs.metrics import ServiceMetrics
 from igaming_platform_tpu.obs.tracing import span
+from igaming_platform_tpu.serve.reflection import reflection_handler
 from igaming_platform_tpu.serve.wire import RawProtoMessage, native_wire_available
 
 # Lazily resolved on the first ScoreBatch (native_wire_available may build
@@ -761,6 +762,8 @@ def serve_risk(service: RiskGrpcService, port: int, max_workers: int = 32):
     server.add_generic_rpc_handlers((
         _generic_handler("risk.v1.RiskService", service, _RISK_METHODS, service.metrics),
         _health_handler(health),
+        # grpcurl-without-protos parity (risk/cmd/main.go:150).
+        reflection_handler(("risk.v1.RiskService", "grpc.health.v1.Health")),
     ))
     bound = server.add_insecure_port(f"[::]:{port}")
     server.start()
@@ -773,6 +776,8 @@ def serve_wallet(service: WalletGrpcService, port: int, max_workers: int = 32):
     server.add_generic_rpc_handlers((
         _generic_handler("wallet.v1.WalletService", service, _WALLET_METHODS, service.metrics),
         _health_handler(health),
+        # grpcurl-without-protos parity (wallet/cmd/main.go:154).
+        reflection_handler(("wallet.v1.WalletService", "grpc.health.v1.Health")),
     ))
     bound = server.add_insecure_port(f"[::]:{port}")
     server.start()

@@ -1,0 +1,117 @@
+"""gRPC server reflection (v1alpha), served in-tree.
+
+The reference registers reflection on both servers so grpcurl can drive
+the API without local proto files (wallet/cmd/main.go:154,
+risk/cmd/main.go:150 — its README's grpcurl examples depend on it). The
+image ships no grpcio-reflection package, so the protocol is implemented
+directly: every request kind reduces to "find a FileDescriptor in the
+generated descriptor pool, return its serialized FileDescriptorProto
+plus transitive dependencies".
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from igaming_platform_tpu.proto_gen.grpc.reflection.v1alpha import reflection_pb2
+
+SERVICE_NAME = "grpc.reflection.v1alpha.ServerReflection"
+
+_NOT_FOUND = 5        # grpc.StatusCode.NOT_FOUND.value[0]
+_UNIMPLEMENTED = 12
+
+
+def _file_and_deps(fd) -> list[bytes]:
+    """Serialized FileDescriptorProto of ``fd`` and its transitive deps —
+    grpcurl needs the full closure to decode messages (e.g. risk.proto
+    pulls in google/protobuf/timestamp.proto)."""
+    out: list[bytes] = []
+    seen: set[str] = set()
+    stack = [fd]
+    while stack:
+        f = stack.pop()
+        if f.name in seen:
+            continue
+        seen.add(f.name)
+        out.append(f.serialized_pb)
+        stack.extend(f.dependencies)
+    return out
+
+
+class ReflectionServicer:
+    """Bidi-streaming handler: one response per request, any order."""
+
+    def __init__(self, service_names: tuple[str, ...]):
+        from google.protobuf import descriptor_pool
+
+        self._services = tuple(service_names) + (SERVICE_NAME,)
+        # The default pool: every generated *_pb2 module in the process
+        # registered its file here at import time.
+        self._pool = descriptor_pool.Default()
+
+    def _find_symbol(self, symbol: str):
+        """The Python pool indexes files/messages/enums/services but not
+        methods or fields; grpcurl may ask for e.g.
+        ``risk.v1.RiskService.ScoreTransaction``. Walk up the dotted path
+        until a known parent symbol resolves (grpc-go does the same)."""
+        parts = symbol.split(".")
+        while parts:
+            try:
+                return self._pool.FindFileContainingSymbol(".".join(parts))
+            except KeyError:
+                parts.pop()
+        raise KeyError(symbol)
+
+    def server_reflection_info(self, request_iterator, context):
+        for request in request_iterator:
+            yield self._respond(request)
+
+    def _respond(self, request):
+        resp = reflection_pb2.ServerReflectionResponse(valid_host=request.host)
+        resp.original_request.CopyFrom(request)
+        kind = request.WhichOneof("message_request")
+        try:
+            if kind == "list_services":
+                resp.list_services_response.service.extend(
+                    reflection_pb2.ServiceResponse(name=s) for s in self._services
+                )
+            elif kind == "file_by_filename":
+                fd = self._pool.FindFileByName(request.file_by_filename)
+                resp.file_descriptor_response.file_descriptor_proto.extend(
+                    _file_and_deps(fd))
+            elif kind == "file_containing_symbol":
+                fd = self._find_symbol(request.file_containing_symbol)
+                resp.file_descriptor_response.file_descriptor_proto.extend(
+                    _file_and_deps(fd))
+            elif kind == "file_containing_extension":
+                ext = request.file_containing_extension
+                msg = self._pool.FindMessageTypeByName(ext.containing_type)
+                found = self._pool.FindExtensionByNumber(
+                    msg, ext.extension_number)
+                resp.file_descriptor_response.file_descriptor_proto.extend(
+                    _file_and_deps(found.file))
+            elif kind == "all_extension_numbers_of_type":
+                msg = self._pool.FindMessageTypeByName(
+                    request.all_extension_numbers_of_type)
+                resp.all_extension_numbers_response.base_type_name = msg.full_name
+                resp.all_extension_numbers_response.extension_number.extend(
+                    e.number for e in self._pool.FindAllExtensions(msg))
+            else:
+                resp.error_response.error_code = _UNIMPLEMENTED
+                resp.error_response.error_message = "no message_request set"
+        except KeyError:
+            resp.error_response.error_code = _NOT_FOUND
+            resp.error_response.error_message = f"{kind} target not found"
+        return resp
+
+
+def reflection_handler(service_names: tuple[str, ...]) -> grpc.GenericRpcHandler:
+    """Generic handler registering ServerReflectionInfo for a server."""
+    servicer = ReflectionServicer(service_names)
+    method = grpc.stream_stream_rpc_method_handler(
+        servicer.server_reflection_info,
+        request_deserializer=reflection_pb2.ServerReflectionRequest.FromString,
+        response_serializer=reflection_pb2.ServerReflectionResponse.SerializeToString,
+    )
+    return grpc.method_handlers_generic_handler(
+        SERVICE_NAME, {"ServerReflectionInfo": method})
