@@ -43,7 +43,7 @@ migrate-down:
 migrate-status:
 	$(PY) -m igaming_platform_tpu.platform.migrations '$(DATABASE_URL)' status
 
-# Dev fixture accounts through the real pipeline (SQLITE_PATH or DATABASE_URL).
+# Dev fixture accounts through the real pipeline (DATABASE_URL, as run-wallet).
 seed:
 	$(PY) -m igaming_platform_tpu.platform.seed
 

@@ -158,6 +158,33 @@ def config3_sequence_throughput(batch: int = 64, seq_len: int = 256, iters: int 
 
     from igaming_platform_tpu.ops.pallas.flash_attention import supports as flash_supports
 
+    # Extra-long point: S=8192 (32x the short config) — the "event
+    # histories longer than one chip's HBM slice would allow densely"
+    # regime the flash kernel exists for. TPU-only by default: the CPU
+    # einsum fallback would time an S^2 matmul instead of the kernel.
+    import os as _os
+
+    xlong_s = int(_os.environ.get("BENCH_SEQ_XLONG_S", 8192))
+    xlong: dict = {}
+    if xlong_s and (jax.default_backend() == "tpu"
+                    or _os.environ.get("BENCH_SEQ_XLONG_FORCE") == "1"):
+        xb = 2
+        x_xl = np.random.default_rng(2).normal(
+            size=(xb, xlong_s, EVENT_DIM)).astype(np.float32)
+        jax.block_until_ready(fn(params, x_xl))
+        t0 = time.perf_counter()
+        xl_iters = 3
+        for _ in range(xl_iters):
+            out = fn(params, x_xl)
+        jax.block_until_ready(out)
+        xl_elapsed = time.perf_counter() - t0
+        xlong = {
+            "xlong_seq_len": xlong_s,
+            "xlong_batch": xb,
+            "xlong_tokens_per_sec": round(
+                xb * xlong_s * xl_iters / xl_elapsed, 1),
+        }
+
     return {
         "metric": "abuse_sequences_per_sec",
         "value": round(batch * iters / elapsed, 1),
@@ -168,6 +195,7 @@ def config3_sequence_throughput(batch: int = 64, seq_len: int = 256, iters: int 
         "long_batch": long_batch,
         "long_sequences_per_sec": round(long_batch * long_iters / long_elapsed, 1),
         "long_tokens_per_sec": round(long_batch * long_s * long_iters / long_elapsed, 1),
+        **xlong,
         # True only when the Pallas kernel actually ran: dispatch also
         # gates on the TPU backend (sequence.py takes the XLA einsum path
         # elsewhere), so a CPU run must not attribute its number to flash.

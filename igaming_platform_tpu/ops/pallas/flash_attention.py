@@ -2,10 +2,21 @@
 
 The XLA dense path (`models/sequence._dense_attention`) materialises the
 [S, S] score matrix in HBM per head — at S=2048 that is 4 M floats per
-(batch, head) touched twice, pure HBM bandwidth. This kernel never leaves
-VMEM: each grid step owns one query block, streams KV blocks through the
-MXU, and folds them into a running online-softmax accumulator
-(max / normaliser / weighted sum), so memory is O(S·Dh) instead of O(S²).
+(batch, head) touched twice, pure HBM bandwidth. This module computes the
+same attention as a running online softmax (max / normaliser / weighted
+sum) that never leaves VMEM, in two variants picked by sequence length:
+
+- **resident** (S <= _RESIDENT_MAX_S): grid (batch·head, q block), each
+  (batch·head)'s whole [S, Dh] K/V sits in VMEM across its query blocks
+  and an in-kernel loop streams it through the MXU. Fewest grid steps —
+  fastest — but Dh lane-pads to 128, so the KV footprint grows with S
+  and past ~4k the double-buffered copies blow the 16 MB scoped-VMEM
+  budget (observed compile-time OOM at S=8192).
+- **tiled** (longer S): grid (batch·head, q block, kv block) with the
+  accumulator in VMEM scratch carried across the sequential kv sweep.
+  Resident memory is O(block·Dh), independent of S — S=8192/32k compile
+  and run; ~more grid-step overhead, which is why it isn't the default
+  for short sequences.
 
 This is the intra-chip core; across chips the ring/Ulysses strategies of
 models/sequence.py shard S over the `seq` mesh axis and this kernel runs
@@ -24,12 +35,20 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 
+# The resident-KV variant holds each (batch·head)'s whole [S, Dh] K and V
+# in VMEM across its query blocks — far fewer grid steps, so it wins while
+# it fits. Dh lane-pads to 128, so K+V double-buffered cost is
+# S·128·4·4 bytes; 4096 keeps that at 8 MB, half the scoped-VMEM budget.
+# Beyond it the KV-tiled variant (O(block) memory, S-independent) takes over.
+_RESIDENT_MAX_S = 4096
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float):
+
+def _kernel_resident(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float):
     q = q_ref[0]  # [bq, dh]
     s_total = k_ref.shape[1]
     bq, dh = q.shape
@@ -55,10 +74,10 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float):
 
 
 @functools.partial(jax.jit, static_argnames=("block_q", "block_k", "interpret"))
-def _run(q, k, v, *, block_q, block_k, interpret):
+def _run_resident(q, k, v, *, block_q, block_k, interpret):
     bh, s, dh = q.shape
     kernel = functools.partial(
-        _kernel, block_k=block_k, scale=1.0 / math.sqrt(dh)
+        _kernel_resident, block_k=block_k, scale=1.0 / math.sqrt(dh)
     )
     return pl.pallas_call(
         kernel,
@@ -70,6 +89,65 @@ def _run(q, k, v, *, block_q, block_k, interpret):
             pl.BlockSpec((1, s, dh), lambda i, j: (i, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, dh), lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _kernel_tiled(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  scale: float, nk: int):
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr[...], -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr[...])
+        acc_scr[...] = jnp.zeros_like(acc_scr[...])
+
+    q = q_ref[0]  # [bq, dh]
+    k = k_ref[0]  # [bk, dh]
+    v = v_ref[0]
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale  # MXU
+
+    m = m_scr[...]   # [bq, 1]
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m - m_new)
+    m_scr[...] = m_new
+    l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[...] = acc_scr[...] * corr + jnp.dot(
+        p, v, preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[...] / l_scr[...]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_k", "interpret"))
+def _run_tiled(q, k, v, *, block_q, block_k, interpret):
+    bh, s, dh = q.shape
+    nk = s // block_k
+    kernel = functools.partial(
+        _kernel_tiled, scale=1.0 / math.sqrt(dh), nk=nk
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        # KV tiles iterate in the LAST grid dim so the output block and
+        # scratch stay resident across the sequential sweep.
+        grid=(bh, s // block_q, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dh), lambda i, jq, jk: (i, jq, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda i, jq, jk: (i, jk, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda i, jq, jk: (i, jk, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dh), lambda i, jq, jk: (i, jq, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running normaliser
+            pltpu.VMEM((block_q, dh), jnp.float32),  # weighted-sum acc
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
 
@@ -108,7 +186,8 @@ def flash_attention(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    out = _run(
+    run = _run_resident if s <= _RESIDENT_MAX_S else _run_tiled
+    out = run(
         q.reshape(b * h, s, dh), k.reshape(b * h, s, dh), v.reshape(b * h, s, dh),
         block_q=bq, block_k=bk, interpret=interpret,
     )

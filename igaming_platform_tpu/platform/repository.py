@@ -265,6 +265,23 @@ def uow_of(repo):
     return getattr(store_of(repo), "unit_of_work", None)
 
 
+def store_from_url(url: str):
+    """DATABASE_URL -> store instance, or None for the in-memory repos
+    (empty/unknown scheme). The single dispatch shared by the wallet
+    server and `make seed`, so the two entry points cannot drift."""
+    if url.startswith(("postgres://", "postgresql://")):
+        # Production store of record (postgres.go over the pure-Python
+        # wire client; schema migrations applied at boot).
+        from igaming_platform_tpu.platform.pg_store import PostgresStore
+
+        return PostgresStore(url)
+    if url.startswith("sqlite://") and url != "sqlite://:memory:":
+        return SQLiteStore(url.removeprefix("sqlite://"))
+    if url == "sqlite://:memory:":
+        return SQLiteStore()
+    return None
+
+
 # ---------------------------------------------------------------------------
 # SQLite implementation (durable single-file deployment)
 # ---------------------------------------------------------------------------

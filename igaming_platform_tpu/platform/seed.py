@@ -12,9 +12,9 @@ sweep.
 Idempotent: create_account replays on player_id, deposits replay on
 fixed idempotency keys — running `make seed` twice changes nothing.
 
-Usage:
+Usage (same DATABASE_URL contract as the wallet server):
     python -m igaming_platform_tpu.platform.seed            # in-memory demo
-    SQLITE_PATH=dev.db python -m igaming_platform_tpu.platform.seed
+    DATABASE_URL=sqlite://dev.db python -m igaming_platform_tpu.platform.seed
     DATABASE_URL=postgres://... python -m igaming_platform_tpu.platform.seed
 """
 
@@ -49,25 +49,17 @@ def main() -> int:
     from igaming_platform_tpu.platform.outbox import OutboxPublisher
     from igaming_platform_tpu.platform.wallet import WalletService
 
-    # Same DATABASE_URL contract as the wallet server (platform/server.py):
-    # postgres:// selects the store of record, sqlite://path a file store.
+    from igaming_platform_tpu.platform.repository import SQLiteStore, store_from_url
+
+    # EXACTLY the wallet server's DATABASE_URL dispatch (one shared
+    # helper), so what seed writes is what the server will read.
     url = os.environ.get("DATABASE_URL", "")
-    sqlite_path = os.environ.get("SQLITE_PATH", "")
-    if url.startswith("postgres://") or url.startswith("postgresql://"):
-        from igaming_platform_tpu.platform.pg_store import PostgresStore
-
-        store = PostgresStore(url)
-        label = "postgres"
-    elif url.startswith("sqlite://") and url != "sqlite://:memory:":
-        from igaming_platform_tpu.platform.repository import SQLiteStore
-
-        store = SQLiteStore(url.removeprefix("sqlite://"))
+    store = store_from_url(url)
+    if store is not None:
         label = url
     else:
-        from igaming_platform_tpu.platform.repository import SQLiteStore
-
-        store = SQLiteStore(sqlite_path or ":memory:")
-        label = sqlite_path or ":memory: (set SQLITE_PATH or DATABASE_URL to persist)"
+        store = SQLiteStore()  # throwaway demo run
+        label = ":memory: (set DATABASE_URL=sqlite://… or postgres://… to persist)"
     wallet = WalletService(
         store.accounts, store.transactions, store.ledger,
         events=OutboxPublisher(store), audit=store.audit,

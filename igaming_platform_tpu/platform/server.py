@@ -21,7 +21,7 @@ from igaming_platform_tpu.platform.repository import (
     InMemoryAccountRepository,
     InMemoryLedgerRepository,
     InMemoryTransactionRepository,
-    SQLiteStore,
+    store_from_url,
 )
 from igaming_platform_tpu.platform.wallet import WalletConfig, WalletService
 from igaming_platform_tpu.platform.outbox import InMemoryOutbox, OutboxPublisher, OutboxRelay
@@ -53,28 +53,12 @@ class WalletServer:
         # in-process broker so single-binary runs need no infra.
         self.broker = resolve_transport(broker, self.config.rabbitmq_url)
 
-        url = self.config.database_url
-        if url.startswith(("postgres://", "postgresql://")):
-            # Production store of record (postgres.go over the pure-Python
-            # wire client; schema + trigger backstops bootstrapped).
-            from igaming_platform_tpu.platform.pg_store import PostgresStore
-
-            self.store = PostgresStore(url)
-            accounts, transactions, ledger = (
-                self.store.accounts, self.store.transactions, self.store.ledger
-            )
-        elif url.startswith("sqlite://") and url != "sqlite://:memory:":
-            self.store = SQLiteStore(url.removeprefix("sqlite://"))
-            accounts, transactions, ledger = (
-                self.store.accounts, self.store.transactions, self.store.ledger
-            )
-        elif url == "sqlite://:memory:":
-            self.store = SQLiteStore()
+        self.store = store_from_url(self.config.database_url)
+        if self.store is not None:
             accounts, transactions, ledger = (
                 self.store.accounts, self.store.transactions, self.store.ledger
             )
         else:
-            self.store = None
             accounts = InMemoryAccountRepository()
             transactions = InMemoryTransactionRepository()
             ledger = InMemoryLedgerRepository()

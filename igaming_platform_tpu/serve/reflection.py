@@ -52,14 +52,32 @@ class ReflectionServicer:
     def _find_symbol(self, symbol: str):
         """The Python pool indexes files/messages/enums/services but not
         methods or fields; grpcurl may ask for e.g.
-        ``risk.v1.RiskService.ScoreTransaction``. Walk up the dotted path
-        until a known parent symbol resolves (grpc-go does the same)."""
-        parts = symbol.split(".")
-        while parts:
-            try:
-                return self._pool.FindFileContainingSymbol(".".join(parts))
-            except KeyError:
-                parts.pop()
+        ``risk.v1.RiskService.ScoreTransaction``. Resolve the parent and
+        verify the leaf is a real member — a bogus leaf must stay
+        NOT_FOUND, not silently succeed via its parent."""
+        try:
+            return self._pool.FindFileContainingSymbol(symbol)
+        except KeyError:
+            pass
+        parent, _, leaf = symbol.rpartition(".")
+        if not parent:
+            raise KeyError(symbol)
+        try:
+            svc = self._pool.FindServiceByName(parent)
+        except KeyError:
+            pass
+        else:
+            if leaf in svc.methods_by_name:
+                return svc.file
+            raise KeyError(symbol)
+        try:
+            msg = self._pool.FindMessageTypeByName(parent)
+        except KeyError:
+            raise KeyError(symbol) from None
+        if (leaf in msg.fields_by_name or leaf in msg.nested_types_by_name
+                or leaf in msg.enum_types_by_name
+                or leaf in msg.oneofs_by_name):
+            return msg.file
         raise KeyError(symbol)
 
     def server_reflection_info(self, request_iterator, context):

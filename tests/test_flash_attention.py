@@ -70,3 +70,46 @@ def test_sequence_model_unchanged_on_cpu():
     out = sequence_forward(params, x, cfg)
     assert out["abuse"].shape == (2,)
     assert np.all((np.asarray(out["abuse"]) >= 0) & (np.asarray(out["abuse"]) <= 1))
+
+
+def test_tiled_variant_matches_dense():
+    """The long-sequence (KV-tiled, scratch-carried) variant must agree
+    with dense exactly like the resident variant does. Exercised directly
+    at small S so interpret mode stays fast; on TPU it is what runs past
+    _RESIDENT_MAX_S (the S=8192 regime that OOMed the resident kernel's
+    scoped VMEM)."""
+    from igaming_platform_tpu.ops.pallas.flash_attention import _run_tiled
+
+    rng = np.random.default_rng(7)
+    b, h, s, dh = 2, 3, 512, 16
+    q = jnp.asarray(rng.normal(size=(b * h, s, dh)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b * h, s, dh)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b * h, s, dh)), jnp.float32)
+    out = _run_tiled(q, k, v, block_q=128, block_k=128, interpret=True)
+    ref = dense(q.reshape(b, h, s, dh), k.reshape(b, h, s, dh),
+                v.reshape(b, h, s, dh)).reshape(b * h, s, dh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_variant_selection_by_length(monkeypatch):
+    """Pin flash_attention's ACTUAL dispatch: resident up to
+    _RESIDENT_MAX_S (past it the resident kernel compile-OOMs scoped VMEM
+    on TPU), tiled beyond."""
+    from igaming_platform_tpu.ops.pallas import flash_attention as fa
+
+    calls = []
+
+    def fake(which):
+        def run(q, k, v, *, block_q, block_k, interpret):
+            calls.append(which)
+            return q
+
+        return run
+
+    monkeypatch.setattr(fa, "_run_resident", fake("resident"))
+    monkeypatch.setattr(fa, "_run_tiled", fake("tiled"))
+    for s, expect in ((256, "resident"), (4096, "resident"), (8192, "tiled")):
+        q = jnp.zeros((1, 1, s, 16), jnp.float32)
+        fa.flash_attention(q, q, q, interpret=True)
+        assert calls[-1] == expect, s

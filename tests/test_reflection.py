@@ -87,3 +87,15 @@ def test_unknown_symbol_is_not_found_not_an_rpc_error(reflect):
 def test_empty_request_is_unimplemented(reflect):
     resp = reflect()
     assert resp.error_response.error_code == 12
+
+
+def test_bogus_leaf_under_known_parent_is_not_found(reflect):
+    """A nonexistent method/field under a real service/message must be
+    NOT_FOUND — the parent walk-up may not vouch for children it doesn't
+    have."""
+    for symbol in ("risk.v1.RiskService.NoSuchMethod",
+                   "risk.v1.ScoreTransactionRequest.no_such_field",
+                   "risk.v1.NoSuchMessage.whatever"):
+        resp = reflect(file_containing_symbol=symbol)
+        assert resp.WhichOneof("message_response") == "error_response", symbol
+        assert resp.error_response.error_code == 5  # NOT_FOUND
