@@ -56,7 +56,13 @@ def main() -> int:
     url = os.environ.get("DATABASE_URL", "")
     store = store_from_url(url)
     if store is not None:
-        label = url
+        # Redact userinfo — DATABASE_URL carries credentials and this
+        # line lands in terminal scrollback and CI logs.
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(url)
+        host = parts.hostname or ""
+        label = f"{parts.scheme}://{host}{parts.path}" if parts.scheme else url
     else:
         store = SQLiteStore()  # throwaway demo run
         label = ":memory: (set DATABASE_URL=sqlite://… or postgres://… to persist)"

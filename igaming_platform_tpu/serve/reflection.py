@@ -73,11 +73,19 @@ class ReflectionServicer:
         try:
             msg = self._pool.FindMessageTypeByName(parent)
         except KeyError:
+            pass
+        else:
+            if (leaf in msg.fields_by_name or leaf in msg.nested_types_by_name
+                    or leaf in msg.enum_types_by_name
+                    or leaf in msg.oneofs_by_name):
+                return msg.file
+            raise KeyError(symbol)
+        try:
+            enum = self._pool.FindEnumTypeByName(parent)
+        except KeyError:
             raise KeyError(symbol) from None
-        if (leaf in msg.fields_by_name or leaf in msg.nested_types_by_name
-                or leaf in msg.enum_types_by_name
-                or leaf in msg.oneofs_by_name):
-            return msg.file
+        if leaf in enum.values_by_name:
+            return enum.file
         raise KeyError(symbol)
 
     def server_reflection_info(self, request_iterator, context):

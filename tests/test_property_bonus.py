@@ -1,0 +1,192 @@
+"""Randomized property test: bonus-engine wagering/lifecycle invariants.
+
+Companion to test_property_wallet.py (SURVEY.md §4's property-test
+contract; reference semantics bonus_engine.go:245-460). Seeded random
+sequences of award / wager / max-bet check / free spins / clock-warp
+expiry / forfeiture run against both bonus repositories, with an
+independent oracle tracking what each bonus's state must be:
+
+- wagering progress equals the oracle's sum of weighted contributions
+  from wagers made while the bonus was ACTIVE, and freezes at a
+  terminal status,
+- statuses only move ACTIVE -> {COMPLETED, EXPIRED, FORFEITED},
+- a bonus COMPLETED exactly when progress reached its requirement,
+- one-time rules award at most once per account,
+- free-spin accounting: used <= total, winnings capped at the rule's
+  max_bonus, wagering requirement re-tracks amount x multiplier,
+- check_max_bet raises exactly when an active bonus's limit is exceeded.
+"""
+
+import numpy as np
+import pytest
+
+from igaming_platform_tpu.core.enums import BonusStatus, BonusType
+from igaming_platform_tpu.platform.bonus import (
+    BonusEngine,
+    BonusRule,
+    InMemoryBonusRepository,
+    MaxBetExceededError,
+    NotEligibleError,
+    SQLiteBonusRepository,
+)
+
+ACCOUNTS = ("p1", "p2", "p3")
+CATEGORIES = ("slots", "table", "live", "other")
+
+
+def make_rules():
+    return [
+        BonusRule(id="match", type=BonusType.DEPOSIT_MATCH, match_percent=50,
+                  max_bonus=20_000, wagering_multiplier=10,
+                  game_weights={"slots": 100, "table": 10, "live": 0},
+                  max_bet_percent=20, expiry_days=7),
+        BonusRule(id="welcome", type=BonusType.DEPOSIT_MATCH, match_percent=100,
+                  max_bonus=50_000, wagering_multiplier=35, one_time=True,
+                  max_bet_absolute=5_000, expiry_days=30),
+        BonusRule(id="spins", type=BonusType.FREE_SPINS, free_spins_count=5,
+                  max_bonus=10_000, wagering_multiplier=20, expiry_days=3),
+    ]
+
+
+def expected_amount(rule: BonusRule, deposit: int) -> int:
+    if rule.type == BonusType.DEPOSIT_MATCH:
+        amount = deposit * rule.match_percent // 100
+        return min(amount, rule.max_bonus) if rule.max_bonus else amount
+    return 0  # free spins start at zero value
+
+
+def contribution(rule: BonusRule, category: str, bet: int) -> int:
+    return bet * rule.game_weights.get(category, 100) // 100
+
+
+@pytest.mark.parametrize("backend", ["memory", "sqlite"])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_bonus_engine_random_sequences(backend, seed, tmp_path):
+    rules = make_rules()
+    by_id = {r.id: r for r in rules}
+    if backend == "sqlite":
+        from igaming_platform_tpu.platform.repository import SQLiteStore
+
+        store = SQLiteStore(str(tmp_path / "bonus.db"))
+        repo = SQLiteBonusRepository(store)
+    else:
+        repo = InMemoryBonusRepository()
+
+    clock = [1_000_000.0]
+    engine = BonusEngine(rules, repo=repo, now_fn=lambda: clock[0])
+
+    rng = np.random.default_rng(seed)
+    # bonus_id -> oracle state dict
+    oracle: dict[str, dict] = {}
+
+    def active_of(account: str):
+        return [o for o in oracle.values()
+                if o["account"] == account and o["status"] == BonusStatus.ACTIVE]
+
+    for _ in range(250):
+        op = rng.choice(["award", "wager", "maxbet", "spin", "warp", "forfeit"],
+                        p=[0.3, 0.35, 0.1, 0.1, 0.1, 0.05])
+        account = str(rng.choice(ACCOUNTS))
+
+        if op == "award":
+            rule = by_id[str(rng.choice(list(by_id)))]
+            deposit = int(rng.integers(0, 60_000))
+            amount = expected_amount(rule, deposit)
+            already = any(o["rule"] is rule for o in oracle.values()
+                          if o["account"] == account)
+            zero_invalid = (amount == 0 and rule.type != BonusType.FREE_SPINS)
+            if (rule.one_time and already) or zero_invalid:
+                with pytest.raises(NotEligibleError):
+                    engine.award_bonus(account, rule.id, deposit_amount=deposit)
+                continue
+            b = engine.award_bonus(account, rule.id, deposit_amount=deposit)
+            assert b.bonus_amount == amount
+            assert b.wagering_required == amount * rule.wagering_multiplier
+            assert b.status == BonusStatus.ACTIVE
+            oracle[b.id] = {
+                "account": account, "rule": rule, "amount": amount,
+                "progress": 0, "required": amount * rule.wagering_multiplier,
+                "status": BonusStatus.ACTIVE, "spins_used": 0,
+                "expires_at": clock[0] + rule.expiry_days * 86400,
+            }
+
+        elif op == "wager":
+            bet = int(rng.integers(1, 8_000))
+            category = str(rng.choice(CATEGORIES))
+            expect_completed = set()
+            for bid, o in oracle.items():
+                if o["account"] != account or o["status"] != BonusStatus.ACTIVE:
+                    continue
+                c = contribution(o["rule"], category, bet)
+                if c == 0:
+                    continue
+                o["progress"] += c
+                if o["progress"] >= o["required"]:
+                    o["status"] = BonusStatus.COMPLETED
+                    expect_completed.add(bid)
+            done = engine.process_wager(account, bet, game_category=category)
+            assert {b.id for b in done} == expect_completed
+
+        elif op == "maxbet":
+            bet = int(rng.integers(1, 30_000))
+            violates = False
+            for o in active_of(account):
+                r = o["rule"]
+                # Engine reads the LIVE bonus amount (grows via free spins).
+                live = repo.get_by_id(next(
+                    bid for bid, oo in oracle.items() if oo is o))
+                if r.max_bet_percent > 0 and bet > live.bonus_amount * r.max_bet_percent // 100:
+                    violates = True
+                if r.max_bet_absolute > 0 and bet > r.max_bet_absolute:
+                    violates = True
+            if violates:
+                with pytest.raises(MaxBetExceededError):
+                    engine.check_max_bet(account, bet)
+            else:
+                engine.check_max_bet(account, bet)
+
+        elif op == "spin":
+            spins = [(bid, o) for bid, o in oracle.items()
+                     if o["rule"].type == BonusType.FREE_SPINS]
+            if not spins:
+                continue
+            bid, o = spins[int(rng.integers(0, len(spins)))]
+            win = int(rng.integers(0, 4_000))
+            rule = o["rule"]
+            if o["status"] != BonusStatus.ACTIVE or o["spins_used"] >= rule.free_spins_count:
+                with pytest.raises(NotEligibleError):
+                    engine.use_free_spin(bid, win_amount=win)
+                continue
+            b = engine.use_free_spin(bid, win_amount=win)
+            o["spins_used"] += 1
+            if win > 0:
+                o["amount"] = min(o["amount"] + win, rule.max_bonus)
+                o["required"] = o["amount"] * rule.wagering_multiplier
+            assert b.free_spins_used == o["spins_used"] <= rule.free_spins_count
+            assert b.bonus_amount == o["amount"] <= rule.max_bonus
+            assert b.wagering_required == o["required"]
+
+        elif op == "warp":
+            clock[0] += float(rng.integers(1, 96)) * 3600.0
+            expect = sum(1 for o in oracle.values()
+                         if o["status"] == BonusStatus.ACTIVE
+                         and o["expires_at"] < clock[0])
+            assert engine.expire_old_bonuses() == expect
+            for o in oracle.values():
+                if o["status"] == BonusStatus.ACTIVE and o["expires_at"] < clock[0]:
+                    o["status"] = BonusStatus.EXPIRED
+
+        elif op == "forfeit":
+            expect = len(active_of(account))
+            assert engine.forfeit_bonuses(account) == expect
+            for o in active_of(account):
+                o["status"] = BonusStatus.FORFEITED
+
+    # Final exact-state audit: every bonus matches its oracle.
+    for bid, o in oracle.items():
+        b = repo.get_by_id(bid)
+        assert b.status == o["status"], bid
+        assert b.wagering_progress == o["progress"], bid
+        assert b.bonus_amount == o["amount"], bid
+        assert b.wagering_required == o["required"], bid
+        assert b.free_spins_used == o["spins_used"], bid

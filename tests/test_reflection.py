@@ -99,3 +99,12 @@ def test_bogus_leaf_under_known_parent_is_not_found(reflect):
         resp = reflect(file_containing_symbol=symbol)
         assert resp.WhichOneof("message_response") == "error_response", symbol
         assert resp.error_response.error_code == 5  # NOT_FOUND
+
+
+def test_enum_value_symbol_resolves(reflect):
+    """Enum-value leaves (e.g. grpcurl describing risk.v1.Action.ACTION_ALLOW)
+    must resolve via their enum parent."""
+    resp = reflect(file_containing_symbol="risk.v1.Action.ACTION_APPROVE")
+    assert resp.WhichOneof("message_response") == "file_descriptor_response"
+    resp = reflect(file_containing_symbol="risk.v1.Action.NO_SUCH_VALUE")
+    assert resp.WhichOneof("message_response") == "error_response"
