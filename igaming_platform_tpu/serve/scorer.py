@@ -149,6 +149,31 @@ class TPUScoringEngine:
         )
         self._mesh = mesh
 
+        # WIRE_DTYPE=bf16 (opt-in): ship feature batches to the device as
+        # bfloat16 — half the host->device bytes; the graph casts back to
+        # float32 on device (make_score_fn's jnp.asarray). Built for
+        # remote/tunneled device links where per-RPC transfer is the e2e
+        # wall and the device itself is ~idle. Off by default because it
+        # is NOT reference-exact: features round to ~3 significant
+        # digits, so a row whose feature sits within that rounding of a
+        # rule threshold can flip that rule — worst case one rule's full
+        # weight, ~20 score points (tests/test_scorer_chunking.py pins
+        # both the typical-row envelope and the threshold-edge flip).
+        # The host latency tier always keeps float32 — no link, no
+        # reason to round.
+        self._wire_dtype: Any = np.float32
+        wire_dtype_env = os.environ.get("WIRE_DTYPE", "").lower()
+        if wire_dtype_env in ("bf16", "bfloat16"):
+            import ml_dtypes
+
+            self._wire_dtype = ml_dtypes.bfloat16
+        elif wire_dtype_env not in ("", "f32", "fp32", "float32"):
+            # A typo here would silently ship float32 while the operator
+            # believes compression is active — fail loudly instead.
+            raise ValueError(
+                f"WIRE_DTYPE={wire_dtype_env!r} not supported "
+                "(use 'bf16' or 'float32')")
+
         fn = make_score_fn(self.config, ml_backend, mesh=mesh)
         # The serving executable returns ONE packed int32 [5, B] array
         # (score / action / reason_mask / rule_score / ml_score-bits)
@@ -251,15 +276,17 @@ class TPUScoringEngine:
         interconnects is far costlier than steady state) so the first
         request doesn't pay either cost."""
         for shape in self._shapes:
-            x = np.zeros((shape, NUM_FEATURES), dtype=np.float32)
+            x = np.zeros((shape, NUM_FEATURES), dtype=self._wire_dtype)
             bl = np.zeros((shape,), dtype=bool)
             out = self._packed_fn(self._params, x, bl, self._thresholds)
             jax.block_until_ready(out)
             jax.device_get(out)
             # Warm every host-tier shape a near-empty flush could pad to.
+            # The host tier always serves float32 (no link to save).
             if self._fn_host is not None and shape <= self._pick_shape(self._host_tier):
+                x32 = np.zeros((shape, NUM_FEATURES), dtype=np.float32)
                 jax.device_get(
-                    self._fn_host(self._params_host, x, bl, self._thresholds_host)
+                    self._fn_host(self._params_host, x32, bl, self._thresholds_host)
                 )
 
     def close(self) -> None:
@@ -345,9 +372,13 @@ class TPUScoringEngine:
         link round-trip at all."""
         n = x.shape[0]
         shape = self._pick_shape(n)
+        use_host = self._fn_host is not None and n <= self._host_tier
+        if not use_host and self._wire_dtype is not np.float32:
+            # Cast BEFORE padding: pad_batch preserves dtype, so the pad
+            # copy is already half-size (WIRE_DTYPE halves H2D bytes).
+            x = x.astype(self._wire_dtype)
         xp, _ = pad_batch(x, shape)
         blp, _ = pad_batch(bl, shape)
-        use_host = self._fn_host is not None and n <= self._host_tier
         with self._params_lock:
             # Snapshot under the lock, dispatch outside it — scoring must
             # never serialize on the params mutex.

@@ -16,3 +16,61 @@ def test_score_batch_exceeding_compiled_size_chunks():
         assert responses[52].features.tx_amount == 152
     finally:
         eng.close()
+
+
+def test_wire_dtype_bf16_typical_rows_and_threshold_edges(monkeypatch):
+    """WIRE_DTYPE=bf16 (opt-in H2D compression for remote device links):
+    typical rows must score identically to the exact float32 path; the
+    known failure mode is a feature landing within bf16 rounding of a
+    rule threshold, where that one rule can flip (worst case its full
+    weighted contribution). The default engine must not round, and bogus
+    WIRE_DTYPE values must fail loudly."""
+    import numpy as np
+    import pytest
+
+    # Amounts away from every rule threshold (bf16 ulp at 1e5 is 512).
+    reqs = [
+        ScoreRequest(f"bf16-{i}", amount=250 + 977 * i,
+                     tx_type=("deposit", "bet", "withdraw")[i % 3])
+        for i in range(200)
+    ]
+    # Rows deliberately INSIDE the rounding band of the large-deposit
+    # threshold (100_000): bf16 rounds 100_050 down across it.
+    edge = [ScoreRequest(f"edge-{i}", amount=100_000 + 50 + i, tx_type="deposit")
+            for i in range(8)]
+
+    monkeypatch.delenv("WIRE_DTYPE", raising=False)
+    eng32 = TPUScoringEngine(batcher_config=BatcherConfig(batch_size=64, max_wait_ms=1))
+    try:
+        assert eng32._wire_dtype is np.float32  # opt-in only
+        base = eng32.score_batch(reqs)
+        base_edge = eng32.score_batch(edge)
+    finally:
+        eng32.close()
+
+    monkeypatch.setenv("WIRE_DTYPE", "bf16")
+    eng16 = TPUScoringEngine(batcher_config=BatcherConfig(batch_size=64, max_wait_ms=1))
+    try:
+        import ml_dtypes
+
+        assert eng16._wire_dtype is ml_dtypes.bfloat16
+        rounded = eng16.score_batch(reqs)
+        edge16 = eng16.score_batch(edge)
+    finally:
+        eng16.close()
+
+    # Away from thresholds: identical decisions, scores within rounding.
+    assert all(a.action == b.action for a, b in zip(base, rounded))
+    assert max(abs(a.score - b.score) for a, b in zip(base, rounded)) <= 3
+
+    # At the threshold edge the flip is real and bounded by one rule's
+    # weighted contribution (large-tx weight 30 x 0.4 rule share = 12).
+    edge_delta = max(abs(a.score - b.score) for a, b in zip(base_edge, edge16))
+    assert edge_delta <= 20, edge_delta
+    for b in edge16:  # still a valid, deterministic decision
+        assert b.action in ("approve", "review", "block")
+
+    monkeypatch.setenv("WIRE_DTYPE", "fp16")  # unsupported -> loud failure
+    with pytest.raises(ValueError):
+        TPUScoringEngine(batcher_config=BatcherConfig(batch_size=16, max_wait_ms=1),
+                         warmup=False)
