@@ -15,7 +15,6 @@ Prints ONE JSON line: {"metric": ..., "value": N, "unit": ...,
 
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -29,42 +28,14 @@ DEVICE_FALLBACK: str | None = None
 
 
 def _ensure_responsive_device(probe_timeout_s: float = 90.0) -> None:
-    """The tunneled dev chip sometimes wedges so hard that jax.devices()
-    blocks FOREVER in every process. Probe it from a killable subprocess
-    first; if it hangs, pin this process to CPU so the bench still
-    produces an (honestly labeled) artifact instead of hanging the
-    driver. Real TPU hosts pass the probe in a second or two."""
+    """Probe the (possibly wedged) device tunnel before touching jax; on
+    a hang, pin to CPU so the bench still produces an honestly-labeled
+    artifact instead of hanging the driver. Logic lives in
+    core/devices.py — shared with eval / ltv-job / soak."""
     global DEVICE_FALLBACK
-    if os.environ.get("BENCH_DEVICE_FALLBACK"):
-        # A parent harness (run_all.py, soak.py) already hit the wedge:
-        # inherit its fallback label so this process's artifact stays
-        # honestly labeled, and skip the (hopeless) re-probe.
-        DEVICE_FALLBACK = os.environ["BENCH_DEVICE_FALLBACK"]
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
+    from igaming_platform_tpu.core.devices import ensure_responsive_device
 
-        jax.config.update("jax_platforms", "cpu")
-        return
-    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
-        return
-    if os.environ.get("BENCH_DEVICE_PROBED") == "1":
-        return  # parent process already probed successfully
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=probe_timeout_s, capture_output=True,
-        )
-        if probe.returncode == 0:
-            os.environ["BENCH_DEVICE_PROBED"] = "1"
-            return
-    except subprocess.TimeoutExpired:
-        pass
-    DEVICE_FALLBACK = "cpu (device tunnel unresponsive)"
-    os.environ["BENCH_DEVICE_FALLBACK"] = DEVICE_FALLBACK
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
+    DEVICE_FALLBACK = ensure_responsive_device(probe_timeout_s)
 
 
 def device_pipeline_numbers() -> dict:

@@ -1,0 +1,54 @@
+"""Device-liveness guard shared by every CLI that may touch the TPU.
+
+The tunneled dev chip sometimes wedges so hard that ``jax.devices()``
+blocks FOREVER in every process (even importing jax then asking for CPU
+is too late — the platform plugin initializes on first device query).
+Any long-running CLI (bench, soak, eval, ltv-job) must probe from a
+killable subprocess FIRST and pin itself to CPU if the probe hangs, so
+it produces an honestly-labeled result instead of hanging its caller.
+
+Probe state propagates to child processes via env so per-config bench
+subprocesses neither re-probe nor lose the fallback label:
+``BENCH_DEVICE_PROBED=1`` (healthy) / ``BENCH_DEVICE_FALLBACK=<label>``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+def _pin_cpu() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def ensure_responsive_device(probe_timeout_s: float = 90.0) -> str | None:
+    """Probe the device from a killable subprocess; on a wedged tunnel,
+    pin this process to CPU. Returns the fallback label (None = healthy
+    or already explicitly CPU)."""
+    if os.environ.get("BENCH_DEVICE_FALLBACK"):
+        # A parent process already hit the wedge: inherit its label and
+        # skip the (hopeless) re-probe.
+        _pin_cpu()
+        return os.environ["BENCH_DEVICE_FALLBACK"]
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        return None
+    if os.environ.get("BENCH_DEVICE_PROBED") == "1":
+        return None  # parent already probed successfully
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=probe_timeout_s, capture_output=True,
+        )
+        if probe.returncode == 0:
+            os.environ["BENCH_DEVICE_PROBED"] = "1"
+            return None
+    except subprocess.TimeoutExpired:
+        pass
+    label = "cpu (device tunnel unresponsive)"
+    os.environ["BENCH_DEVICE_FALLBACK"] = label
+    _pin_cpu()
+    return label

@@ -126,7 +126,16 @@ def main() -> None:
         print("usage: python -m igaming_platform_tpu.serve.ltv_job <wallet.db> [out.json]",
               file=sys.stderr)
         sys.exit(2)
+    # A wedged device tunnel must not hang the batch job (core/devices.py).
+    from igaming_platform_tpu.core.devices import ensure_responsive_device
+
+    fallback = ensure_responsive_device()
     result = run_batch_job(sys.argv[1])
+    import jax
+
+    result["device"] = str(jax.devices()[0])
+    if fallback:
+        result["device_fallback"] = fallback
     payload = json.dumps(result, indent=1)
     if len(sys.argv) > 2:
         with open(sys.argv[2], "w") as f:

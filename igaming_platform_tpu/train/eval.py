@@ -261,13 +261,23 @@ def run_eval(
 def main() -> None:
     import argparse
 
+    from igaming_platform_tpu.core.devices import ensure_responsive_device
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="EVAL.json")
     ap.add_argument("--n-train", type=int, default=60_000)
     ap.add_argument("--n-test", type=int, default=20_000)
     ap.add_argument("--steps", type=int, default=400)
     args = ap.parse_args()
+    # A wedged device tunnel must not hang `make eval` — fall back to an
+    # honestly-labeled CPU run.
+    fallback = ensure_responsive_device()
     result = run_eval(n_train=args.n_train, n_test=args.n_test, steps=args.steps)
+    import jax
+
+    result["device"] = str(jax.devices()[0])
+    if fallback:
+        result["device_fallback"] = fallback
     with open(args.out, "w") as f:
         json.dump(result, f, indent=2)
     print(json.dumps({"models": result["models"], "ordering": result["ordering"]}, indent=2))
