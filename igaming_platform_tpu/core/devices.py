@@ -46,9 +46,12 @@ def ensure_responsive_device(probe_timeout_s: float = 90.0) -> str | None:
         if probe.returncode == 0:
             os.environ["BENCH_DEVICE_PROBED"] = "1"
             return None
+        # Fast failure is NOT a wedge — surface the real cause (driver
+        # crash, bad install) instead of mislabeling it unresponsive.
+        tail = probe.stderr.decode("utf-8", "replace").strip().splitlines()
+        label = f"cpu (device init failed: {tail[-1][:120] if tail else 'rc=' + str(probe.returncode)})"
     except subprocess.TimeoutExpired:
-        pass
-    label = "cpu (device tunnel unresponsive)"
+        label = "cpu (device tunnel unresponsive)"
     os.environ["BENCH_DEVICE_FALLBACK"] = label
     _pin_cpu()
     return label
