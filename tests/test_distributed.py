@@ -1,4 +1,11 @@
-"""Distributed bootstrap (single-process path) + sharded serving engine."""
+"""Distributed bootstrap (single-process path) + sharded serving engine
++ REAL two-OS-process DCN runs (bootstrap, collectives, DP training)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
 
 import numpy as np
 
@@ -12,6 +19,64 @@ from igaming_platform_tpu.parallel.distributed import (
 from igaming_platform_tpu.parallel.mesh import AXIS_DATA, MeshSpec, mesh_axis_size
 from igaming_platform_tpu.serve.feature_store import TransactionEvent
 from igaming_platform_tpu.serve.scorer import ScoreRequest, TPUScoringEngine
+
+# Shared preamble for every spawned worker: pin CPU with 2 virtual
+# devices (NOT pytest's 8 — the env is scrubbed below) and bootstrap
+# through the production env contract.
+_WORKER_PREAMBLE = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["REPO_ROOT"])
+"""
+
+
+def _run_two_workers(tmp_path, body: str, timeout: float = 240.0) -> list[str]:
+    """Spawn two worker processes running PREAMBLE+body with the
+    COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID env contract; returns
+    their outputs, asserting both exited 0."""
+    with socket.socket() as s:  # free coordinator port
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER_PREAMBLE + textwrap.dedent(body))
+
+    env = dict(
+        os.environ,
+        REPO_ROOT=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        COORDINATOR_ADDRESS=f"localhost:{port}",
+        NUM_PROCESSES="2",
+    )
+    # Workers must not inherit pytest's single-process device pinning.
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker)],
+            env={**env, "PROCESS_ID": str(i)},
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        # One dead worker leaves its peer blocked in initialize(); never
+        # abandon live children (they would outlive pytest and hold the
+        # coordinator port — and the bound-then-closed port pick above is
+        # inherently racy, so failures here must clean up after themselves).
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-2000:]}"
+    return outs
 
 
 def test_single_process_noop(monkeypatch):
@@ -58,25 +123,7 @@ def test_two_process_dcn_bootstrap_and_collectives(tmp_path):
     gradient-style reduction plus process_batch_slice sharding — the
     DCN scale-out story executed for real (gloo-backed CPU collectives),
     not simulated on one process."""
-    import os
-    import socket
-    import subprocess
-    import sys
-    import textwrap
-
-    with socket.socket() as s:  # free coordinator port
-        s.bind(("localhost", 0))
-        port = s.getsockname()[1]
-
-    worker = tmp_path / "worker.py"
-    worker.write_text(textwrap.dedent("""
-        import os, sys
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        sys.path.insert(0, os.environ["REPO_ROOT"])
-
+    outs = _run_two_workers(tmp_path, """
         from igaming_platform_tpu.parallel.distributed import (
             global_mesh, initialize_from_env, is_primary, process_batch_slice,
         )
@@ -104,38 +151,64 @@ def test_two_process_dcn_bootstrap_and_collectives(tmp_path):
         got = float(jax.device_get(total))
         assert got == 28.0, got  # sum(0..7): both processes' shards included
         print(f"OK process={jax.process_index()} sum={got}", flush=True)
-    """))
-
-    env = dict(
-        os.environ,
-        REPO_ROOT=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        COORDINATOR_ADDRESS=f"localhost:{port}",
-        NUM_PROCESSES="2",
-    )
-    # Workers must not inherit pytest's single-process device pinning.
-    env.pop("XLA_FLAGS", None)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(worker)],
-            env={**env, "PROCESS_ID": str(i)},
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        )
-        for i in range(2)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=180)
-            outs.append(out)
-    finally:
-        # One dead worker leaves its peer blocked in initialize(); never
-        # abandon live children (they would outlive pytest and hold the
-        # coordinator port — and the bound-then-closed port pick above is
-        # inherently racy, so failures here must clean up after themselves).
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.communicate()
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"worker {i} failed:\n{out[-2000:]}"
+    """, timeout=180)
+    for i, out in enumerate(outs):
         assert f"OK process={i}" in out, out[-500:]
+
+
+def test_two_process_dp_training_matches_single_process(tmp_path):
+    """DP gradient sync over REAL process boundaries: two OS processes
+    train the multitask net on complementary halves of one global batch
+    (psum over gloo), and their per-step losses must match a
+    single-process run on the full batch — the multi-host training claim
+    (SURVEY.md §2.3 DP row) executed, not simulated."""
+    from igaming_platform_tpu.train.data import make_stream
+    from igaming_platform_tpu.train.trainer import TrainConfig, Trainer
+
+    steps, global_batch, seed = 3, 64, 123
+
+    # Single-process reference on the full global batch.
+    cfg = TrainConfig(batch_size=global_batch, seed=seed, trunk=(64, 64))
+    ref = Trainer(cfg)
+    stream = make_stream(global_batch, seed=seed)
+    ref_losses = [ref.train_step(next(stream))["loss"] for _ in range(steps)]
+
+    outs = _run_two_workers(tmp_path, f"""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from igaming_platform_tpu.parallel.distributed import (
+            global_mesh, initialize_from_env, process_batch_slice,
+        )
+        from igaming_platform_tpu.parallel.mesh import AXIS_DATA, MeshSpec
+        from igaming_platform_tpu.train.data import Batch, make_stream
+        from igaming_platform_tpu.train.trainer import TrainConfig, Trainer
+
+        assert initialize_from_env() is True
+        mesh = global_mesh(MeshSpec(data=-1))
+        trainer = Trainer(TrainConfig(batch_size={global_batch}, seed={seed},
+                                      trunk=(64, 64)), mesh=mesh)
+
+        # Identical global data on every process; each loads only its slice
+        # and contributes it as a shard of ONE global array.
+        stream = make_stream({global_batch}, seed={seed})
+        per, offset = process_batch_slice({global_batch})
+        batch_sh = NamedSharding(mesh, P(AXIS_DATA, None))
+        vec_sh = NamedSharding(mesh, P(AXIS_DATA))
+
+        def to_global(b):
+            sl = slice(offset, offset + per)
+            mk = jax.make_array_from_process_local_data
+            return Batch(x=mk(batch_sh, b.x[sl]), fraud=mk(vec_sh, b.fraud[sl]),
+                         ltv=mk(vec_sh, b.ltv[sl]), churn=mk(vec_sh, b.churn[sl]))
+
+        for _ in range({steps}):
+            m = trainer.train_step(to_global(next(stream)))
+            print(f"LOSS process={{jax.process_index()}} {{m['loss']:.6f}}", flush=True)
+    """)
+    for i, out in enumerate(outs):
+        got = [float(line.split()[-1]) for line in out.splitlines()
+               if line.startswith(f"LOSS process={i}")]
+        assert len(got) == steps, out[-500:]
+        # Cross-process DP must reproduce the single-process run
+        # (float32 reduction-order tolerance only).
+        np.testing.assert_allclose(got, ref_losses, rtol=2e-4, atol=2e-5)
