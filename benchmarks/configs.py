@@ -17,12 +17,13 @@ headline metric; this module measures the full matrix:
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def config1_single_txn_latency(n_requests: int = 200, batch_size: int = 256) -> dict:
@@ -162,12 +163,10 @@ def config3_sequence_throughput(batch: int = 64, seq_len: int = 256, iters: int 
     # histories longer than one chip's HBM slice would allow densely"
     # regime the flash kernel exists for. TPU-only by default: the CPU
     # einsum fallback would time an S^2 matmul instead of the kernel.
-    import os as _os
-
-    xlong_s = int(_os.environ.get("BENCH_SEQ_XLONG_S", 8192))
+    xlong_s = int(os.environ.get("BENCH_SEQ_XLONG_S", 8192))
     xlong: dict = {}
     if xlong_s and (jax.default_backend() == "tpu"
-                    or _os.environ.get("BENCH_SEQ_XLONG_FORCE") == "1"):
+                    or os.environ.get("BENCH_SEQ_XLONG_FORCE") == "1"):
         xb = 2
         x_xl = np.random.default_rng(2).normal(
             size=(xb, xlong_s, EVENT_DIM)).astype(np.float32)
@@ -279,7 +278,6 @@ def config6_wallet_ops(n_threads: int = 8, cycles: int = 120) -> dict:
       through the serving engine's continuous batcher before money
       moves (the Deposit/Bet -> RiskService gate of SURVEY.md §3.1-3.2).
     """
-    import os
     import tempfile
     import threading
 

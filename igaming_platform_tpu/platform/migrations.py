@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import contextlib
 import sys
+import time
 from dataclasses import dataclass, field
 
 
@@ -210,8 +211,6 @@ class MigrationRunner:
         if target is not None and target not in {m.version for m in MIGRATIONS}:
             raise ValueError(f"unknown migration version {target}")
         ran: list[int] = []
-        import time
-
         with self._locked():
             # Read the ledger only once the lock is held: a concurrent
             # winner's rows must be visible to the loser.
