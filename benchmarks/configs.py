@@ -263,12 +263,141 @@ def config0_grpc_e2e() -> dict:
         shutdown()
 
 
+class _DirectWalletClient:
+    """The deposit/bet/win verbs against an in-process WalletService."""
+
+    def __init__(self, wallet, tid: int):
+        self._w = wallet
+        self._tid = tid
+        self._account_id = ""
+
+    def create_and_seed(self) -> None:
+        acct = self._w.create_account(f"bench-{self._tid}")
+        self._w.deposit(acct.id, 10_000_000, f"seed-{self._tid}")
+        self._account_id = acct.id
+
+    def deposit(self, amount: int, key: str) -> None:
+        self._w.deposit(self._account_id, amount, key)
+
+    def bet(self, amount: int, key: str, game_id: str, round_id: str) -> None:
+        self._w.bet(self._account_id, amount, key, game_id=game_id, round_id=round_id)
+
+    def win(self, amount: int, key: str, game_id: str, round_id: str) -> None:
+        self._w.win(self._account_id, amount, key, game_id=game_id, round_id=round_id)
+
+    def close(self) -> None:
+        pass
+
+
+class _WireWalletClient:
+    """The same verbs over a real wallet.v1 gRPC socket (bounded
+    deadlines so a stalled handler cannot hang the harness)."""
+
+    _TIMEOUT_S = 30
+
+    def __init__(self, addr: str, tid: int):
+        import grpc
+
+        from igaming_platform_tpu.serve.grpc_server import make_wallet_stub
+
+        self._ch = grpc.insecure_channel(addr)
+        self._stub = make_wallet_stub(self._ch)
+        self._tid = tid
+        self._account_id = ""
+
+    def create_and_seed(self) -> None:
+        from igaming_platform_tpu.proto_gen.wallet.v1 import wallet_pb2
+
+        acct = self._stub.CreateAccount(
+            wallet_pb2.CreateAccountRequest(player_id=f"wire-{self._tid}"),
+            timeout=self._TIMEOUT_S).account
+        self._stub.Deposit(wallet_pb2.DepositRequest(
+            account_id=acct.id, amount=10_000_000,
+            idempotency_key=f"seed-{self._tid}"), timeout=self._TIMEOUT_S)
+        self._account_id = acct.id
+
+    def deposit(self, amount: int, key: str) -> None:
+        from igaming_platform_tpu.proto_gen.wallet.v1 import wallet_pb2
+
+        self._stub.Deposit(wallet_pb2.DepositRequest(
+            account_id=self._account_id, amount=amount, idempotency_key=key),
+            timeout=self._TIMEOUT_S)
+
+    def bet(self, amount: int, key: str, game_id: str, round_id: str) -> None:
+        from igaming_platform_tpu.proto_gen.wallet.v1 import wallet_pb2
+
+        self._stub.Bet(wallet_pb2.BetRequest(
+            account_id=self._account_id, amount=amount, idempotency_key=key,
+            game_id=game_id, round_id=round_id), timeout=self._TIMEOUT_S)
+
+    def win(self, amount: int, key: str, game_id: str, round_id: str) -> None:
+        from igaming_platform_tpu.proto_gen.wallet.v1 import wallet_pb2
+
+        self._stub.Win(wallet_pb2.WinRequest(
+            account_id=self._account_id, amount=amount, idempotency_key=key,
+            game_id=game_id, round_id=round_id), timeout=self._TIMEOUT_S)
+
+    def close(self) -> None:
+        self._ch.close()
+
+
+def _wallet_mix(make_client, n_threads: int, cycles: int):
+    """Drive the deposit -> bet -> win op mix (unique idempotency keys,
+    per-thread accounts) from n_threads workers against any client with
+    the verbs above; returns (latencies_ms, errors, wall_s). The seed
+    phase counts toward errors too — a worker that cannot seed reports
+    itself instead of silently shrinking the op count."""
+    import threading
+
+    errors = [0]
+    lat: list[float] = []
+    lock = threading.Lock()
+
+    def worker(tid: int) -> None:
+        client = make_client(tid)
+        my_lat = []
+        try:
+            try:
+                client.create_and_seed()
+            except Exception:  # noqa: BLE001 — counted, fails loudly in artifacts
+                with lock:
+                    errors[0] += 1
+                return
+            for i in range(cycles):
+                ops = [
+                    lambda: client.deposit(2_000 + i, f"d-{tid}-{i}"),
+                    lambda: client.bet(100 + (i % 50), f"b-{tid}-{i}", "slots-1", f"r{i}"),
+                    lambda: client.win(150, f"w-{tid}-{i}", "slots-1", f"r{i}"),
+                ]
+                for op in ops:
+                    t0 = time.perf_counter()
+                    try:
+                        op()
+                    except Exception:  # noqa: BLE001 — counted
+                        with lock:
+                            errors[0] += 1
+                        continue
+                    my_lat.append((time.perf_counter() - t0) * 1e3)
+            with lock:
+                lat.extend(my_lat)
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(n_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return np.array(lat), errors[0], wall
+
+
 def config6_wallet_ops(n_threads: int = 8, cycles: int = 120) -> dict:
     """Money-op pipeline throughput — the reference's platform hot path
     (WalletService/Bet, SURVEY.md §3.2; wallet_service.go:351-462).
 
-    Two figures from the same op mix (deposit -> bet -> win cycles,
-    unique idempotency keys, per-thread accounts):
+    Two figures from the same op mix (_wallet_mix):
 
     - ``store_ops_per_sec``: WalletService over the durable SQLite store
       with the risk gate off — tx row, optimistic-lock balance update,
@@ -279,49 +408,10 @@ def config6_wallet_ops(n_threads: int = 8, cycles: int = 120) -> dict:
       moves (the Deposit/Bet -> RiskService gate of SURVEY.md §3.1-3.2).
     """
     import tempfile
-    import threading
 
     from igaming_platform_tpu.platform.outbox import OutboxPublisher
     from igaming_platform_tpu.platform.repository import SQLiteStore
     from igaming_platform_tpu.platform.wallet import WalletService
-
-    def run_mix(wallet, tag: str) -> tuple[np.ndarray, int, float]:
-        errors = [0]
-        lat: list[float] = []
-        lock = threading.Lock()
-
-        def worker(tid: int) -> None:
-            acct = wallet.create_account(f"bench-{tag}-{tid}")
-            wallet.deposit(acct.id, 10_000_000, f"seed-{tag}-{tid}")
-            my_lat = []
-            for i in range(cycles):
-                ops = [
-                    lambda: wallet.deposit(acct.id, 2_000 + i, f"d-{tag}-{tid}-{i}"),
-                    lambda: wallet.bet(acct.id, 100 + (i % 50), f"b-{tag}-{tid}-{i}",
-                                       game_id="slots-1", round_id=f"r{i}"),
-                    lambda: wallet.win(acct.id, 150, f"w-{tag}-{tid}-{i}",
-                                       game_id="slots-1", round_id=f"r{i}"),
-                ]
-                for op in ops:
-                    t0 = time.perf_counter()
-                    try:
-                        op()
-                    except Exception:  # noqa: BLE001 — counted, fails loudly below
-                        with lock:
-                            errors[0] += 1
-                        continue
-                    my_lat.append((time.perf_counter() - t0) * 1e3)
-            with lock:
-                lat.extend(my_lat)
-
-        threads = [threading.Thread(target=worker, args=(t,)) for t in range(n_threads)]
-        t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall = time.perf_counter() - t0
-        return np.array(lat), errors[0], wall
 
     with tempfile.TemporaryDirectory() as tmp:
         # Store-of-record pipeline only (risk gate off).
@@ -330,7 +420,8 @@ def config6_wallet_ops(n_threads: int = 8, cycles: int = 120) -> dict:
             store.accounts, store.transactions, store.ledger,
             events=OutboxPublisher(store), audit=store.audit,
         )
-        store_lat, store_errors, store_wall = run_mix(wallet, "s")
+        store_lat, store_errors, store_wall = _wallet_mix(
+            lambda tid: _DirectWalletClient(wallet, tid), n_threads, cycles)
         store.close()
 
         # Full topology: risk gate scores deposits/bets through the
@@ -339,7 +430,8 @@ def config6_wallet_ops(n_threads: int = 8, cycles: int = 120) -> dict:
 
         app = PlatformApp(AppConfig(sqlite_path=os.path.join(tmp, "wallet_full.db")))
         try:
-            full_lat, full_errors, full_wall = run_mix(app.wallet, "f")
+            full_lat, full_errors, full_wall = _wallet_mix(
+                lambda tid: _DirectWalletClient(app.wallet, tid), n_threads, cycles)
         finally:
             app.close()
 
@@ -359,6 +451,53 @@ def config6_wallet_ops(n_threads: int = 8, cycles: int = 120) -> dict:
     }
 
 
+def config7_wallet_wire(n_threads: int = 8, cycles: int = 100) -> dict:
+    """Wallet money ops AT THE WIRE: wallet.v1 Deposit/Bet/Win over a
+    real gRPC socket against serve_wallet + the durable SQLite store —
+    the platform hot path measured the way clients see it (the reference
+    serves this path as grpc-go handler -> service -> Postgres,
+    wallet_service.go:240-549; here handler -> WalletService -> one
+    SQLite unit of work per op with outbox staging). Risk gate off so
+    the figure isolates the wallet wire + pipeline (config6 reports the
+    risk-gated topology)."""
+    import tempfile
+
+    from igaming_platform_tpu.platform.outbox import OutboxPublisher
+    from igaming_platform_tpu.platform.repository import SQLiteStore
+    from igaming_platform_tpu.platform.wallet import WalletService
+    from igaming_platform_tpu.serve.grpc_server import (
+        WalletGrpcService,
+        graceful_stop,
+        serve_wallet,
+    )
+
+    with tempfile.TemporaryDirectory() as tmp:
+        store = SQLiteStore(os.path.join(tmp, "wire.db"))
+        wallet = WalletService(
+            store.accounts, store.transactions, store.ledger,
+            events=OutboxPublisher(store), audit=store.audit,
+        )
+        server, health, port = serve_wallet(WalletGrpcService(wallet), port=0)
+        try:
+            lat, errors, wall = _wallet_mix(
+                lambda tid: _WireWalletClient(f"localhost:{port}", tid),
+                n_threads, cycles)
+        finally:
+            graceful_stop(server, health, grace=5)
+            store.close()
+
+    return {
+        "metric": "wallet_wire_ops_per_sec",
+        "value": round(lat.size / wall, 1),
+        "unit": "ops/s",
+        "op_p50_ms": round(float(np.percentile(lat, 50)), 2) if lat.size else None,
+        "op_p99_ms": round(float(np.percentile(lat, 99)), 2) if lat.size else None,
+        "errors": errors,
+        "threads": n_threads,
+        "ops": int(lat.size),
+    }
+
+
 ALL_CONFIGS = {
     "grpc_e2e": config0_grpc_e2e,
     "single_txn": config1_single_txn_latency,
@@ -367,4 +506,5 @@ ALL_CONFIGS = {
     "ltv": config4_ltv_batch_throughput,
     "train": config5_training_throughput,
     "wallet": config6_wallet_ops,
+    "wallet_wire": config7_wallet_wire,
 }

@@ -2,7 +2,7 @@
 
 Usage: python benchmarks/run_all.py [config ...]
 Configs: grpc_e2e single_txn replay sequence ltv train wallet
-(default: all).
+wallet_wire (default: all).
 
 Each config runs in its OWN subprocess when several are requested: the
 serving configs leave device queues / batcher threads / allocator state

@@ -12,6 +12,7 @@ from configs import (  # noqa: E402
     config4_ltv_batch_throughput,
     config5_training_throughput,
     config6_wallet_ops,
+    config7_wallet_wire,
 )
 
 
@@ -47,3 +48,10 @@ def test_config6_runs():
     assert r["errors"] == 0 and r["store_errors"] == 0
     assert r["store_ops_per_sec"] > 0
     assert r["ops"] == 2 * 4 * 3  # threads x cycles x ops-per-cycle
+
+
+def test_config7_runs():
+    r = config7_wallet_wire(n_threads=2, cycles=3)
+    assert r["value"] > 0 and r["unit"] == "ops/s"
+    assert r["errors"] == 0
+    assert r["ops"] == 2 * 3 * 3
