@@ -359,3 +359,34 @@ def test_host_latency_tier_executes_and_matches(monkeypatch):
         assert np.abs(host[0, :4] - dev[0, :4]).max() <= 1       # score
     finally:
         engine.close()
+
+
+def test_abuse_detector_long_history_ring_matches_dense():
+    """The SERVING abuse wrapper at long history (S=1024) with ring
+    sequence parallelism == the dense single-device wrapper on identical
+    event streams — the long-context path through the production
+    ingestion/padding code, not just the bare model."""
+    import numpy as np
+
+    from igaming_platform_tpu.parallel.mesh import MeshSpec, create_mesh
+    from igaming_platform_tpu.serve.abuse import SequenceAbuseDetector
+
+    mesh = create_mesh(MeshSpec(data=2, seq=4))
+    ring = SequenceAbuseDetector(max_history=1024, mesh=mesh, seq_mode="ring")
+    dense = SequenceAbuseDetector(max_history=1024, params=ring.params, cfg=ring.cfg)
+
+    rng = np.random.default_rng(11)
+    accounts = [f"lc-{i}" for i in range(3)]
+    for det in (ring, dense):
+        r = np.random.default_rng(7)  # identical stream into both
+        for _ in range(1200):  # > max_history: deque rolls over
+            acct = accounts[int(r.integers(0, len(accounts)))]
+            det.record_event(acct, int(r.integers(100, 50_000)),
+                             ("deposit", "bet", "win")[int(r.integers(0, 3))],
+                             timestamp=1_000_000.0 + float(r.random()))
+    del rng
+
+    s_ring = ring.check_batch(accounts, seq_len=1024)
+    s_dense = dense.check_batch(accounts, seq_len=1024)
+    assert s_ring.shape == (3,)
+    np.testing.assert_allclose(s_ring, s_dense, rtol=2e-4, atol=2e-5)
