@@ -345,8 +345,35 @@ class RiskServer:
         self.shutdown()
 
 
+def device_gate() -> None:
+    """A wedged device tunnel makes jax device init block FOREVER — the
+    server would log its first lines and then never open a port, the
+    most operator-hostile failure mode there is. Probe first: fail fast
+    with a clear message by default, or serve on the host CPU when
+    explicitly allowed (the host latency tier's executable is the same
+    score graph, so correctness is unchanged — only throughput)."""
+    import os as _os
+
+    from igaming_platform_tpu.core.devices import ensure_responsive_device
+
+    fallback = ensure_responsive_device()
+    if not fallback:
+        return
+    if _os.environ.get("SERVE_DEVICE_FALLBACK", "").lower() == "cpu":
+        logging.getLogger(__name__).warning(
+            "device unavailable (%s) — SERVE_DEVICE_FALLBACK=cpu set, "
+            "serving on host CPU", fallback)
+        return
+    logging.getLogger(__name__).error(
+        "device unavailable (%s) — refusing to boot a degraded server. "
+        "Set SERVE_DEVICE_FALLBACK=cpu to serve on host CPU anyway.",
+        fallback)
+    raise SystemExit(1)
+
+
 def main() -> None:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    device_gate()
     server = RiskServer()
     server.wait_for_signal()
 

@@ -390,3 +390,30 @@ def test_abuse_detector_long_history_ring_matches_dense():
     s_dense = dense.check_batch(accounts, seq_len=1024)
     assert s_ring.shape == (3,)
     np.testing.assert_allclose(s_ring, s_dense, rtol=2e-4, atol=2e-5)
+
+
+def test_device_gate_refuses_degraded_boot_unless_opted_in(monkeypatch):
+    """On a wedged device tunnel the server must exit loudly, not hang
+    half-booted; SERVE_DEVICE_FALLBACK=cpu opts into host serving.
+
+    The probe is stubbed (not driven through env) so its _pin_cpu side
+    effects cannot leak a CPU pin into the rest of the session."""
+    import pytest
+
+    from igaming_platform_tpu.core import devices
+    from igaming_platform_tpu.serve.server import device_gate
+
+    monkeypatch.setattr(devices, "ensure_responsive_device",
+                        lambda *a, **k: "cpu (device tunnel unresponsive)")
+    monkeypatch.delenv("SERVE_DEVICE_FALLBACK", raising=False)
+    with pytest.raises(SystemExit):
+        device_gate()
+
+    monkeypatch.setenv("SERVE_DEVICE_FALLBACK", "cpu")
+    device_gate()  # opted in: warns and continues
+
+    # Healthy device: no gate at all.
+    monkeypatch.setattr(devices, "ensure_responsive_device",
+                        lambda *a, **k: None)
+    monkeypatch.delenv("SERVE_DEVICE_FALLBACK", raising=False)
+    device_gate()
