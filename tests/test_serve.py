@@ -417,3 +417,25 @@ def test_device_gate_refuses_degraded_boot_unless_opted_in(monkeypatch):
                         lambda *a, **k: None)
     monkeypatch.delenv("SERVE_DEVICE_FALLBACK", raising=False)
     device_gate()
+
+
+def test_persistent_compile_cache_config(monkeypatch, tmp_path):
+    """enable_persistent_compile_cache honors the env override and the
+    '0' disable switch, and points jax at the directory."""
+    import jax
+
+    from igaming_platform_tpu.serve.server import enable_persistent_compile_cache
+
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        target = str(tmp_path / "xla")
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", target)
+        assert enable_persistent_compile_cache() == target
+        assert jax.config.jax_compilation_cache_dir == target
+
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "0")
+        assert enable_persistent_compile_cache() is None
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_min)
