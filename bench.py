@@ -143,6 +143,9 @@ def e2e_numbers() -> dict:
 
 def main() -> None:
     _ensure_responsive_device()
+    from igaming_platform_tpu.core.devices import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
     import jax
 
     result = {"device": str(jax.devices()[0]), "backend": "multitask-ensemble"}

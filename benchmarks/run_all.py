@@ -28,6 +28,11 @@ def main() -> None:
     # (BENCH_DEVICE_PROBED / BENCH_DEVICE_FALLBACK) so children neither
     # re-probe nor lose the fallback label.
     bench._ensure_responsive_device()
+    from igaming_platform_tpu.core.devices import enable_persistent_compile_cache
+
+    # Share compiled executables across matrix runs; each per-config
+    # subprocess re-enters main() and resolves the same cache dir.
+    enable_persistent_compile_cache()
     names = sys.argv[1:] or list(ALL_CONFIGS)
     isolate = len(names) > 1 and os.environ.get("BENCH_NO_ISOLATE") != "1"
     for name in names:

@@ -55,3 +55,25 @@ def ensure_responsive_device(probe_timeout_s: float = 90.0) -> str | None:
     os.environ["BENCH_DEVICE_FALLBACK"] = label
     _pin_cpu()
     return label
+
+
+def enable_persistent_compile_cache() -> str | None:
+    """Persist XLA executables across restarts: first boot pays the
+    20-45 s serving-shape compile, every later boot loads it from disk.
+    JAX_COMPILATION_CACHE_DIR overrides the location; set it to ``0`` to
+    disable. Returns the directory in effect (None = disabled)."""
+    import jax
+
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "igaming-tpu-xla"),
+    )
+    if cache_dir in ("", "0"):
+        return None
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # Cache even fast compiles — the serving ladder has several small
+    # shapes and a restarting server wants ALL of them warm from disk —
+    # unless the operator set the threshold explicitly via env.
+    if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    return cache_dir

@@ -345,26 +345,9 @@ class RiskServer:
         self.shutdown()
 
 
-def enable_persistent_compile_cache() -> str | None:
-    """Persist XLA executables across restarts: first boot pays the
-    20-45 s serving-shape compile, every later boot loads it from disk.
-    JAX_COMPILATION_CACHE_DIR overrides the location; set it to ``0`` to
-    disable. Returns the directory in effect (None = disabled)."""
-    import os as _os
-
-    import jax
-
-    cache_dir = _os.environ.get(
-        "JAX_COMPILATION_CACHE_DIR",
-        _os.path.join(_os.path.expanduser("~"), ".cache", "igaming-tpu-xla"),
-    )
-    if cache_dir in ("", "0"):
-        return None
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    # Cache even fast compiles: the serving ladder has several small
-    # shapes and a restarting server wants ALL of them warm from disk.
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
-    return cache_dir
+from igaming_platform_tpu.core.devices import (  # noqa: E402 — boot path
+    enable_persistent_compile_cache,
+)
 
 
 def device_gate() -> None:
