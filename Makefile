@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-fast bench bench-all eval native proto run-risk run-wallet dryrun clean soak soak-wire api-test migrate-up migrate-down migrate-status seed
+.PHONY: all test test-fast bench bench-all eval native proto run-risk run-wallet dryrun clean soak soak-wire api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
 
 all: native test
 
@@ -74,6 +74,25 @@ run-wallet:
 # LTV batch job: wallet DB -> per-player segments (one device pass).
 ltv-job:
 	$(PY) -m igaming_platform_tpu.serve.ltv_job $(DB)
+
+# Image build/publish (the reference Makefile:191-209 equivalents).
+# One image serves both services (CMD selects); REGISTRY/TAG override.
+REGISTRY ?= localhost:5000
+TAG ?= latest
+IMAGE = $(REGISTRY)/igaming-platform-tpu:$(TAG)
+
+docker-build:
+	docker build -f deploy/Dockerfile -t $(IMAGE) .
+
+docker-push: docker-build
+	docker push $(IMAGE)
+
+# Infra stack up/down (stores profile adds PG/Redis/RabbitMQ/ClickHouse).
+infra-up:
+	docker compose -f deploy/docker-compose.yml --profile stores up -d
+
+infra-down:
+	docker compose -f deploy/docker-compose.yml --profile stores down
 
 # Multi-chip sharding validation on virtual CPU devices.
 dryrun:

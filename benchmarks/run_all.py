@@ -39,6 +39,13 @@ def main() -> None:
         if ALL_CONFIGS.get(name) is None:
             print(json.dumps({"error": f"unknown config: {name}"}))
             continue
+        if isolate and os.environ.get("BENCH_DEVICE_FALLBACK"):
+            # The tunnel wedge is transient: one quick probe between
+            # configs flips the remaining subprocesses back onto the
+            # device the moment it recovers.
+            from igaming_platform_tpu.core.devices import reprobe_recovered
+
+            reprobe_recovered()
         if isolate:
             try:
                 proc = subprocess.run(

@@ -7,6 +7,13 @@ Any long-running CLI (bench, soak, eval, ltv-job) must probe from a
 killable subprocess FIRST and pin itself to CPU if the probe hangs, so
 it produces an honestly-labeled result instead of hanging its caller.
 
+The wedge is transient — the tunnel has been observed to recover within
+minutes — so the probe RETRIES with backoff inside a bounded budget
+(``DEVICE_PROBE_BUDGET_S``, default 360 s) instead of giving up after a
+single attempt, and a matrix-style caller that did fall back can call
+``reprobe_recovered()`` between configs to flip later subprocesses back
+onto the device the moment the tunnel returns.
+
 Probe state propagates to child processes via env so per-config bench
 subprocesses neither re-probe nor lose the fallback label:
 ``BENCH_DEVICE_PROBED=1`` (healthy) / ``BENCH_DEVICE_FALLBACK=<label>``.
@@ -14,66 +21,211 @@ subprocesses neither re-probe nor lose the fallback label:
 
 from __future__ import annotations
 
+import hashlib
 import os
 import subprocess
 import sys
+import time
+
+_PROBE_SNIPPET = "import jax; jax.devices()"
+
+# Env key recording JAX_PLATFORMS as it was before the FIRST _pin_cpu()
+# ("" = was unset). An env var, not a module global, so a child process
+# that inherited the fallback still knows the original platform choice —
+# its own pre-pin value is the parent's already-pinned "cpu", and
+# reprobing with that would trivially "succeed" on the CPU backend.
+_PREPIN_ENV = "BENCH_DEVICE_PREPIN_PLATFORMS"
+
 
 def _pin_cpu() -> None:
+    if _PREPIN_ENV not in os.environ:
+        os.environ[_PREPIN_ENV] = os.environ.get("JAX_PLATFORMS", "")
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
     jax.config.update("jax_platforms", "cpu")
 
 
-def ensure_responsive_device(probe_timeout_s: float = 90.0) -> str | None:
-    """Probe the device from a killable subprocess; on a wedged tunnel,
-    pin this process to CPU. Returns the fallback label (None = healthy
-    or already explicitly CPU)."""
+def _probe_once(timeout_s: float) -> str | None:
+    """One subprocess probe. Returns None on success, else a fallback
+    label describing the failure mode."""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", _PROBE_SNIPPET],
+            timeout=timeout_s, capture_output=True,
+        )
+    except subprocess.TimeoutExpired:
+        return "cpu (device tunnel unresponsive)"
+    if probe.returncode == 0:
+        return None
+    # Fast failure is NOT a wedge — surface the real cause (driver
+    # crash, bad install) instead of mislabeling it unresponsive.
+    tail = probe.stderr.decode("utf-8", "replace").strip().splitlines()
+    detail = tail[-1][:120] if tail else f"rc={probe.returncode}"
+    return f"cpu (device init failed: {detail})"
+
+
+def ensure_responsive_device(probe_timeout_s: float = 75.0) -> str | None:
+    """Probe the device from a killable subprocess, retrying with backoff
+    while the probe budget lasts (the tunnel recovers mid-round often
+    enough that one 90 s attempt throws away real-device artifacts). On
+    exhaustion, pin this process to CPU. Returns the fallback label
+    (None = healthy or already explicitly CPU)."""
     if os.environ.get("BENCH_DEVICE_FALLBACK"):
         # A parent process already hit the wedge: inherit its label and
         # skip the (hopeless) re-probe.
         _pin_cpu()
         return os.environ["BENCH_DEVICE_FALLBACK"]
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        # Explicit CPU choice — but the env var alone does NOT stick:
+        # sitecustomize force-registers the TPU plugin, whose init hangs
+        # on a wedged tunnel even with JAX_PLATFORMS=cpu. Pin via
+        # jax.config too (what tests/conftest.py does).
+        _pin_cpu()
         return None
     if os.environ.get("BENCH_DEVICE_PROBED") == "1":
         return None  # parent already probed successfully
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=probe_timeout_s, capture_output=True,
-        )
-        if probe.returncode == 0:
+    budget_s = float(os.environ.get("DEVICE_PROBE_BUDGET_S", 360.0))
+    deadline = time.monotonic() + budget_s
+    delay_s, attempts, label = 10.0, 0, "cpu (device probe never ran)"
+    while True:
+        attempts += 1
+        remaining = deadline - time.monotonic()
+        label = _probe_once(min(probe_timeout_s, max(15.0, remaining)))
+        if label is None:
             os.environ["BENCH_DEVICE_PROBED"] = "1"
             return None
-        # Fast failure is NOT a wedge — surface the real cause (driver
-        # crash, bad install) instead of mislabeling it unresponsive.
-        tail = probe.stderr.decode("utf-8", "replace").strip().splitlines()
-        label = f"cpu (device init failed: {tail[-1][:120] if tail else 'rc=' + str(probe.returncode)})"
-    except subprocess.TimeoutExpired:
-        label = "cpu (device tunnel unresponsive)"
+        if "unresponsive" not in label:
+            # Fast deterministic failure (broken install, crashed
+            # driver): retrying the doomed probe for the whole budget
+            # would stall every boot ~6 minutes. Only the wedge —
+            # which demonstrably recovers — is worth waiting out.
+            break
+        if time.monotonic() + delay_s >= deadline:
+            break
+        time.sleep(delay_s)
+        delay_s = min(delay_s * 2.0, 60.0)
+    if attempts > 1:
+        label = f"{label[:-1]}; {attempts} probes over {int(budget_s)}s)"
     os.environ["BENCH_DEVICE_FALLBACK"] = label
     _pin_cpu()
     return label
 
 
+_last_reprobe_at: float = 0.0
+
+
+def reprobe_recovered(probe_timeout_s: float = 20.0,
+                      min_interval_s: float = 90.0) -> bool:
+    """For a fallen-back matrix parent: one quick probe between configs.
+    On success, clears the inherited-fallback env and restores the
+    pre-pin JAX_PLATFORMS so LATER CHILD PROCESSES run on the recovered
+    device (this process stays CPU-pinned — its jax backend is already
+    initialized). Returns True if the tunnel is back.
+
+    Attempts are throttled (at most one per ``min_interval_s``) and use
+    a short timeout: a recovered tunnel answers in seconds, so a long
+    wait only adds dead wall-clock to a degraded matrix run."""
+    global _last_reprobe_at
+    if not os.environ.get("BENCH_DEVICE_FALLBACK"):
+        return True  # never fell back
+    now = time.monotonic()
+    if now - _last_reprobe_at < min_interval_s:
+        return False
+    _last_reprobe_at = now
+    env = dict(os.environ)
+    prepin = env.pop(_PREPIN_ENV, "")
+    if prepin:
+        env["JAX_PLATFORMS"] = prepin
+    else:
+        env.pop("JAX_PLATFORMS", None)
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", _PROBE_SNIPPET],
+            timeout=probe_timeout_s, capture_output=True, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    if probe.returncode != 0:
+        return False
+    del os.environ["BENCH_DEVICE_FALLBACK"]
+    os.environ["BENCH_DEVICE_PROBED"] = "1"
+    if prepin:
+        os.environ["JAX_PLATFORMS"] = prepin
+    else:
+        os.environ.pop("JAX_PLATFORMS", None)
+    os.environ.pop(_PREPIN_ENV, None)
+    return True
+
+
+def host_fingerprint(cpuinfo_path: str = "/proc/cpuinfo") -> str:
+    """Short digest of the host ISA + CPU feature flags. Keys the
+    persistent compile cache: an executable AOT-compiled on a host with
+    e.g. AVX-512 must never be deserialized on one without it (XLA warns
+    'could lead to execution errors such as SIGILL')."""
+    import platform as _platform
+
+    bits = [_platform.machine()]
+    try:
+        with open(cpuinfo_path, encoding="utf-8", errors="replace") as f:
+            for line in f:
+                if line.lower().startswith(("flags", "features")):
+                    bits.append(" ".join(sorted(line.split(":", 1)[1].split())))
+                    break
+    except OSError:
+        bits.append(_platform.processor() or "unknown-cpu")
+    return hashlib.sha256("|".join(bits).encode()).hexdigest()[:12]
+
+
+def cache_dir_for(backend: str, base_dir: str) -> str:
+    """Cache directory keyed by ``<backend>-<host fingerprint>``: an
+    entry written by a different backend, or by a CPU with a different
+    feature set, is invisible rather than deserialized into a potential
+    SIGILL."""
+    return os.path.join(base_dir, f"{backend}-{host_fingerprint()}")
+
+
 def enable_persistent_compile_cache() -> str | None:
     """Persist XLA executables across restarts: first boot pays the
     20-45 s serving-shape compile, every later boot loads it from disk.
-    JAX_COMPILATION_CACHE_DIR overrides the location; set it to ``0`` to
-    disable. Returns the directory in effect (None = disabled)."""
+
+    Enabled only for accelerator backends. CPU executables are NEVER
+    cached: they recompile in well under a second, and XLA's CPU AOT
+    loader compares compile-feature strings that embed tuning
+    pseudo-features (``+prefer-no-gather``), so even a same-host reload
+    emits its "could lead to execution errors such as SIGILL" warning
+    (reproduced with a fresh cache; also the round-3 driver-run tail).
+    For the accelerator case the directory is additionally keyed by
+    backend + host fingerprint (``cache_dir_for``) so a heterogeneous
+    fleet sharing a home directory cannot cross-load executables.
+
+    JAX_COMPILATION_CACHE_DIR overrides the base location; set it to
+    ``0`` to disable. Returns the directory in effect (None = disabled).
+    """
     import jax
 
-    cache_dir = os.environ.get(
+    backend = jax.default_backend()
+    if backend == "cpu":
+        # jax's own config binds jax_compilation_cache_dir to the
+        # JAX_COMPILATION_CACHE_DIR env var at import time — clear it
+        # explicitly, or an operator-exported override would keep CPU
+        # caching alive at the raw un-fingerprinted base dir.
+        if jax.config.jax_compilation_cache_dir:
+            jax.config.update("jax_compilation_cache_dir", None)
+        return None
+    base_dir = os.environ.get(
         "JAX_COMPILATION_CACHE_DIR",
         os.path.join(os.path.expanduser("~"), ".cache", "igaming-tpu-xla"),
     )
-    if cache_dir in ("", "0"):
+    if base_dir in ("", "0"):
         return None
+    cache_dir = cache_dir_for(backend, base_dir)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
-    # Cache even fast compiles — the serving ladder has several small
-    # shapes and a restarting server wants ALL of them warm from disk —
-    # unless the operator set the threshold explicitly via env.
+    # Threshold 2 s: every TPU compile (including the serving ladder's
+    # small shapes) costs more and stays cached, while the host-latency-
+    # tier CPU executables compiled alongside them stay OUT of the cache
+    # — reloading a CPU AOT result is what trips XLA's feature-mismatch
+    # warning. Operators can still override via env.
     if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
     return cache_dir

@@ -3,10 +3,20 @@
 The reference keeps money as arbitrary-precision decimals
 (/root/reference/pkg/money/money.go:16-19) but the wire contract and the
 database schema are integer cents (wallet.proto:58-63, init-db.sql:13-26).
-This framework standardises on int64 cents everywhere — exact, hashable, and
-directly usable as device arrays (TPU has no decimal type) — with the same
-checked semantics: negative construction rejected, currency-mismatch and
-insufficient-funds errors on arithmetic (money.go:49-142).
+This framework standardises on int64 **minor units** everywhere — exact,
+hashable, and directly usable as device arrays (TPU has no decimal type) —
+with the same checked semantics: negative construction rejected,
+currency-mismatch and insufficient-funds errors on arithmetic
+(money.go:49-142).
+
+The minor-unit exponent is per currency (money.go:24-31 lists BTC/ETH
+alongside the fiats): fiat currencies use 2 (cents — the wire and DB
+contract, unchanged), BTC uses 8 (satoshi), ETH uses 9 (nano-ETH / gwei).
+Full 18-decimal wei would cap balances at ~9.2 ETH inside int64, so the
+finest unit that keeps a practical range is used instead; 1 nano-ETH is
+still ~7 orders of magnitude below a cent, i.e. genuinely sub-cent. For
+USD — the only currency on the benchmarked wire paths — a ``Money``'s
+integer value is bit-identical to the old cents representation.
 
 Python ints are unbounded, so ``Money`` validates the int64 range explicitly
 to preserve database/wire compatibility.
@@ -29,6 +39,17 @@ class Currency(str, enum.Enum):
     RUB = "RUB"
     BTC = "BTC"
     ETH = "ETH"
+
+
+#: Decimal digits in one major unit, per currency (money.go:24-31's set).
+MINOR_UNIT_EXPONENT: dict[Currency, int] = {
+    Currency.USD: 2,
+    Currency.EUR: 2,
+    Currency.GBP: 2,
+    Currency.RUB: 2,
+    Currency.BTC: 8,  # satoshi
+    Currency.ETH: 9,  # nano-ETH; see module docstring for the int64 tradeoff
+}
 
 
 class MoneyError(ValueError):
@@ -59,7 +80,13 @@ def _check_int64(cents: int) -> int:
 
 @dataclass(frozen=True, slots=True)
 class Money:
-    """Immutable monetary value: integer cents + currency."""
+    """Immutable monetary value: integer minor units + currency.
+
+    The field keeps its historical name ``cents`` — for every fiat
+    currency the value IS cents, and the wallet wire contract
+    (wallet.proto:58-63) reads it unchanged. For BTC/ETH it holds
+    satoshi / nano-ETH per ``MINOR_UNIT_EXPONENT``.
+    """
 
     cents: int
     currency: Currency = Currency.USD
@@ -71,6 +98,10 @@ class Money:
         if self.cents < 0:
             raise NegativeAmountError(f"amount cannot be negative: {self.cents}")
 
+    @property
+    def exponent(self) -> int:
+        return MINOR_UNIT_EXPONENT[self.currency]
+
     # -- constructors -------------------------------------------------------
 
     @classmethod
@@ -79,11 +110,17 @@ class Money:
 
     @classmethod
     def from_cents(cls, cents: int, currency: Currency = Currency.USD) -> "Money":
+        """Wire-contract constructor: the int64 amount field, interpreted
+        in the account currency's minor unit (cents for fiat)."""
         return cls(int(cents), currency)
+
+    from_minor_units = from_cents
 
     @classmethod
     def parse(cls, value: str, currency: Currency = Currency.USD) -> "Money":
-        """Parse a decimal string like '12.34' into exact cents."""
+        """Parse a decimal string like '12.34' (or '0.00000001' BTC)
+        into exact minor units at the currency's precision."""
+        exp = MINOR_UNIT_EXPONENT[currency]
         text = value.strip()
         negative = text.startswith("-")
         if negative:
@@ -94,15 +131,16 @@ class Money:
         if whole == "" and frac == "":
             raise InvalidAmountError(f"invalid amount format: {value!r}")
         try:
-            whole_cents = int(whole or "0") * 100
+            units = int(whole or "0") * 10**exp
             if frac:
-                if len(frac) > 2 and any(c != "0" for c in frac[2:]):
-                    raise InvalidAmountError(f"sub-cent precision not representable: {value!r}")
-                frac = (frac + "00")[:2]
-                whole_cents += int(frac)
+                if len(frac) > exp and any(c != "0" for c in frac[exp:]):
+                    raise InvalidAmountError(
+                        f"sub-{currency.value}-minor-unit precision not representable: {value!r}")
+                frac = (frac + "0" * exp)[:exp]
+                units += int(frac) if exp else 0
         except ValueError as exc:
             raise InvalidAmountError(f"invalid amount format: {value!r}") from exc
-        return cls(whole_cents, currency)
+        return cls(units, currency)
 
     # -- predicates ---------------------------------------------------------
 
@@ -170,11 +208,16 @@ class Money:
 
     # -- formatting ---------------------------------------------------------
 
+    def _decimal_str(self) -> str:
+        exp = self.exponent
+        scale = 10**exp
+        return f"{self.cents // scale}.{self.cents % scale:0{exp}d}"
+
     def __str__(self) -> str:
-        return f"{self.cents // 100}.{self.cents % 100:02d} {self.currency.value}"
+        return f"{self._decimal_str()} {self.currency.value}"
 
     def to_json(self) -> dict:
-        return {"value": f"{self.cents // 100}.{self.cents % 100:02d}", "currency": self.currency.value}
+        return {"value": self._decimal_str(), "currency": self.currency.value}
 
     @classmethod
     def from_json(cls, obj: dict) -> "Money":

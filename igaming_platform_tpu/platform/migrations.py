@@ -184,8 +184,13 @@ class MigrationRunner:
 
     def __init__(self, conn):
         self._conn = conn
-        for stmt in _statements(_LEDGER_DDL):
-            conn.execute(stmt)
+        # Ledger DDL under the same advisory lock as the migrations
+        # themselves: CREATE TABLE IF NOT EXISTS races on a fresh
+        # database (duplicate-key on pg_type/pg_class) when two services
+        # boot concurrently — exactly the scenario the lock exists for.
+        with self._locked():
+            for stmt in _statements(_LEDGER_DDL):
+                conn.execute(stmt)
 
     @contextlib.contextmanager
     def _locked(self):

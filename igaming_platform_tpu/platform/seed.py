@@ -55,6 +55,13 @@ def main() -> int:
     # helper), so what seed writes is what the server will read.
     url = os.environ.get("DATABASE_URL", "")
     store = store_from_url(url)
+    if store is None and url:
+        # A typo'd scheme ('postgress://…') must not silently seed a
+        # throwaway in-memory store and exit 0 — same fail-loudly policy
+        # as WIRE_DTYPE in the scorer.
+        print(f"error: unrecognized DATABASE_URL scheme "
+              f"(want sqlite:// or postgres://)", file=sys.stderr)
+        return 2
     if store is not None:
         # Redact userinfo — DATABASE_URL carries credentials and this
         # line lands in terminal scrollback and CI logs.

@@ -166,17 +166,28 @@ def test_status_reflects_ledger():
 
 def test_runs_are_bracketed_by_advisory_lock():
     """Concurrent service boots against one DATABASE_URL must serialize:
-    the run takes the session advisory lock BEFORE reading the ledger and
-    releases it after (golang-migrate's guard for the same race)."""
+    the ledger DDL and the run each take the session advisory lock (the
+    DDL too — CREATE TABLE IF NOT EXISTS races on a fresh database), and
+    the ledger read happens while a lock is held (golang-migrate's guard
+    for the same race)."""
     conn = FakeConn()
+    # Construction itself (ledger DDL) is bracketed by the lock.
     runner = MigrationRunner(conn)
+    i_ddl = next(i for i, s in enumerate(conn.statements)
+                 if "schema_migrations" in s and "CREATE" in s.upper())
+    assert any("pg_advisory_lock" in s for s in conn.statements[:i_ddl])
+    assert any("pg_advisory_unlock" in s for s in conn.statements[i_ddl:])
+
     runner.up()
     stmts = conn.statements
-    i_lock = next(i for i, s in enumerate(stmts) if "pg_advisory_lock" in s)
     i_read = next(i for i, s in enumerate(stmts)
                   if s.upper().startswith("SELECT VERSION FROM SCHEMA_MIGRATIONS"))
-    i_unlock = next(i for i, s in enumerate(stmts) if "pg_advisory_unlock" in s)
-    assert i_lock < i_read < i_unlock
+    # The nearest lock/unlock events around the ledger read bracket it.
+    assert any("pg_advisory_lock" in s for s in stmts[:i_read])
+    last_before = max(i for i, s in enumerate(stmts[:i_read])
+                      if "pg_advisory_lock" in s or "pg_advisory_unlock" in s)
+    assert "pg_advisory_unlock" not in stmts[last_before]
+    assert any("pg_advisory_unlock" in s for s in stmts[i_read:])
     # down() takes the same lock.
     before = len(conn.statements)
     runner.down(0)

@@ -91,3 +91,48 @@ def test_zero():
     z = Money.zero()
     assert z.is_zero() and not z.is_positive()
     assert Money.from_cents(1).is_positive()
+
+
+# -- sub-cent currencies (money.go:16-31: decimal precision, BTC/ETH) ------
+
+def test_fiat_minor_units_are_cents_unchanged():
+    """The USD wire/DB contract is untouched by per-currency exponents."""
+    m = Money.parse("12.34")
+    assert m.cents == 1234 and m.exponent == 2
+    assert str(m) == "12.34 USD"
+    assert m.to_json() == {"value": "12.34", "currency": "USD"}
+
+
+def test_btc_satoshi_precision():
+    one_sat = Money.parse("0.00000001", Currency.BTC)
+    assert one_sat.cents == 1 and one_sat.exponent == 8
+    assert str(one_sat) == "0.00000001 BTC"
+    m = Money.parse("0.05", Currency.BTC)
+    assert m.cents == 5_000_000
+    assert Money.from_json(one_sat.to_json()) == one_sat
+
+
+def test_eth_nano_precision():
+    gwei = Money.parse("0.000000001", Currency.ETH)
+    assert gwei.cents == 1 and gwei.exponent == 9
+    assert str(gwei) == "0.000000001 ETH"
+    # 21000 gwei * 50 = a realistic gas amount, still exact.
+    assert gwei.mul_int(21_000 * 50).cents == 1_050_000
+
+
+def test_sub_minor_unit_rejected_per_currency():
+    with pytest.raises(InvalidAmountError):
+        Money.parse("0.001")  # sub-cent USD: still rejected
+    with pytest.raises(InvalidAmountError):
+        Money.parse("0.000000000001", Currency.BTC)  # sub-satoshi
+    # But 3 decimals is fine for BTC where USD rejects it.
+    assert Money.parse("0.001", Currency.BTC).cents == 100_000
+
+
+def test_cross_currency_math_still_rejected():
+    with pytest.raises(CurrencyMismatchError):
+        Money.parse("1", Currency.BTC).add(Money.parse("1", Currency.ETH))
+
+
+def test_from_minor_units_alias():
+    assert Money.from_minor_units(7, Currency.BTC).cents == 7

@@ -335,8 +335,8 @@ def test_load_rules_yaml():
     assert welcome.match_percent == 100
     assert welcome.max_bonus == 50_000
     assert welcome.one_time
-    assert welcome.conditions.max_account_age_days == 7
-    assert welcome.game_weights["video_poker"] == 50
+    assert welcome.conditions.max_account_age_days == 10
+    assert welcome.game_weights["video_poker"] == 40
 
 
 def test_award_deposit_match_capped():

@@ -1,5 +1,7 @@
 """Serving layer tests: feature store, HLL, batcher, TPU scoring engine."""
 
+import os
+
 import numpy as np
 
 from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
@@ -420,10 +422,13 @@ def test_device_gate_refuses_degraded_boot_unless_opted_in(monkeypatch):
 
 
 def test_persistent_compile_cache_config(monkeypatch, tmp_path):
-    """enable_persistent_compile_cache honors the env override and the
-    '0' disable switch, and points jax at the directory."""
+    """The cache is a TPU-boot-time optimization: disabled outright on
+    the CPU backend (reloading CPU AOT results trips XLA's SIGILL-hazard
+    feature-mismatch warning even same-host), keyed by backend + host
+    fingerprint otherwise, and '0' disables."""
     import jax
 
+    from igaming_platform_tpu.core.devices import cache_dir_for, host_fingerprint
     from igaming_platform_tpu.serve.server import enable_persistent_compile_cache
 
     prev_dir = jax.config.jax_compilation_cache_dir
@@ -431,11 +436,44 @@ def test_persistent_compile_cache_config(monkeypatch, tmp_path):
     try:
         target = str(tmp_path / "xla")
         monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", target)
-        assert enable_persistent_compile_cache() == target
-        assert jax.config.jax_compilation_cache_dir == target
+        # Tests run on the CPU backend: never cached.
+        assert jax.default_backend() == "cpu"
+        assert enable_persistent_compile_cache() is None
+
+        # The accelerator path resolves <base>/<backend>-<fingerprint>.
+        expected = os.path.join(target, f"tpu-{host_fingerprint()}")
+        assert cache_dir_for("tpu", target) == expected
 
         monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "0")
         assert enable_persistent_compile_cache() is None
     finally:
         jax.config.update("jax_compilation_cache_dir", prev_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_min)
+
+
+def test_compile_cache_rejects_foreign_host_entries(tmp_path):
+    """An entry written under one host feature set lands in a directory
+    another feature set never resolves — the SIGILL-by-deserialization
+    path is structurally impossible, not merely survived."""
+    from igaming_platform_tpu.core.devices import host_fingerprint
+
+    a = tmp_path / "cpuinfo_a"
+    b = tmp_path / "cpuinfo_b"
+    a.write_text("flags\t\t: fpu sse sse2 avx avx2 avx512f\n")
+    b.write_text("flags\t\t: fpu sse sse2 avx avx2\n")
+    fp_a, fp_b = host_fingerprint(str(a)), host_fingerprint(str(b))
+    assert fp_a != fp_b
+
+    # Flag ORDER must not change the key (kernels list flags stably, but
+    # the fingerprint should not depend on it).
+    a2 = tmp_path / "cpuinfo_a2"
+    a2.write_text("flags\t\t: avx512f avx2 avx sse2 sse fpu\n")
+    assert host_fingerprint(str(a2)) == fp_a
+
+    # A cache entry written under fingerprint A is invisible under B.
+    base = tmp_path / "cache"
+    dir_a = base / f"cpu-{fp_a}"
+    dir_a.mkdir(parents=True)
+    (dir_a / "some-executable").write_bytes(b"\x00xla")
+    dir_b = base / f"cpu-{fp_b}"
+    assert not dir_b.exists()
