@@ -226,24 +226,71 @@ def config4_ltv_batch_throughput(rows: int = 100_000, iters: int = 10) -> dict:
 
 
 def config5_training_throughput(steps: int = 30, batch_size: int = 4096) -> dict:
+    """DP training throughput with the production input pipeline:
+    double-buffered H2D prefetch, no per-step metric readback (each sync
+    readback over the tunneled device costs a full RTT — the round-3
+    artifact's 15x TPU-vs-CPU gap was five scalar readbacks plus a
+    synchronous H2D per step, not the step itself). Reports a per-stage
+    breakdown (h2d / device step / readback) and MFU so the figure is
+    normalized, not just a throughput sample."""
+    import jax
+
+    from igaming_platform_tpu.obs.perfmodel import utilization
     from igaming_platform_tpu.train.data import make_stream
     from igaming_platform_tpu.train.trainer import TrainConfig, Trainer
 
     cfg = TrainConfig(batch_size=batch_size)
     trainer = Trainer(cfg)
     data = make_stream(batch_size, seed=0)
-    trainer.train_step(next(data))  # compile
+    first = next(data)
+    trainer.train_step(first)  # compile
+    cost = trainer.step_cost(first)
 
+    # Stage breakdown. H2D: one batch transfer, blocked (batch built
+    # outside the timer — generation is host work, not transfer).
+    h2d_batch = next(data)
     t0 = time.perf_counter()
-    for _ in range(steps):
-        metrics = trainer.train_step(next(data))
+    dev_batch = trainer.put_batch(h2d_batch)
+    jax.block_until_ready(dev_batch)
+    h2d_ms = (time.perf_counter() - t0) * 1e3
+
+    # Device step: device-resident inputs, no readback, amortized.
+    dev_batches = [trainer.put_batch(next(data)) for _ in range(2)]
+    jax.block_until_ready(dev_batches)
+    m = trainer.train_step_device(dev_batches[0])
+    jax.block_until_ready(m)
+    step_iters = max(8, steps // 2)
+    t0 = time.perf_counter()
+    for i in range(step_iters):
+        m = trainer.train_step_device(dev_batches[i % 2])
+    jax.block_until_ready(m)
+    step_ms = (time.perf_counter() - t0) / step_iters * 1e3
+
+    # Readback: one packed metrics transfer.
+    t0 = time.perf_counter()
+    jax.device_get(m)
+    readback_ms = (time.perf_counter() - t0) * 1e3
+
+    # End-to-end: the double-buffered fit loop (H2D overlapped, one
+    # readback at the end).
+    t0 = time.perf_counter()
+    metrics = trainer.fit(steps, data=data)
     elapsed = time.perf_counter() - t0
+
+    util = utilization(cost, elapsed / steps, jax.devices()[0])
     return {
         "metric": "train_samples_per_sec",
         "value": round(steps * batch_size / elapsed, 1),
         "unit": "samples/s",
         "steps_per_sec": round(steps / elapsed, 2),
         "final_loss": round(metrics["loss"], 4),
+        "h2d_ms": round(h2d_ms, 3),
+        "device_step_ms": round(step_ms, 3),
+        "metrics_readback_ms": round(readback_ms, 3),
+        "step_flops": cost["flops"],
+        "mfu": util["mfu"],
+        "achieved_tflops": util["achieved_tflops"],
+        "hbm_util": util["hbm_util"],
     }
 
 

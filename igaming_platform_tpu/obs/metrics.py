@@ -200,6 +200,11 @@ class ServiceMetrics:
             f"{service}_batch_occupancy", "Rows per device batch",
             buckets=(1, 8, 32, 64, 128, 256, 512, 1024),
         )
+        self.abuse_shed_total = self.registry.counter(
+            f"{service}_abuse_shed_total",
+            "CheckBonusAbuse requests shed with UNAVAILABLE "
+            "(ABUSE_CPU_POLICY=shed on a degraded deployment)",
+        )
         # Business-level series backing the Grafana dashboards the reference
         # README promises (README.md:196-202) but ships no data for: per-type
         # transaction flow (bonus conversion = bonus_grant rate vs deposit

@@ -1,0 +1,95 @@
+"""FLOPs/bytes cost model + device peaks: turns bench timings into
+MFU / HBM-utilization figures so "fast" is normalized against what the
+hardware can do (the reference publishes no such figures at all —
+BASELINE.md; these make "matching-or-beating" auditable).
+
+Costs come from XLA's own cost analysis of the compiled executable
+(``compiled.cost_analysis()``: ``flops`` and ``bytes accessed``) rather
+than hand-derived formulas, so they track the actual fused program.
+Peaks are a small per-``device_kind`` table of published chip specs;
+unknown kinds (e.g. the CPU fallback) report achieved rates with null
+utilization instead of inventing a denominator.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+# Published per-chip peaks: (dense bf16 FLOP/s, HBM bytes/s).
+# v5e: 197 bf16 TFLOP/s, 16 GB HBM2 @ 819 GB/s. v4: 275 TFLOP/s,
+# 1228 GB/s. v5p: 459 TFLOP/s, 2765 GB/s. v6e (Trillium): 918 TFLOP/s,
+# 1640 GB/s. Matching is by substring of jax's ``device_kind``.
+_PEAKS: dict[str, tuple[float, float]] = {
+    "v5 lite": (197e12, 819e9),
+    "v5e": (197e12, 819e9),
+    "v5p": (459e12, 2765e9),
+    "v4": (275e12, 1228e9),
+    "v6 lite": (918e12, 1640e9),
+    "v6e": (918e12, 1640e9),
+}
+
+
+def peak_for(device: Any) -> tuple[float, float] | None:
+    """(peak FLOP/s, peak HBM B/s) for a jax device, else None."""
+    kind = str(getattr(device, "device_kind", "")).lower()
+    for key, peaks in _PEAKS.items():
+        if key in kind:
+            return peaks
+    return None
+
+
+def compiled_cost(compiled: Any) -> dict[str, float]:
+    """{"flops": F, "bytes": B} per execution of a compiled executable,
+    from XLA's cost analysis; zeros when the backend exposes none."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+            cost = cost[0] if cost else {}
+    except Exception:  # noqa: BLE001 — cost analysis is best-effort
+        cost = {}
+    return {
+        "flops": float(cost.get("flops", 0.0)),
+        "bytes": float(cost.get("bytes accessed", 0.0)),
+    }
+
+
+def cost_of(fn: Any, *example_args, **lower_kwargs) -> dict[str, float]:
+    """Lower+compile ``fn`` (a jax-jittable callable or an existing
+    jitted wrapper) on example args and return its per-call cost."""
+    import jax
+
+    wrapped = fn if hasattr(fn, "lower") else jax.jit(fn)
+    compiled = wrapped.lower(*example_args, **lower_kwargs).compile()
+    return compiled_cost(compiled)
+
+
+def utilization(
+    cost: dict[str, float], seconds_per_call: float, device: Any
+) -> dict[str, float | None]:
+    """Achieved rates + utilization vs the device's published peaks.
+
+    Returns achieved_tflops / achieved_hbm_gbps always (when the cost
+    model has the numerator), and mfu / hbm_util only when the device
+    kind has a known peak — a CPU fallback line carries nulls rather
+    than a made-up denominator.
+    """
+    out: dict[str, float | None] = {
+        "achieved_tflops": None, "achieved_hbm_gbps": None,
+        "mfu": None, "hbm_util": None,
+    }
+    if seconds_per_call <= 0.0:
+        return out
+    flops_s = cost.get("flops", 0.0) / seconds_per_call
+    bytes_s = cost.get("bytes", 0.0) / seconds_per_call
+    if flops_s > 0:
+        out["achieved_tflops"] = round(flops_s / 1e12, 4)
+    if bytes_s > 0:
+        out["achieved_hbm_gbps"] = round(bytes_s / 1e9, 2)
+    peaks = peak_for(device)
+    if peaks is not None:
+        peak_flops, peak_hbm = peaks
+        if flops_s > 0:
+            out["mfu"] = round(flops_s / peak_flops, 4)
+        if bytes_s > 0:
+            out["hbm_util"] = round(bytes_s / peak_hbm, 4)
+    return out

@@ -28,8 +28,27 @@ from igaming_platform_tpu.models.sequence import (
 )
 
 
+class AbuseShed(RuntimeError):
+    """Raised when the abuse path sheds load instead of serving a
+    degraded score (ABUSE_CPU_POLICY=shed on a CPU-fallback deployment).
+    The gRPC layer maps it to UNAVAILABLE — loud, countable, never a
+    silently-slow or silently-different answer."""
+
+
 class SequenceAbuseDetector:
-    """Per-account event history + transformer scoring + device linking."""
+    """Per-account event history + transformer scoring + device linking.
+
+    ``policy`` selects the scoring path:
+
+    - ``"model"`` (default): the sequence transformer — the TPU path.
+    - ``"heuristic"``: vectorized scalar pattern-matching over the same
+      ring buffers — the class of signals the reference itself ships
+      (engine.go:462-466 / bonus_engine.go:268-275 match on scalar
+      aggregates). For ``SERVE_DEVICE_FALLBACK=cpu`` deployments where
+      the transformer would collapse to ~80 seq/s; responses carry a
+      DEGRADED_CPU_HEURISTIC signal so the degradation is visible.
+    - ``"shed"``: refuse with :class:`AbuseShed` (→ gRPC UNAVAILABLE).
+    """
 
     def __init__(
         self,
@@ -40,13 +59,17 @@ class SequenceAbuseDetector:
         mesh=None,
         seq_mode: str = "dense",
         threshold: float = 0.5,
+        policy: str = "model",
     ):
+        if policy not in ("model", "heuristic", "shed"):
+            raise ValueError(f"unknown abuse policy: {policy!r}")
         self.cfg = cfg or SeqConfig(d_model=64, n_heads=8, n_layers=2, d_ff=128)
         self.params = params if params is not None else init_sequence_model(
             jax.random.key(0), self.cfg
         )
         self.max_history = max_history
         self.threshold = threshold
+        self.policy = policy
         self._histories: dict[str, deque] = {}
         self._last_ts: dict[str, float] = {}
         self._device_accounts: dict[str, set[str]] = {}
@@ -98,15 +121,25 @@ class SequenceAbuseDetector:
     def check(self, account_id: str, bonus_id: str = "") -> tuple[float, list[str], list[str]]:
         """(abuse_score, signals, linked_accounts) — the CheckBonusAbuse
         contract (risk.proto:140-145)."""
-        scores = self.check_batch([account_id])
-        score = float(scores[0])
-        signals = abuse_signals(score, self.threshold)
+        if self.policy == "heuristic":
+            score, signals = self._heuristic_one(account_id)
+        else:
+            scores = self.check_batch([account_id])
+            score = float(scores[0])
+            signals = abuse_signals(score, self.threshold)
         linked = self.linked_accounts(account_id)
         if linked:
             signals.append("MULTI_ACCOUNT")
         return score, signals, linked
 
     def check_batch(self, account_ids: list[str], seq_len: int | None = None) -> np.ndarray:
+        if self.policy == "shed":
+            raise AbuseShed("abuse scoring shed: sequence model unavailable "
+                            "on this deployment (ABUSE_CPU_POLICY=shed)")
+        if self.policy == "heuristic":
+            return np.array(
+                [self._heuristic_one(a)[0] for a in account_ids], dtype=np.float32
+            )
         seq_len = seq_len or min(self.max_history, 64)
         x = self._history_matrix(account_ids, seq_len)
         # On a mesh, the batch axis shards over `data`: pad to a multiple
@@ -117,6 +150,58 @@ class SequenceAbuseDetector:
             padded = ((n + self._batch_multiple - 1) // self._batch_multiple) * self._batch_multiple
             x = np.concatenate([x, np.zeros((padded - n, *x.shape[1:]), x.dtype)])
         return np.asarray(self._fn(self.params, x))[:n]
+
+    def _heuristic_one(self, account_id: str) -> tuple[float, list[str]]:
+        """Scalar pattern-matching over the encoded ring buffer — the
+        reference's own abuse signal class (engine.go:462-466), kept as
+        the CPU-fallback scorer. O(history) numpy, no device."""
+        from igaming_platform_tpu.models.sequence import TX_TYPE_INDEX
+
+        with self._lock:
+            hist = self._histories.get(account_id)
+            h = np.stack(hist) if hist else None
+        signals = ["DEGRADED_CPU_HEURISTIC"]
+        if h is None or not len(h):
+            return 0.0, signals
+        dt_s = np.expm1(h[:, 1])  # encode_event stores log1p(dt)
+        types = h[:, 2:10]
+        # First event's dt is a 0 placeholder (no predecessor), not a
+        # rapid-fire gap — a single ordinary deposit must not look fast.
+        rapid = float(np.mean(dt_s[1:] < 30.0)) if len(dt_s) > 1 else 0.0
+        bonus_frac = float(
+            types[:, TX_TYPE_INDEX["bonus_grant"]].mean()
+            + types[:, TX_TYPE_INDEX["bonus_wager"]].mean()
+        )
+        gi = np.flatnonzero(types[:, TX_TYPE_INDEX["bonus_grant"]] > 0)
+        wi = np.flatnonzero(types[:, TX_TYPE_INDEX["withdraw"]] > 0)
+        quick_cashout = 0.0
+        if gi.size and wi.size:
+            # Wall-clock from grant to a later withdraw (< 1 h = abuse
+            # shape: grant -> burn wagering -> cash out), via cumulative
+            # inter-event time — event-count gaps would miss a grant
+            # followed by many rapid wagers.
+            t = np.cumsum(dt_s)
+            gap_s = t[wi[None, :]] - t[gi[:, None]]
+            after = wi[None, :] > gi[:, None]
+            quick_cashout = float((after & (gap_s < 3600.0)).any())
+        low_weight = float(
+            np.mean((h[:, 10] < 0.2) & (types[:, TX_TYPE_INDEX["bonus_wager"]] > 0))
+        )
+        score = float(min(
+            1.0,
+            0.45 * rapid + 0.6 * bonus_frac + 0.35 * quick_cashout + 0.3 * low_weight,
+        ))
+        if rapid > 0.5:
+            signals.append("RAPID_FIRE_WAGERING")
+        if bonus_frac > 0.5:
+            signals.append("BONUS_ONLY_PLAYER")
+        if quick_cashout:
+            signals.append("QUICK_BONUS_CASHOUT")
+        if low_weight > 0.3:
+            signals.append("LOW_WEIGHT_GAME_FOCUS")
+        if score >= self.threshold:
+            signals.append("SEQUENCE_MODEL_HIGH_RISK")
+        return score, signals
 
     def is_abuser(self, account_id: str) -> bool:
         """BonusEngine RiskChecker seam (bonus_engine.go:139-141)."""

@@ -373,7 +373,18 @@ class RiskGrpcService:
 
     def CheckBonusAbuse(self, request, context):
         if self.abuse_detector is not None:
-            score, signals, linked = self.abuse_detector(request.account_id, request.bonus_id)
+            from igaming_platform_tpu.serve.abuse import AbuseShed
+
+            try:
+                score, signals, linked = self.abuse_detector(
+                    request.account_id, request.bonus_id)
+            except AbuseShed as exc:
+                # Loud shed, never a silent 80 seq/s: UNAVAILABLE plus a
+                # dedicated counter (errors_total itself is incremented
+                # by the RPC wrapper — incrementing it here too would
+                # double-count).
+                self.metrics.abuse_shed_total.inc()
+                raise RpcAbort(grpc.StatusCode.UNAVAILABLE, str(exc)) from exc
         else:
             # Scalar fallback: the bonus-only-player heuristic.
             import numpy as np

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import signal
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -153,9 +154,20 @@ class RiskServer:
         # Sequence-parallel abuse scoring when the mesh has a `seq` axis:
         # ring attention shards each event history across chips (CP).
         seq_sharded = mesh is not None and int(mesh.shape.get("seq", 1)) > 1
+        # On a CPU-fallback deployment the transformer collapses (~80
+        # seq/s) — the abuse path must not silently become the outage:
+        # ABUSE_CPU_POLICY picks `heuristic` (default: the reference's
+        # own scalar signal class, >=10k checks/s, responses flagged
+        # DEGRADED_CPU_HEURISTIC) or `shed` (gRPC UNAVAILABLE + metric).
+        abuse_policy = "model"
+        if os.environ.get("SERVE_DEVICE_FALLBACK", "").lower() == "cpu":
+            abuse_policy = os.environ.get("ABUSE_CPU_POLICY", "heuristic")
+            logger.warning("abuse path degraded to policy=%s (CPU fallback)",
+                           abuse_policy)
         self.abuse = SequenceAbuseDetector(
             mesh=mesh if seq_sharded else None,
             seq_mode="ring" if seq_sharded else "dense",
+            policy=abuse_policy,
         )
         self.broker = resolve_transport(broker, self.config.rabbitmq_url)
         self.bridge = ScoringBridge(self.engine, self.broker, abuse_detector=self.abuse)

@@ -98,18 +98,23 @@ class Trainer:
             params = optax.apply_updates(params, updates)
             return params, opt_state, metrics
 
+        self._batch_sh = None
+        self._vec_sh = None
         if mesh is not None:
             pspecs = param_specs(params)
             p_sh = tree_shardings(mesh, pspecs)
-            batch_sh = NamedSharding(mesh, P(AXIS_DATA, None))
-            vec_sh = NamedSharding(mesh, P(AXIS_DATA))
+            self._batch_sh = NamedSharding(mesh, P(AXIS_DATA, None))
+            self._vec_sh = NamedSharding(mesh, P(AXIS_DATA))
             params = jax.device_put(params, p_sh)
             # optax moment buffers mirror the param pytree, so re-initialising
             # from sharded params inherits the TP layout; jit infers the rest.
             opt_state = self.optimizer.init(params)
             self._step_fn = jax.jit(
                 train_step,
-                in_shardings=(p_sh, None, batch_sh, vec_sh, vec_sh, vec_sh),
+                in_shardings=(
+                    p_sh, None, self._batch_sh,
+                    self._vec_sh, self._vec_sh, self._vec_sh,
+                ),
                 out_shardings=(p_sh, None, None),
                 donate_argnums=(0, 1),
             )
@@ -118,12 +123,40 @@ class Trainer:
 
         self.state = TrainState(params=params, opt_state=opt_state, step=0)
 
-    def train_step(self, batch: Batch) -> dict[str, float]:
+    def put_batch(self, batch: Batch) -> tuple:
+        """Start the H2D transfer for a batch (async — device_put returns
+        immediately) with the mesh's batch shardings when sharded. Feeding
+        ``train_step_device`` with pre-put batches overlaps the next
+        batch's transfer with the current step's compute — per-step
+        synchronous H2D is what made device training slower than the CPU
+        control over the tunneled chip."""
+        if self._batch_sh is not None:
+            return (
+                jax.device_put(batch.x, self._batch_sh),
+                jax.device_put(batch.fraud, self._vec_sh),
+                jax.device_put(batch.ltv, self._vec_sh),
+                jax.device_put(batch.churn, self._vec_sh),
+            )
+        return (
+            jax.device_put(batch.x), jax.device_put(batch.fraud),
+            jax.device_put(batch.ltv), jax.device_put(batch.churn),
+        )
+
+    def train_step_device(self, dev_batch: tuple):
+        """One training step with NO host synchronization: inputs are
+        device arrays from ``put_batch`` and the returned metrics stay on
+        device. Callers materialize them every N steps (one packed D2H)
+        instead of five scalar readbacks per step — over a tunneled
+        device each sync readback costs a full RTT."""
         params, opt_state, metrics = self._step_fn(
-            self.state.params, self.state.opt_state, batch.x, batch.fraud, batch.ltv, batch.churn
+            self.state.params, self.state.opt_state, *dev_batch
         )
         self.state = TrainState(params=params, opt_state=opt_state, step=self.state.step + 1)
-        return {k: float(v) for k, v in metrics.items()}
+        return metrics
+
+    def train_step(self, batch: Batch) -> dict[str, float]:
+        metrics = self.train_step_device(self.put_batch(batch))
+        return {k: float(v) for k, v in jax.device_get(metrics).items()}
 
     def fit(
         self,
@@ -132,13 +165,33 @@ class Trainer:
         log_every: int = 50,
         log_fn=None,
     ) -> dict[str, float]:
+        """Double-buffered training loop: batch k+1's H2D overlaps batch
+        k's step; metrics are read back (one transfer) only at log points
+        and at the end."""
+        if steps <= 0:
+            return {}
         data = data or make_stream(self.cfg.batch_size, seed=self.cfg.seed)
-        metrics: dict[str, float] = {}
+        metrics = None
+        pending = self.put_batch(next(data))
         for i in range(steps):
-            metrics = self.train_step(next(data))
+            current = pending
+            if i + 1 < steps:
+                pending = self.put_batch(next(data))
+            metrics = self.train_step_device(current)
             if log_fn is not None and (i + 1) % log_every == 0:
-                log_fn(self.state.step, metrics)
-        return metrics
+                log_fn(self.state.step,
+                       {k: float(v) for k, v in jax.device_get(metrics).items()})
+        return {k: float(v) for k, v in jax.device_get(metrics).items()}
+
+    def step_cost(self, batch: Batch) -> dict[str, float]:
+        """XLA's per-step FLOPs/bytes for this trainer's compiled step
+        (obs/perfmodel) — the numerator for MFU reporting."""
+        from igaming_platform_tpu.obs.perfmodel import compiled_cost
+
+        lowered = self._step_fn.lower(
+            self.state.params, self.state.opt_state, *self.put_batch(batch)
+        )
+        return compiled_cost(lowered.compile())
 
     def export_params(self):
         """Hand the live params to the serving engine (zero-copy on the

@@ -477,3 +477,110 @@ def test_compile_cache_rejects_foreign_host_entries(tmp_path):
     (dir_a / "some-executable").write_bytes(b"\x00xla")
     dir_b = base / f"cpu-{fp_b}"
     assert not dir_b.exists()
+
+
+# -- CPU-fallback abuse policies (engine.go:462-466 floor semantics) ---------
+
+
+def _planted_abuser(det):
+    """bonus_grant -> rapid low-weight wagering -> quick withdraw."""
+    t = 1_000_000.0
+    det.record_event("abuser", 5_000, "bonus_grant", timestamp=t)
+    for i in range(20):
+        t += 4.0
+        det.record_event("abuser", 400, "bonus_wager", game_weight=0.1,
+                         timestamp=t)
+    det.record_event("abuser", 9_000, "withdraw", timestamp=t + 5.0)
+
+
+def _normal_player(det):
+    t = 1_000_000.0
+    for i in range(12):
+        t += 3600.0
+        det.record_event("normal", 2_000, ("deposit", "bet", "win")[i % 3],
+                         game_weight=1.0, timestamp=t)
+
+
+def test_abuse_heuristic_policy_separates_abuser_from_normal():
+    """ABUSE_CPU_POLICY=heuristic: scalar pattern-matching over the same
+    ring buffers keeps the abuse path alive on CPU fallback; responses
+    are flagged DEGRADED_CPU_HEURISTIC."""
+    from igaming_platform_tpu.serve.abuse import SequenceAbuseDetector
+
+    det = SequenceAbuseDetector(policy="heuristic")
+    _planted_abuser(det)
+    _normal_player(det)
+
+    score_a, signals_a, _ = det.check("abuser")
+    score_n, signals_n, _ = det.check("normal")
+    assert score_a >= det.threshold > score_n
+    assert "DEGRADED_CPU_HEURISTIC" in signals_a
+    assert "DEGRADED_CPU_HEURISTIC" in signals_n
+    assert "QUICK_BONUS_CASHOUT" in signals_a
+    assert "RAPID_FIRE_WAGERING" in signals_a
+    assert det.is_abuser("abuser") and not det.is_abuser("normal")
+    # Batch path agrees with the single path.
+    batch = det.check_batch(["abuser", "normal", "no-history"])
+    assert batch[0] >= det.threshold > batch[1]
+    assert batch[2] == 0.0
+
+
+def test_abuse_heuristic_throughput_floor():
+    """The heuristic must clear the >=10k checks/s floor on plain CPU —
+    the whole point of not serving the transformer there."""
+    import time as _time
+
+    from igaming_platform_tpu.serve.abuse import SequenceAbuseDetector
+
+    det = SequenceAbuseDetector(policy="heuristic")
+    _planted_abuser(det)
+    _normal_player(det)
+    accounts = ["abuser", "normal"] * 50
+    det.check_batch(accounts)  # warm
+    t0 = _time.perf_counter()
+    iters = 20
+    for _ in range(iters):
+        det.check_batch(accounts)
+    per_sec = len(accounts) * iters / (_time.perf_counter() - t0)
+    assert per_sec >= 10_000, f"heuristic too slow: {per_sec:.0f} checks/s"
+
+
+def test_abuse_shed_policy_maps_to_unavailable():
+    """ABUSE_CPU_POLICY=shed: CheckBonusAbuse aborts UNAVAILABLE and the
+    error is counted — never a silent collapse."""
+    import grpc
+    import pytest
+
+    from igaming_platform_tpu.obs.metrics import ServiceMetrics
+    from igaming_platform_tpu.serve.abuse import AbuseShed, SequenceAbuseDetector
+    from igaming_platform_tpu.serve.grpc_server import RiskGrpcService, RpcAbort
+    from igaming_platform_tpu.proto_gen.risk.v1 import risk_pb2
+
+    det = SequenceAbuseDetector(policy="shed")
+    with pytest.raises(AbuseShed):
+        det.check("anyone")
+
+    engine = TPUScoringEngine(
+        batcher_config=BatcherConfig(batch_size=8, max_wait_ms=1.0))
+    try:
+        metrics = ServiceMetrics("risk_shed_test")
+        svc = RiskGrpcService(
+            engine, abuse_detector=lambda a, b: det.check(a, b),
+            metrics=metrics)
+        with pytest.raises(RpcAbort) as exc_info:
+            svc.CheckBonusAbuse(
+                risk_pb2.CheckBonusAbuseRequest(account_id="x", bonus_id="b"),
+                context=None)
+        assert exc_info.value.code == grpc.StatusCode.UNAVAILABLE
+        assert metrics.abuse_shed_total.value() == 1.0
+    finally:
+        engine.close()
+
+
+def test_abuse_rejects_unknown_policy():
+    import pytest
+
+    from igaming_platform_tpu.serve.abuse import SequenceAbuseDetector
+
+    with pytest.raises(ValueError):
+        SequenceAbuseDetector(policy="bogus")
