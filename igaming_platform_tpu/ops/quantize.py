@@ -77,6 +77,89 @@ def quantize_multitask_fraud(params: Params, calibration_x: jnp.ndarray | None =
     )
 
 
+# ---------------------------------------------------------------------------
+# int8 WIRE transport (WIRE_DTYPE=int8): 4x fewer H2D bytes than float32
+# ---------------------------------------------------------------------------
+#
+# The feature wire ships RAW features (cents, seconds, counts) whose ranges
+# span 8 orders of magnitude, so a single linear int8 grid would zero out
+# small amounts entirely. Instead each feature is quantized in a
+# per-feature CALIBRATED domain chosen from the schema itself
+# (core/features.py; the same knowledge normalize() uses):
+#
+# - wide-range features (amounts, durations, counts): symmetric signed-log
+#   domain sign(x)*log1p(|x|) with a per-feature calibrated ceiling —
+#   constant RELATIVE precision (half-step ~2.5-10% depending on the
+#   ceiling), so a $5 bet and a $50k deposit both survive; values beyond
+#   a ceiling clamp to it (ceilings are set beyond realistic data);
+# - bounded features (booleans, ratios, rates): linear over [0, 1] —
+#   absolute step 1/127.
+#
+# Like WIRE_DTYPE=bf16 this is NOT reference-exact: a feature within one
+# quantization step of a rule threshold can flip that rule (bounded by the
+# rule's weighted contribution; pinned in tests/test_scorer_chunking.py).
+# Zero stays exactly zero in both domains, so batch padding is exact.
+
+import numpy as np
+
+from igaming_platform_tpu.core.features import F, NUM_FEATURES
+
+
+def _wire8_domain_tables() -> tuple[np.ndarray, np.ndarray]:
+    """(log_ceiling [30], linear_mask [30]): per-feature signed-log
+    ceilings (0 where the feature is linear [0,1])."""
+    ceil = np.zeros((NUM_FEATURES,), dtype=np.float32)
+    linear = np.zeros((NUM_FEATURES,), dtype=np.float32)
+    amounts = (F.TX_SUM_1H, F.TX_AVG_1H, F.AVG_BET_SIZE, F.TX_AMOUNT)
+    # Lifetime aggregates get a far higher ceiling ($1B): rule 6 compares
+    # TOTAL_WITHDRAWALS against TOTAL_DEPOSITS, and clamping BOTH at a
+    # reachable ceiling would systematically fire the ratio rule for
+    # every whale account — a population error, not the disclosed
+    # near-threshold flip. Values beyond any ceiling still clamp.
+    lifetime = (F.TOTAL_DEPOSITS, F.TOTAL_WITHDRAWALS, F.NET_DEPOSIT)
+    durations = (F.TIME_SINCE_LAST_TX, F.SESSION_DURATION)
+    ages = (F.DEVICE_AGE_DAYS, F.ACCOUNT_AGE_DAYS)
+    big_counts = (F.TX_COUNT_1H, F.DEPOSIT_COUNT, F.WITHDRAW_COUNT,
+                  F.BONUS_CLAIM_COUNT, F.IP_COUNTRY_CHANGES)
+    small_counts = (F.TX_COUNT_1M, F.TX_COUNT_5M,
+                    F.UNIQUE_DEVICES_24H, F.UNIQUE_IPS_24H)
+    for idx, hi in (
+        (amounts, float(np.log1p(1e9))),         # cents up to $10M
+        (lifetime, float(np.log1p(1e11))),       # cents up to $1B
+        (durations, float(np.log1p(604800.0))),  # a week of seconds
+        (ages, float(np.log1p(3650.0))),         # a decade of days
+        (big_counts, float(np.log1p(1e4))),
+        (small_counts, float(np.log1p(1e3))),
+    ):
+        for f in idx:
+            ceil[f] = hi
+    for f in (F.WIN_RATE, F.IS_VPN, F.IS_PROXY, F.IS_TOR, F.DISPOSABLE_EMAIL,
+              F.BONUS_WAGER_RATE, F.BONUS_ONLY_PLAYER,
+              F.TX_TYPE_DEPOSIT, F.TX_TYPE_WITHDRAW, F.TX_TYPE_BET):
+        linear[f] = 1.0
+        ceil[f] = 1.0  # step = hi/127 in the linear domain too
+    assert (ceil > 0).all(), "every feature needs a wire-int8 domain"
+    return ceil, linear
+
+
+W8_CEIL, W8_LINEAR = _wire8_domain_tables()
+
+
+def wire_quantize_int8(x: np.ndarray) -> np.ndarray:
+    """Host side: raw f32 [B, 30] -> int8 [B, 30] (numpy, pre-H2D)."""
+    x = np.asarray(x, np.float32)
+    t = np.where(W8_LINEAR > 0, x, np.sign(x) * np.log1p(np.abs(x)))
+    q = np.rint(t * (127.0 / W8_CEIL))
+    return np.clip(q, -127, 127).astype(np.int8)
+
+
+def wire_dequantize_int8(q: jnp.ndarray) -> jnp.ndarray:
+    """Device side (jittable): int8 [B, 30] -> raw f32 [B, 30]."""
+    t = q.astype(jnp.float32) * (jnp.asarray(W8_CEIL) / 127.0)
+    logged = jnp.sign(t) * jnp.expm1(jnp.abs(t))
+    return jnp.where(jnp.asarray(W8_LINEAR) > 0, t, logged)
+
+
 def _quantize_rows(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """[B, D] f32 -> (int8, [B] per-row scales), symmetric absmax."""
     absmax = jnp.max(jnp.abs(x), axis=-1)

@@ -119,3 +119,61 @@ def test_trained_multitask_checkpoint_quantizes_for_serving():
     assert np.max(diff) <= 3
     assert np.mean(diff) < 1.0
     assert np.mean(diff <= 1) > 0.9
+
+
+# -- int8 WIRE transport codec (WIRE_DTYPE=int8) -----------------------------
+
+
+def test_wire_int8_roundtrip_relative_error():
+    """Wide-range features survive the signed-log int8 wire with bounded
+    RELATIVE error; bounded features with bounded absolute error; zero
+    (the batch pad value) is exact."""
+    import numpy as np
+
+    from igaming_platform_tpu.core.features import F, NUM_FEATURES
+    from igaming_platform_tpu.ops.quantize import (
+        wire_dequantize_int8,
+        wire_quantize_int8,
+    )
+
+    rng = np.random.default_rng(0)
+    x = np.zeros((256, NUM_FEATURES), dtype=np.float32)
+    x[:, F.TX_AMOUNT] = 10.0 ** rng.uniform(1, 7, size=256)   # $0.10..$100k
+    x[:, F.TX_COUNT_1M] = rng.integers(0, 20, size=256)
+    x[:, F.NET_DEPOSIT] = rng.normal(0, 1e6, size=256)        # signed
+    x[:, F.WIN_RATE] = rng.uniform(0, 1, size=256)
+    x[:, F.IS_VPN] = rng.integers(0, 2, size=256)
+
+    q = wire_quantize_int8(x)
+    assert q.dtype == np.int8
+    back = np.asarray(wire_dequantize_int8(q))
+
+    amt, amt_b = x[:, F.TX_AMOUNT], back[:, F.TX_AMOUNT]
+    rel = np.abs(amt_b - amt) / amt
+    assert rel.max() < 0.09, rel.max()  # log1p(1e9)/127 half-step => ~8.5%
+
+    net, net_b = x[:, F.NET_DEPOSIT], back[:, F.NET_DEPOSIT]
+    nz = np.abs(net) > 1.0
+    assert np.all(np.sign(net[nz]) == np.sign(net_b[nz]))  # sign survives
+    assert (np.abs(net_b[nz] - net[nz]) / np.abs(net[nz])).max() < 0.11
+
+    # Whale lifetime aggregates must NOT clamp at reachable magnitudes:
+    # rule 6 compares withdrawals vs deposits, and a shared saturated
+    # ceiling would fire it for every high-value account.
+    w = np.zeros((1, NUM_FEATURES), dtype=np.float32)
+    w[0, F.TOTAL_DEPOSITS] = 5e8    # $5M lifetime deposits (cents)
+    w[0, F.TOTAL_WITHDRAWALS] = 1.5e8
+    wb = np.asarray(wire_dequantize_int8(wire_quantize_int8(w)))
+    # Exact rule: 1.5e8 > 0.8 * 5e8 is False; must stay False after the wire.
+    assert wb[0, F.TOTAL_WITHDRAWALS] <= 0.8 * wb[0, F.TOTAL_DEPOSITS]
+
+    cnt, cnt_b = x[:, F.TX_COUNT_1M], back[:, F.TX_COUNT_1M]
+    assert np.abs(cnt_b - cnt).max() < 0.6  # ~log-domain step at 20
+
+    assert np.abs(back[:, F.WIN_RATE] - x[:, F.WIN_RATE]).max() < 0.005
+    assert np.abs(back[:, F.IS_VPN] - x[:, F.IS_VPN]).max() < 0.005
+
+    # Zero rows (padding) are bit-exact through the wire.
+    zq = wire_quantize_int8(np.zeros((4, NUM_FEATURES), np.float32))
+    assert (zq == 0).all()
+    assert (np.asarray(wire_dequantize_int8(zq)) == 0.0).all()

@@ -74,3 +74,68 @@ def test_wire_dtype_bf16_typical_rows_and_threshold_edges(monkeypatch):
     with pytest.raises(ValueError):
         TPUScoringEngine(batcher_config=BatcherConfig(batch_size=16, max_wait_ms=1),
                          warmup=False)
+
+
+def test_wire_dtype_int8_typical_rows_and_decisions(monkeypatch):
+    """WIRE_DTYPE=int8 (4x H2D compression): typical rows keep their
+    decisions within the disclosed envelope — one rule's weighted
+    contribution worst-case, same caveat class as bf16 with a wider
+    step. Padding zeros stay exact (pinned by the codec test)."""
+    import numpy as np
+
+    # Amounts log-spaced away from rule thresholds by >8% (the int8
+    # signed-log step at the $1M ceiling is ~7.5% relative).
+    reqs = [
+        ScoreRequest(f"i8-{i}", amount=int(120 * 1.31 ** (i % 24)) + 7 * i,
+                     tx_type=("deposit", "bet", "withdraw")[i % 3])
+        for i in range(200)
+    ]
+
+    monkeypatch.delenv("WIRE_DTYPE", raising=False)
+    eng32 = TPUScoringEngine(batcher_config=BatcherConfig(batch_size=64, max_wait_ms=1))
+    try:
+        base = eng32.score_batch(reqs)
+    finally:
+        eng32.close()
+
+    monkeypatch.setenv("WIRE_DTYPE", "int8")
+    eng8 = TPUScoringEngine(batcher_config=BatcherConfig(batch_size=64, max_wait_ms=1))
+    try:
+        assert eng8._wire_dtype is np.int8
+        quant = eng8.score_batch(reqs)
+    finally:
+        eng8.close()
+
+    # The worst a quantization step can do is flip rules whose threshold
+    # it straddles: bounded by the ensemble's rule share of one rule's
+    # weight (large-tx 30 x 0.4 = 12), as with bf16's edge test.
+    deltas = [abs(a.score - b.score) for a, b in zip(base, quant)]
+    assert max(deltas) <= 13, max(deltas)
+    # And the overwhelming majority of rows are decision-identical.
+    agree = sum(a.action == b.action for a, b in zip(base, quant))
+    assert agree >= int(0.95 * len(reqs)), agree
+    for b in quant:
+        assert b.action in ("approve", "review", "block")
+
+
+def test_wire_dtype_int8_host_tier_stays_float32(monkeypatch):
+    """The host latency tier has no device link to compress: under
+    WIRE_DTYPE=int8 it must compile the UNWRAPPED f32 graph — feeding raw
+    features through the int8 dequantizer would explode them to inf and
+    silently garbage every near-empty flush."""
+    import numpy as np
+
+    monkeypatch.setenv("WIRE_DTYPE", "int8")
+    monkeypatch.setenv("HOST_TIER_FORCE", "1")
+    eng = TPUScoringEngine(
+        batcher_config=BatcherConfig(batch_size=64, max_wait_ms=1,
+                                     host_tier_rows=8))
+    try:
+        assert eng._fn_host is not None  # tier actually built (forced)
+        # Single request -> near-empty flush -> host tier (n=1 <= 8).
+        resp = eng.score(ScoreRequest("ht-1", amount=50_000, tx_type="deposit"))
+        assert resp.action in ("approve", "review", "block")
+        assert 0 <= resp.score <= 100
+        assert np.isfinite(resp.ml_score) and 0.0 <= resp.ml_score <= 1.0
+    finally:
+        eng.close()
