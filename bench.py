@@ -96,6 +96,16 @@ def device_pipeline_numbers() -> dict:
     jax.block_until_ready(out)
     device_step_ms = (time.perf_counter() - t0) / dev_iters * 1000.0
 
+    # Utilization vs chip peaks (obs/perfmodel): the [B,30] ensemble is
+    # bandwidth-bound, so hbm_util is the meaningful figure; mfu rides
+    # along where a peak is known.
+    from igaming_platform_tpu.obs.perfmodel import cost_of, utilization
+
+    util = utilization(
+        cost_of(fn_nd, params, xd, bld, thrd),
+        device_step_ms / 1000.0, jax.devices()[0],
+    )
+
     lat = np.array(lat)
     return {
         "device_stream_txns_per_sec": round(batch_size * iters / total, 1),
@@ -104,6 +114,9 @@ def device_pipeline_numbers() -> dict:
         "device_txns_per_sec": round(batch_size / (device_step_ms / 1000.0), 1),
         "batch_size": batch_size,
         "pipeline_depth": pipeline_depth,
+        "hbm_util": util["hbm_util"],
+        "achieved_hbm_gbps": util["achieved_hbm_gbps"],
+        "mfu": util["mfu"],
     }
 
 

@@ -19,6 +19,7 @@ behind the same interface unchanged.
 from __future__ import annotations
 
 import contextlib
+import os
 import sqlite3
 import threading
 import time
@@ -368,7 +369,19 @@ class SQLiteStore(DedupeStoreMixin):
 
     def __init__(self, path: str = ":memory:"):
         self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._conn.execute("PRAGMA journal_mode=WAL") if path != ":memory:" else None
+        if path != ":memory:":
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            # Batched fsync: in WAL mode, NORMAL syncs at checkpoints
+            # instead of per-commit — the group-commit analog that lifts
+            # the wallet hot path off the per-op fsync floor. Durability
+            # window: an OS crash can lose the tail of the WAL (commits
+            # since the last checkpoint); the database itself stays
+            # consistent, and the ledger reconciles what persisted.
+            # SQLITE_SYNCHRONOUS=FULL restores per-commit sync.
+            sync = os.environ.get("SQLITE_SYNCHRONOUS", "NORMAL").upper()
+            if sync not in ("OFF", "NORMAL", "FULL", "EXTRA"):
+                raise ValueError(f"SQLITE_SYNCHRONOUS={sync!r} not a sqlite mode")
+            self._conn.execute(f"PRAGMA synchronous={sync}")
         self._conn.executescript(_SCHEMA)
         self._lock = threading.RLock()
         self._tx_depth = 0
