@@ -1,0 +1,49 @@
+"""Trained-model TPU-vs-CPU numerics bounds (train/device_parity.py).
+
+The CPU-pinned default suite runs the harness same-backend (a cheap
+self-consistency check of the machinery); the REAL device run is gated
+on TPU_PARITY_TEST=1 and spawns a subprocess WITHOUT the conftest CPU
+pin so the TPU backend initializes — run it on a TPU host:
+
+    TPU_PARITY_TEST=1 python -m pytest tests/test_device_parity.py -q
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def test_parity_harness_self_consistent_on_cpu():
+    """Same-backend run must report ~zero deltas — proves the harness
+    itself doesn't manufacture deviation."""
+    from igaming_platform_tpu.train.device_parity import run
+
+    result = run(n_rows=6_000, steps=40)
+    assert result["same_backend"] is True
+    assert result["max_prob_delta"] <= 1e-6
+    assert result["ok"] is True
+
+
+@pytest.mark.skipif(
+    os.environ.get("TPU_PARITY_TEST") != "1",
+    reason="device run: set TPU_PARITY_TEST=1 on a TPU host",
+)
+def test_trained_models_match_cpu_on_device():
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo_root, env.get("PYTHONPATH", "")) if p)
+    proc = subprocess.run(
+        [sys.executable, "-m", "igaming_platform_tpu.train.device_parity",
+         "--rows", "20000", "--steps", "150"],
+        capture_output=True, text=True, timeout=1800, env=env, cwd=repo_root,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["ok"] is True
+    assert result["max_prob_delta"] <= 5e-3
+    assert result["max_auc_delta"] <= 1e-3
