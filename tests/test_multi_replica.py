@@ -14,14 +14,17 @@ three things the deployment model promises:
 3. the ledger reconciles the final balance exactly.
 
 A second suite drives replicas as two OS PROCESSES for the process-
-boundary claim. Live-Postgres versions of the same assertions remain in
-the POSTGRES_URL-gated suites.
+boundary claim. Every test here is parametrized to ALSO run against a
+live PostgreSQL when POSTGRES_URL is set — the rig proves the
+capability in CI, the live run proves the rig didn't flatter us.
 """
 
 import os
 import subprocess
 import sys
 import threading
+import types
+import uuid
 
 import pytest
 
@@ -30,10 +33,22 @@ from igaming_platform_tpu.platform.pg_store import PostgresStore
 from igaming_platform_tpu.platform.pg_testing import PgSqliteServer
 from igaming_platform_tpu.platform.wallet import WalletService
 
+_live_param = pytest.param(
+    "live",
+    marks=pytest.mark.skipif(
+        not os.environ.get("POSTGRES_URL"),
+        reason="integration: set POSTGRES_URL to a live PostgreSQL",
+    ),
+)
 
-@pytest.fixture()
-def pg_server(tmp_path):
+
+@pytest.fixture(params=["rig", _live_param])
+def pg_server(request, tmp_path):
+    if request.param == "live":
+        yield types.SimpleNamespace(url=os.environ["POSTGRES_URL"], live=True)
+        return
     server = PgSqliteServer(str(tmp_path / "shared.db"))
+    server.live = False
     yield server
     server.close()
 
@@ -49,7 +64,7 @@ def test_postgres_store_boots_and_operates_through_the_rig(pg_server):
     deposit/bet/idempotency cycle, all through the real wire protocol."""
     wallet, store = _wallet(pg_server.url)
     try:
-        acct = wallet.create_account("rig-p1")
+        acct = wallet.create_account(f"rig-p1-{uuid.uuid4().hex[:8]}")
         wallet.deposit(acct.id, 10_000, "d1")
         wallet.bet(acct.id, 2_500, "b1", game_id="g1")
         # Idempotent replay: same key returns the stored result and
@@ -68,6 +83,8 @@ def test_postgres_store_boots_and_operates_through_the_rig(pg_server):
 def test_concurrent_boot_serialized_by_advisory_lock(pg_server):
     """Two replicas booting against one fresh database must not collide
     on migration DDL (the golang-migrate race the advisory lock guards)."""
+    if getattr(pg_server, "live", False):
+        pytest.skip("needs a FRESH database; the live DB is already migrated")
     errors: list[Exception] = []
 
     def boot():
@@ -92,7 +109,7 @@ def test_cross_replica_optimistic_lock_contention(pg_server):
     wallet_a, store_a = _wallet(pg_server.url)
     wallet_b, store_b = _wallet(pg_server.url)
     try:
-        acct = wallet_a.create_account("contend-1")
+        acct = wallet_a.create_account(f"contend-{uuid.uuid4().hex[:8]}")
         ops_per_thread, n_threads = 12, 2  # per replica
         conflicts = [0]
         lock = threading.Lock()
@@ -171,7 +188,7 @@ def test_cross_replica_two_os_processes(pg_server, tmp_path):
     replicas in separate OS processes against one shared database."""
     wallet, store = _wallet(pg_server.url)
     try:
-        acct = wallet.create_account("proc-contend")
+        acct = wallet.create_account(f"proc-contend-{uuid.uuid4().hex[:8]}")
     finally:
         store.close()
 
@@ -190,7 +207,14 @@ def test_cross_replica_two_os_processes(pg_server, tmp_path):
         )
         for replica in ("a", "b")
     ]
-    outs = [p.communicate(timeout=180) for p in procs]
+    try:
+        outs = [p.communicate(timeout=180) for p in procs]
+    except subprocess.TimeoutExpired:
+        # Never leak replicas that keep writing to a (possibly live)
+        # shared database after the test fails.
+        for p in procs:
+            p.kill()
+        raise
     assert all(p.returncode == 0 for p in procs), outs
 
     wallet, store = _wallet(pg_server.url)

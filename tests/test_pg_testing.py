@@ -1,0 +1,135 @@
+"""Protocol-level behavior of the SQLite-backed PG server
+(platform/pg_testing.py), driven through the real wire client."""
+
+import threading
+
+import pytest
+
+from igaming_platform_tpu.platform.pg_testing import PgSqliteServer
+from igaming_platform_tpu.platform.pgwire import UNIQUE_VIOLATION, PgConnection, PgError
+
+
+@pytest.fixture()
+def server(tmp_path):
+    s = PgSqliteServer(str(tmp_path / "proto.db"))
+    yield s
+    s.close()
+
+
+def _connect(server):
+    conn = PgConnection(server.url)
+    conn.connect()
+    return conn
+
+
+def test_unique_violation_sqlstate_and_param_fidelity(server):
+    conn = _connect(server)
+    conn.execute("CREATE TABLE t (k TEXT PRIMARY KEY, v BIGINT, f DOUBLE PRECISION)")
+    conn.execute("INSERT INTO t VALUES (?, ?, ?)", ("007", 42, 1.5))
+    with pytest.raises(PgError) as exc_info:
+        conn.execute("INSERT INTO t VALUES (?, ?, ?)", ("007", 1, 1.0))
+    assert exc_info.value.sqlstate == UNIQUE_VIOLATION
+    # Numeric-looking strings must round-trip VERBATIM (leading zeros
+    # kept), while numeric columns come back as numbers via OID coercion.
+    row = conn.execute("SELECT k, v, f FROM t").fetchone()
+    assert row == ("007", 42, 1.5)
+    conn.close()
+
+
+def test_aborted_transaction_until_rollback(server):
+    conn = _connect(server)
+    conn.execute("CREATE TABLE a (x BIGINT PRIMARY KEY)")
+    conn.execute("INSERT INTO a VALUES (?)", (1,))
+    conn.begin()
+    with pytest.raises(PgError):
+        conn.execute("INSERT INTO a VALUES (?)", (1,))  # unique violation
+    # PG semantics: the transaction is aborted — further statements fail
+    # with 25P02 until ROLLBACK.
+    with pytest.raises(PgError) as exc_info:
+        conn.execute("SELECT COUNT(*) FROM a")
+    assert exc_info.value.sqlstate == "25P02"
+    conn.rollback()
+    assert conn.execute("SELECT COUNT(*) FROM a").fetchone()[0] == 1
+    conn.close()
+
+
+def test_rollback_discards_transaction_writes(server):
+    conn = _connect(server)
+    conn.execute("CREATE TABLE b (x BIGINT)")
+    conn.begin()
+    conn.execute("INSERT INTO b VALUES (?)", (7,))
+    conn.rollback()
+    assert conn.execute("SELECT COUNT(*) FROM b").fetchone()[0] == 0
+    conn.begin()
+    conn.execute("INSERT INTO b VALUES (?)", (8,))
+    conn.commit()
+    assert conn.execute("SELECT x FROM b").fetchone()[0] == 8
+    conn.close()
+
+
+def test_write_transactions_serialize_across_connections(server):
+    """BEGIN IMMEDIATE: a second writer blocks until the first commits
+    (the arbitration the multi-replica tests rely on)."""
+    c1, c2 = _connect(server), _connect(server)
+    c1.execute("CREATE TABLE w (x BIGINT)")
+    c1.begin()
+    c1.execute("INSERT INTO w VALUES (?)", (1,))
+    order: list[str] = []
+
+    def second_writer():
+        c2.begin()  # blocks on c1's write lock
+        c2.execute("INSERT INTO w VALUES (?)", (2,))
+        c2.commit()
+        order.append("c2-committed")
+
+    t = threading.Thread(target=second_writer)
+    t.start()
+    t.join(timeout=0.5)
+    assert t.is_alive(), "second writer should be blocked behind c1"
+    order.append("c1-committing")
+    c1.commit()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert order == ["c1-committing", "c2-committed"]
+    assert c1.execute("SELECT COUNT(*) FROM w").fetchone()[0] == 2
+    c1.close()
+    c2.close()
+
+
+def test_advisory_lock_blocks_second_session(server):
+    c1, c2 = _connect(server), _connect(server)
+    c1.execute("SELECT pg_advisory_lock(99)")
+    acquired: list[str] = []
+
+    def second():
+        c2.execute("SELECT pg_advisory_lock(99)")
+        acquired.append("c2")
+
+    t = threading.Thread(target=second)
+    t.start()
+    t.join(timeout=0.5)
+    assert t.is_alive(), "advisory lock must block the second session"
+    c1.execute("SELECT pg_advisory_unlock(99)")
+    t.join(timeout=30)
+    assert acquired == ["c2"]
+    c1.close()
+    c2.close()
+
+
+def test_disconnect_releases_advisory_locks(server):
+    c1 = _connect(server)
+    c1.execute("SELECT pg_advisory_lock(123)")
+    c1.close()  # session death releases its locks, like PG
+
+    c2 = _connect(server)
+    done: list[str] = []
+
+    def grab():
+        c2.execute("SELECT pg_advisory_lock(123)")
+        done.append("ok")
+
+    t = threading.Thread(target=grab)
+    t.start()
+    t.join(timeout=30)
+    assert done == ["ok"]
+    c2.close()
