@@ -118,7 +118,7 @@ def test_remat_training_matches_plain():
         assert abs(ma["loss"] - mb["loss"]) < 1e-5
 
 
-def test_double_buffered_fit_matches_stepwise(monkeypatch):
+def test_double_buffered_fit_matches_stepwise():
     """The double-buffered fit loop (async put_batch prefetch, one packed
     metrics readback) must be numerically identical to per-step
     train_step on the same stream — the input pipeline overlaps
