@@ -13,6 +13,7 @@ from configs import (  # noqa: E402
     config5_training_throughput,
     config6_wallet_ops,
     config7_wallet_wire,
+    config8_wallet_pg,
 )
 
 
@@ -56,5 +57,13 @@ def test_config7_runs():
     # Real localhost gRPC with real deadlines: tolerate a single blown
     # deadline on an overloaded CI host. The artifact's `errors` field
     # itself stays strict — this budget is test-only.
+    assert r["errors"] <= 1
+    assert r["ops"] >= 2 * 3 * 3 - 1
+
+
+def test_config8_runs():
+    r = config8_wallet_pg(n_threads=2, cycles=3)
+    assert r["value"] > 0 and r["unit"] == "ops/s"
+    assert "sqlite-backed PG server" in r["backend"]  # honest labeling
     assert r["errors"] <= 1
     assert r["ops"] >= 2 * 3 * 3 - 1
