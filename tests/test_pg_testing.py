@@ -133,3 +133,44 @@ def test_disconnect_releases_advisory_locks(server):
     t.join(timeout=30)
     assert done == ["ok"]
     c2.close()
+
+
+def test_rig_survives_adversarial_bytes(server):
+    """Garbage/truncated/mutated startup and message bytes must neither
+    crash the server nor poison a well-behaved connection that follows
+    (the adversarial-bytes discipline of the native decoder fuzz)."""
+    import socket
+    import struct
+
+    rng = __import__("numpy").random.default_rng(0)
+
+    def blast(payload: bytes) -> None:
+        s = socket.socket()
+        s.settimeout(2.0)
+        try:
+            s.connect(("127.0.0.1", server.port))
+            s.sendall(payload)
+            try:
+                s.recv(4096)
+            except OSError:
+                pass
+        finally:
+            s.close()
+
+    # Plain garbage, truncated startup, absurd lengths, random mutants.
+    blast(b"GET / HTTP/1.1\r\n\r\n")
+    blast(b"\x00\x00")
+    blast(struct.pack(">I", 2**31 - 1))
+    valid_startup = struct.pack(">II", 8, 196608)
+    for _ in range(60):
+        mutant = bytearray(valid_startup + b"user\x00tester\x00\x00")
+        for _ in range(int(rng.integers(1, 4))):
+            mutant[int(rng.integers(0, len(mutant)))] = int(rng.integers(0, 256))
+        blast(bytes(mutant))
+
+    # After all of that, a real client must still work end-to-end.
+    conn = _connect(server)
+    conn.execute("CREATE TABLE IF NOT EXISTS fz (x BIGINT)")
+    conn.execute("INSERT INTO fz VALUES (?)", (1,))
+    assert conn.execute("SELECT COUNT(*) FROM fz").fetchone()[0] == 1
+    conn.close()
