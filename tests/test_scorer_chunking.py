@@ -139,3 +139,34 @@ def test_wire_dtype_int8_host_tier_stays_float32(monkeypatch):
         assert np.isfinite(resp.ml_score) and 0.0 <= resp.ml_score <= 1.0
     finally:
         eng.close()
+
+
+def test_wire_dtype_int8_on_serving_mesh(monkeypatch):
+    """WIRE_DTYPE=int8 composes with mesh-sharded serving: the int8
+    batch shards over `data` and dequantizes in-graph; decisions match
+    the unsharded int8 engine exactly."""
+    import jax
+    import numpy as np
+
+    from igaming_platform_tpu.parallel.mesh import MeshSpec, create_mesh
+
+    monkeypatch.setenv("WIRE_DTYPE", "int8")
+    mesh = create_mesh(MeshSpec(data=8), devices=jax.devices()[:8])
+    reqs = [
+        ScoreRequest(f"m8-{i}", amount=int(150 * 1.37 ** (i % 20)) + 11 * i,
+                     tx_type=("deposit", "bet", "withdraw")[i % 3])
+        for i in range(64)
+    ]
+    eng_mesh = TPUScoringEngine(
+        batcher_config=BatcherConfig(batch_size=64, max_wait_ms=1), mesh=mesh)
+    eng_flat = TPUScoringEngine(
+        batcher_config=BatcherConfig(batch_size=64, max_wait_ms=1))
+    try:
+        assert eng_mesh._wire_dtype is np.int8
+        r_mesh = eng_mesh.score_batch(reqs)
+        r_flat = eng_flat.score_batch(reqs)
+    finally:
+        eng_mesh.close()
+        eng_flat.close()
+    assert [r.action for r in r_mesh] == [r.action for r in r_flat]
+    assert max(abs(a.score - b.score) for a, b in zip(r_mesh, r_flat)) <= 1
