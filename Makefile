@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-fast bench bench-all eval native proto run-risk run-wallet dryrun clean soak soak-wire api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
+.PHONY: all test test-fast lint bench bench-all eval native proto run-risk run-wallet dryrun clean soak soak-wire api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
 
 all: native test
 
@@ -13,6 +13,11 @@ test:
 
 test-fast:
 	$(PY) -m pytest tests/ -x -q -p no:cacheprovider
+
+# In-tree linter (no linter ships in this image): syntax, unused/dup
+# module-level imports, bare except, `== None`, mutable defaults.
+lint:
+	$(PY) tools/lint.py
 
 # Headline benchmark (driver contract: one JSON line) — real device.
 bench:

@@ -19,7 +19,6 @@ when no expert mesh is present.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable
 
 import jax

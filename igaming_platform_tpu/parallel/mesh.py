@@ -15,7 +15,6 @@ across hosts — the framework never issues raw NCCL/MPI-style calls.
 
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass
 
 import jax

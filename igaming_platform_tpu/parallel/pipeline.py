@@ -19,7 +19,6 @@ topologies can dedicate an axis by building the mesh accordingly.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable
 
 import jax

@@ -20,7 +20,6 @@ and the reference's deliberate risk asymmetry preserved:
 from __future__ import annotations
 
 import contextlib
-import time
 from dataclasses import dataclass
 from typing import Protocol
 

@@ -35,7 +35,6 @@ import threading
 import time
 import urllib.parse
 from dataclasses import dataclass
-from typing import Callable
 
 from igaming_platform_tpu.serve.events import Event, EventHandler
 

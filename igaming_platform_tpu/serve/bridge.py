@@ -48,7 +48,7 @@ class ScoringBridge:
     def __init__(
         self,
         engine: TPUScoringEngine,
-        broker: "InMemoryBroker | str",
+        broker: InMemoryBroker | str,
         *,
         abuse_detector=None,
         publish_risk_events: bool = True,

@@ -3,13 +3,11 @@
 import numpy as np
 import pytest
 
-from igaming_platform_tpu.core.config import ScoringConfig
-from igaming_platform_tpu.core.enums import BonusStatus
 from igaming_platform_tpu.platform.app import AppConfig, PlatformApp
-from igaming_platform_tpu.platform.bonus import BonusRule, NotEligibleError
+from igaming_platform_tpu.platform.bonus import NotEligibleError
 from igaming_platform_tpu.platform.domain import BonusRestrictionError, RiskReviewError
 from igaming_platform_tpu.serve.ipintel import CIDRIPIntelligence, IPRanges
-from igaming_platform_tpu.utils.logging import JSONFormatter, kv, log_context, setup_logging
+from igaming_platform_tpu.utils.logging import JSONFormatter, log_context
 
 
 @pytest.fixture()

@@ -4,13 +4,11 @@ import json
 import urllib.request
 
 import numpy as np
-import pytest
 
 from igaming_platform_tpu.core.config import BatcherConfig, RiskServiceConfig, ScoringConfig
 from igaming_platform_tpu.core.enums import (
     EXCHANGE_WALLET,
     QUEUE_ANALYTICS,
-    QUEUE_RISK_SCORING,
 )
 from igaming_platform_tpu.serve.abuse import SequenceAbuseDetector
 from igaming_platform_tpu.serve.bridge import ScoringBridge

@@ -2,7 +2,7 @@
 
 import pytest
 
-from igaming_platform_tpu.core.enums import BonusStatus, BonusType
+from igaming_platform_tpu.core.enums import BonusType
 from igaming_platform_tpu.platform.bonus import BonusEngine, BonusRule, NotEligibleError
 from igaming_platform_tpu.platform.cashback import run_cashback_job, weekly_losses
 from igaming_platform_tpu.platform.repository import (

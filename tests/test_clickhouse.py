@@ -15,7 +15,6 @@ import numpy as np
 import pytest
 
 from igaming_platform_tpu.serve.clickhouse import (
-    BATCH_FEATURES_SQL,
     ClickHouseClient,
     ClickHouseError,
     clickhouse_source,

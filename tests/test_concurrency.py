@@ -9,8 +9,6 @@ each caller its own result.
 
 import threading
 
-import numpy as np
-import pytest
 
 from igaming_platform_tpu.core.config import BatcherConfig
 from igaming_platform_tpu.platform.domain import ConcurrentUpdateError

@@ -2,7 +2,6 @@
 
 import jax
 import numpy as np
-import pytest
 
 from igaming_platform_tpu.core.features import normalize
 from igaming_platform_tpu.models.gbdt import gbdt_predict, init_gbdt

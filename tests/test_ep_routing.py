@@ -8,7 +8,6 @@ compile. SURVEY.md §2.3 EP row.
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from igaming_platform_tpu.core.features import NUM_FEATURES, normalize, standardize_for_model
 from igaming_platform_tpu.parallel.ep import (

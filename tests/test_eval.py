@@ -1,7 +1,6 @@
 """Model-quality eval: labeled fraud generator + metric math + ordering."""
 
 import numpy as np
-import pytest
 
 from igaming_platform_tpu.train.eval import (
     average_precision,

@@ -1,7 +1,6 @@
 """End-to-end gRPC tests: wire-compatible risk.v1 + wallet.v1 over localhost."""
 
 import grpc
-import numpy as np
 import pytest
 
 from igaming_platform_tpu.core.config import BatcherConfig
@@ -18,7 +17,6 @@ from igaming_platform_tpu.serve.grpc_server import (
     SERVING,
     RiskGrpcService,
     WalletGrpcService,
-    graceful_stop,
     make_health_stub,
     make_risk_stub,
     make_wallet_stub,

@@ -5,7 +5,6 @@ import time
 import pytest
 
 from igaming_platform_tpu.core.enums import (
-    EXCHANGE_WALLET,
     QUEUE_RISK_SCORING,
     AccountStatus,
     BonusStatus,
@@ -16,7 +15,6 @@ from igaming_platform_tpu.platform.bonus import (
     BonusEngine,
     BonusRule,
     Conditions,
-    InMemoryBonusRepository,
     MaxBetExceededError,
     NotEligibleError,
     PlayerInfo,
@@ -37,7 +35,7 @@ from igaming_platform_tpu.platform.repository import (
     InMemoryTransactionRepository,
     SQLiteStore,
 )
-from igaming_platform_tpu.platform.wallet import WalletConfig, WalletService
+from igaming_platform_tpu.platform.wallet import WalletService
 from igaming_platform_tpu.serve.events import Publisher, default_broker
 
 RULES_PATH = "igaming_platform_tpu/platform/configs/bonus_rules.yaml"

@@ -12,7 +12,7 @@ import numpy as np
 import pytest
 
 from igaming_platform_tpu.core.config import ScoringConfig
-from igaming_platform_tpu.core.enums import REASON_BIT_ORDER, ReasonCode, decode_reason_mask
+from igaming_platform_tpu.core.enums import ReasonCode, decode_reason_mask
 from igaming_platform_tpu.core.features import F, NUM_FEATURES
 from igaming_platform_tpu.models.ensemble import jit_score_fn
 from igaming_platform_tpu.models.rules import RULE_WEIGHTS

@@ -1,6 +1,5 @@
 """Continuous training loop: checkpoints, resume, live hot-swap."""
 
-import numpy as np
 
 from igaming_platform_tpu.core.config import BatcherConfig
 from igaming_platform_tpu.serve.scorer import ScoreRequest, TPUScoringEngine

@@ -1,0 +1,125 @@
+"""Minimal in-tree linter (`make lint`) — no linter ships in this image.
+
+Checks the classes of slip that have actually bitten this codebase:
+syntax errors (compile), unused imports, duplicate imports, bare
+`except:`, `== None`/`!= None`, and mutable default arguments. AST-only,
+stdlib-only, zero configuration; not a style tool.
+
+Deliberate side-effect imports (descriptor-pool registration, plugin
+hooks) are sanctioned by aliasing to an underscore name —
+``import x.y_pb2 as _y_pb2`` — which the unused-import rule exempts;
+a trailing ``# noqa`` on the import line is also honored.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+ROOTS = ("igaming_platform_tpu", "benchmarks", "tests", "tools")
+TOP_FILES = ("bench.py", "__graft_entry__.py")
+
+
+def _imported_names(node: ast.AST):
+    """Yields (bound name, dedupe key, lineno). For `import a.b` the
+    bound name is `a` but the dedupe key is the full dotted path —
+    `import urllib.parse` + `import urllib.request` is not a duplicate."""
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            bound = alias.asname or alias.name.split(".")[0]
+            yield bound, (alias.asname or alias.name), node.lineno
+    elif isinstance(node, ast.ImportFrom):
+        for alias in node.names:
+            if alias.name != "*":
+                name = alias.asname or alias.name
+                yield name, name, node.lineno
+
+
+def lint_file(path: Path) -> list[str]:
+    src = path.read_text(encoding="utf-8")
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as exc:
+        return [f"{path}:{exc.lineno}: syntax error: {exc.msg}"]
+    noqa_lines = {
+        i for i, line in enumerate(src.splitlines(), start=1)
+        if "# noqa" in line
+    }
+
+    problems: list[str] = []
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            base = node
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name):
+                used.add(base.id)
+
+    # `__all__` re-exports and docstring-only modules keep their imports.
+    exports = set()
+    for node in tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "__all__"
+                        for t in node.targets)
+                and isinstance(node.value, (ast.List, ast.Tuple))):
+            exports = {e.value for e in node.value.elts
+                       if isinstance(e, ast.Constant)}
+
+    # Import hygiene is checked at MODULE level only: function-scope
+    # re-imports are a deliberate idiom here (lazy imports for optional
+    # deps and jax-initialization ordering).
+    seen: dict[str, int] = {}
+    is_init = path.name == "__init__.py"
+    for node in tree.body:
+        for name, key, lineno in _imported_names(node):
+            if lineno in noqa_lines:
+                continue
+            if key in seen and seen[key] != lineno:
+                problems.append(
+                    f"{path}:{lineno}: duplicate module-level import of "
+                    f"{key!r} (first at line {seen[key]})")
+            seen.setdefault(key, lineno)
+            if (not is_init and name != "annotations" and name not in used
+                    and name not in exports and not name.startswith("_")):
+                problems.append(f"{path}:{lineno}: unused import {name!r}")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            problems.append(f"{path}:{node.lineno}: bare `except:`")
+        if isinstance(node, ast.Compare):
+            for op, comp in zip(node.ops, node.comparators):
+                if (isinstance(op, (ast.Eq, ast.NotEq))
+                        and isinstance(comp, ast.Constant)
+                        and comp.value is None):
+                    problems.append(
+                        f"{path}:{node.lineno}: use `is None` / `is not None`")
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for default in list(node.args.defaults) + [
+                    d for d in node.args.kw_defaults if d is not None]:
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                    problems.append(
+                        f"{path}:{default.lineno}: mutable default argument "
+                        f"in {node.name}()")
+    return problems
+
+
+def main() -> int:
+    repo = Path(__file__).resolve().parent.parent
+    files: list[Path] = [repo / f for f in TOP_FILES]
+    for root in ROOTS:
+        files.extend(sorted((repo / root).rglob("*.py")))
+    files = [f for f in files if "proto_gen" not in f.parts and f.exists()]
+    problems: list[str] = []
+    for f in files:
+        problems.extend(lint_file(f))
+    for p in problems:
+        print(p)
+    print(f"lint: {len(files)} files, {len(problems)} problems")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
