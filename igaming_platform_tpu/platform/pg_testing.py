@@ -343,6 +343,11 @@ class _Session:
                     "current transaction is aborted, commands ignored until "
                     "end of transaction block")
 
+        if upper.startswith(("SET ", "RESET ")):
+            # Session parameters (read-only mode, timezones, …): accepted
+            # and ignored — the rig arbitrates writes via SQLite itself.
+            return _msg(b"C", _cstr(upper.split(None, 1)[0]))
+
         if upper in ("BEGIN", "START TRANSACTION"):
             # IMMEDIATE: take the write lock up front so concurrent
             # replicas' write transactions serialize instead of

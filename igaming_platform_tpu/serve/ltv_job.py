@@ -31,6 +31,25 @@ from igaming_platform_tpu.models.ltv import (
 _SECONDS_PER_DAY = 86_400.0
 
 
+def _open_wallet_reader(db: str):
+    """(query(sql) -> rows, close) over either wallet backend: a SQLite
+    path / ``sqlite://`` URL, or ``postgres://`` via the wire client —
+    the LTV batch job must run against whichever store of record the
+    deployment uses (same dispatch rule as ``store_from_url``)."""
+    if db.startswith(("postgres://", "postgresql://")):
+        from igaming_platform_tpu.platform.pgwire import PgConnection
+
+        conn = PgConnection(db)
+        conn.connect()
+        # Same invariant as the sqlite mode=ro open below: a scan job
+        # must be INCAPABLE of writing to the store of record.
+        conn.execute("SET default_transaction_read_only = on")
+        return (lambda sql: conn.execute(sql).fetchall()), conn.close
+    path = db.removeprefix("sqlite://")
+    conn = sqlite3.connect(f"file:{path}?mode=ro", uri=True)
+    return (lambda sql: conn.execute(sql).fetchall()), conn.close
+
+
 def ltv_features_from_wallet(db_path: str, now: float | None = None) -> tuple[list[str], np.ndarray]:
     """Scan a wallet store into the [N, 25] LTV feature matrix.
 
@@ -39,20 +58,22 @@ def ltv_features_from_wallet(db_path: str, now: float | None = None) -> tuple[li
     case the model's data-quality term handles (ltv.go:346-382).
     """
     now = now or time.time()
-    conn = sqlite3.connect(f"file:{db_path}?mode=ro", uri=True)
+    query, close = _open_wallet_reader(db_path)
     try:
-        accounts = conn.execute("SELECT id, created_at FROM accounts").fetchall()
-        rows = conn.execute(
+        accounts = query("SELECT id, created_at FROM accounts")
+        rows = query(
             "SELECT account_id, type, COUNT(*), COALESCE(SUM(amount),0),"
             " COALESCE(MAX(amount),0), COALESCE(MAX(completed_at),0)"
             " FROM transactions WHERE status='completed' GROUP BY account_id, type"
-        ).fetchall()
-        active = dict(conn.execute(
-            "SELECT account_id, COUNT(DISTINCT CAST(created_at / 86400 AS INTEGER))"
+        )
+        # floor(), not CAST(... AS INTEGER): CAST truncates in SQLite but
+        # ROUNDS in PostgreSQL — the day buckets must agree on both.
+        active = dict(query(
+            "SELECT account_id, COUNT(DISTINCT floor(created_at / 86400))"
             " FROM transactions WHERE status='completed' GROUP BY account_id"
-        ).fetchall())
+        ))
     finally:
-        conn.close()
+        close()
 
     agg: dict[str, dict] = {a: {} for a, _ in accounts}
     for account_id, tx_type, count, total, largest, last_ts in rows:
@@ -123,7 +144,7 @@ def run_batch_job(db_path: str, now: float | None = None, metrics=None) -> dict:
 
 def main() -> None:
     if len(sys.argv) < 2:
-        print("usage: python -m igaming_platform_tpu.serve.ltv_job <wallet.db> [out.json]",
+        print("usage: python -m igaming_platform_tpu.serve.ltv_job <wallet.db | postgres://…> [out.json]",
               file=sys.stderr)
         sys.exit(2)
     # A wedged device tunnel must not hang the batch job (core/devices.py).

@@ -86,3 +86,40 @@ def test_job_handles_empty_db(tmp_path):
     path = str(tmp_path / "empty.db")
     SQLiteStore(path).close()
     assert run_batch_job(path) == {"players": [], "segments": {}, "count": 0}
+
+
+def test_ltv_job_reads_postgres_backend(tmp_path):
+    """The batch job runs against the Postgres store of record too —
+    same scan SQL through the wire client (deployment parity with the
+    SQLite path)."""
+    from igaming_platform_tpu.platform.outbox import OutboxPublisher
+    from igaming_platform_tpu.platform.pg_store import PostgresStore
+    from igaming_platform_tpu.platform.pg_testing import PgSqliteServer
+    from igaming_platform_tpu.platform.wallet import WalletService
+    from igaming_platform_tpu.serve.ltv_job import run_batch_job
+
+    pg = PgSqliteServer(str(tmp_path / "ltv_pg.db"))
+    store = PostgresStore(pg.url)
+    try:
+        wallet = WalletService(store.accounts, store.transactions, store.ledger,
+                               events=OutboxPublisher(store), audit=store.audit)
+        whale = wallet.create_account("pg-whale")
+        for i in range(5):
+            wallet.deposit(whale.id, 500_000, f"d{i}")
+        wallet.bet(whale.id, 50_000, "b0", game_id="g")
+        casual = wallet.create_account("pg-casual")
+        wallet.deposit(casual.id, 2_000, "d0")
+
+        ids, x = ltv_features_from_wallet(pg.url)
+        by_id = dict(zip(ids, x))
+        assert by_id[whale.id][L.TOTAL_DEPOSITS] == 5 * 5_000.0  # dollars
+        assert by_id[whale.id][L.BET_COUNT] == 1
+        assert by_id[casual.id][L.TOTAL_DEPOSITS] == 20.0
+
+        result = run_batch_job(pg.url)
+        assert result["count"] == 2
+        recs = {r["account_id"]: r for r in result["players"]}
+        assert recs[whale.id]["predicted_ltv"] > recs[casual.id]["predicted_ltv"]
+    finally:
+        store.close()
+        pg.close()
