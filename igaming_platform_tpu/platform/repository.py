@@ -266,6 +266,31 @@ def uow_of(repo):
     return getattr(store_of(repo), "unit_of_work", None)
 
 
+def open_wallet_reader(db: str):
+    """(query(sql) -> rows, close) over either wallet backend for
+    READ-ONLY scan jobs (LTV batch, batch-feature refresh): a SQLite
+    path / ``sqlite://`` URL opens with mode=ro, ``postgres://`` goes
+    through the wire client with the session forced read-only — a scan
+    job must be incapable of writing to the store of record. Same
+    dispatch rule as ``store_from_url``."""
+    if db.startswith(("postgres://", "postgresql://")):
+        from igaming_platform_tpu.platform.pgwire import PgConnection
+
+        conn = PgConnection(db)
+        conn.connect()
+        try:
+            conn.execute("SET default_transaction_read_only = on")
+        except BaseException:
+            # A pooler/proxy that rejects session SET must not leak the
+            # connection: the caller never gets the close handle.
+            conn.close()
+            raise
+        return (lambda sql: conn.execute(sql).fetchall()), conn.close
+    path = db.removeprefix("sqlite://")
+    ro = sqlite3.connect(f"file:{path}?mode=ro", uri=True)
+    return (lambda sql: ro.execute(sql).fetchall()), ro.close
+
+
 def store_from_url(url: str):
     """DATABASE_URL -> store instance, or None for the in-memory repos
     (empty/unknown scheme). The single dispatch shared by the wallet

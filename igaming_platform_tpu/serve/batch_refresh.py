@@ -20,7 +20,6 @@ exactly the realtime/batch split of engine.go:127-140.
 from __future__ import annotations
 
 import logging
-import sqlite3
 import threading
 import time
 from dataclasses import dataclass
@@ -47,22 +46,25 @@ class BatchFeatures:
 
 
 def wallet_store_source(db_path: str):
-    """Source scanning a wallet SQLite store's completed transactions.
+    """Source scanning a wallet store's completed transactions — SQLite
+    path/URL or ``postgres://`` (platform.repository.open_wallet_reader).
 
     Opens a fresh read-only connection per scan so the refresh never
     contends with the wallet's write path.
     """
 
     def scan() -> dict[str, BatchFeatures]:
-        conn = sqlite3.connect(f"file:{db_path}?mode=ro", uri=True)
+        from igaming_platform_tpu.platform.repository import open_wallet_reader
+
+        query, close = open_wallet_reader(db_path)
         try:
-            created = dict(conn.execute("SELECT id, created_at FROM accounts").fetchall())
-            rows = conn.execute(
+            created = dict(query("SELECT id, created_at FROM accounts"))
+            rows = query(
                 "SELECT account_id, type, COALESCE(SUM(amount),0), COUNT(*)"
                 " FROM transactions WHERE status='completed' GROUP BY account_id, type"
-            ).fetchall()
+            )
         finally:
-            conn.close()
+            close()
         agg: dict[str, dict] = {}
         for account_id, tx_type, total, count in rows:
             d = agg.setdefault(account_id, {})

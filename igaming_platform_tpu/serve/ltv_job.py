@@ -15,7 +15,6 @@ Usage:
 from __future__ import annotations
 
 import json
-import sqlite3
 import sys
 import time
 
@@ -31,25 +30,6 @@ from igaming_platform_tpu.models.ltv import (
 _SECONDS_PER_DAY = 86_400.0
 
 
-def _open_wallet_reader(db: str):
-    """(query(sql) -> rows, close) over either wallet backend: a SQLite
-    path / ``sqlite://`` URL, or ``postgres://`` via the wire client —
-    the LTV batch job must run against whichever store of record the
-    deployment uses (same dispatch rule as ``store_from_url``)."""
-    if db.startswith(("postgres://", "postgresql://")):
-        from igaming_platform_tpu.platform.pgwire import PgConnection
-
-        conn = PgConnection(db)
-        conn.connect()
-        # Same invariant as the sqlite mode=ro open below: a scan job
-        # must be INCAPABLE of writing to the store of record.
-        conn.execute("SET default_transaction_read_only = on")
-        return (lambda sql: conn.execute(sql).fetchall()), conn.close
-    path = db.removeprefix("sqlite://")
-    conn = sqlite3.connect(f"file:{path}?mode=ro", uri=True)
-    return (lambda sql: conn.execute(sql).fetchall()), conn.close
-
-
 def ltv_features_from_wallet(db_path: str, now: float | None = None) -> tuple[list[str], np.ndarray]:
     """Scan a wallet store into the [N, 25] LTV feature matrix.
 
@@ -57,8 +37,10 @@ def ltv_features_from_wallet(db_path: str, now: float | None = None) -> tuple[li
     opt-ins, support tickets) stay zero — exactly the degraded-confidence
     case the model's data-quality term handles (ltv.go:346-382).
     """
+    from igaming_platform_tpu.platform.repository import open_wallet_reader
+
     now = now or time.time()
-    query, close = _open_wallet_reader(db_path)
+    query, close = open_wallet_reader(db_path)
     try:
         accounts = query("SELECT id, created_at FROM accounts")
         rows = query(

@@ -88,3 +88,29 @@ def test_ticker_runs_periodically(tmp_path):
     assert job.last_refresh_count == 1
     assert job.last_refresh_at > 0
     store.close()
+
+
+def test_wallet_store_source_reads_postgres_backend(tmp_path):
+    """The refresh source scans the Postgres store of record too (same
+    dispatch as the LTV job — open_wallet_reader)."""
+    from igaming_platform_tpu.platform.pg_store import PostgresStore
+    from igaming_platform_tpu.platform.pg_testing import PgSqliteServer
+    from igaming_platform_tpu.platform.wallet import WalletService
+
+    pg = PgSqliteServer(str(tmp_path / "refresh_pg.db"))
+    store = PostgresStore(pg.url)
+    try:
+        wallet = WalletService(store.accounts, store.transactions, store.ledger,
+                               audit=store.audit)
+        acct = wallet.create_account("refresh-pg")
+        wallet.deposit(acct.id, 7_000, "d1")
+        wallet.bet(acct.id, 1_500, "b1")
+
+        rows = wallet_store_source(pg.url)()
+        bf = rows[acct.id]
+        assert bf.total_deposits == 7_000 and bf.deposit_count == 1
+        assert bf.total_bets == 1_500 and bf.bet_count == 1
+        assert bf.created_at > 0
+    finally:
+        store.close()
+        pg.close()
