@@ -422,18 +422,18 @@ class _Session:
         post_ddl: list[str] = []
         if _BIGSERIAL_PK.search(s):
             s = _BIGSERIAL_PK.sub("INTEGER PRIMARY KEY AUTOINCREMENT", s)
-        m_col = _BIGSERIAL_COL.search(s)
-        if m_col is not None:
-            col = m_col.group(1)
-            s = _BIGSERIAL_COL.sub(rf"{col} INTEGER", s)
+        cols = [m.group(1) for m in _BIGSERIAL_COL.finditer(s)]
+        if cols:
+            s = _BIGSERIAL_COL.sub(lambda m: f"{m.group(1)} INTEGER", s)
             m_table = _CREATE_TABLE.search(s)
             if m_table is not None:
                 table = m_table.group(1)
                 # Insertion-order sequence for plain BIGSERIAL columns
                 # (the PG transactions.seq tiebreak).
-                post_ddl.append(
-                    f"CREATE TRIGGER IF NOT EXISTS {table}_{col}_fill "
-                    f"AFTER INSERT ON {table} WHEN NEW.{col} IS NULL "
-                    f"BEGIN UPDATE {table} SET {col} = NEW.rowid "
-                    f"WHERE rowid = NEW.rowid; END")
+                for col in cols:
+                    post_ddl.append(
+                        f"CREATE TRIGGER IF NOT EXISTS {table}_{col}_fill "
+                        f"AFTER INSERT ON {table} WHEN NEW.{col} IS NULL "
+                        f"BEGIN UPDATE {table} SET {col} = NEW.rowid "
+                        f"WHERE rowid = NEW.rowid; END")
         return s, post_ddl
