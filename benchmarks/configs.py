@@ -26,6 +26,17 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _engine_util(engine, n_rows: int, seconds_per_batch: float) -> dict:
+    """hbm_util/achieved rate fields for a scoring-engine bench line."""
+    import jax
+
+    from igaming_platform_tpu.obs.perfmodel import utilization
+
+    util = utilization(engine.step_cost(n_rows), seconds_per_batch, jax.devices()[0])
+    return {"hbm_util": util["hbm_util"],
+            "achieved_hbm_gbps": util["achieved_hbm_gbps"]}
+
+
 def config1_single_txn_latency(n_requests: int = 200, batch_size: int = 256) -> dict:
     from igaming_platform_tpu.core.config import BatcherConfig
     from igaming_platform_tpu.serve.scorer import ScoreRequest, TPUScoringEngine
@@ -65,6 +76,10 @@ def config1_single_txn_latency(n_requests: int = 200, batch_size: int = 256) -> 
             "device_step_p99_ms": round(float(np.percentile(dev, 99)), 3),
             "device_step_p50_ms": round(float(np.percentile(dev, 50)), 3),
             "requests": int(lat.size),
+            # Ensemble-step utilization at this shape ([B,30] is
+            # bandwidth-bound: hbm_util is the meaningful figure).
+            **_engine_util(engine, batch_size,
+                           float(np.percentile(dev, 50)) / 1e3),
         }
     finally:
         engine.close()
@@ -114,6 +129,10 @@ def config2_replay_throughput(
             "unit": "txns/s",
             "events": stats["events_scored"],
             "blocked": stats["blocked"],
+            # Device utilization ACROSS the replay (includes host gaps —
+            # how hard the chip worked for the e2e figure, not peak step).
+            **_engine_util(engine, batch_size,
+                           batch_size / max(stats["txns_per_sec"], 1e-9)),
         }
     finally:
         engine.close()
