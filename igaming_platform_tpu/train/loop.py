@@ -53,18 +53,44 @@ class TrainingLoop:
             logger.info("resumed from checkpoint at step %d", self.trainer.state.step)
 
     def run_steps(self, steps: int) -> dict[str, float]:
-        """Synchronous loop body (tests / foreground use)."""
+        """Synchronous loop body (tests / foreground use).
+
+        Runs the trainer's pipelined path: the next batch's H2D overlaps
+        the current step and metrics stay on device, materialized (one
+        packed transfer) only every few steps and at swap/checkpoint
+        boundaries — a per-step scalar readback costs a full RTT on a
+        tunneled device and was the continuous loop's throughput wall.
+        """
+        if steps <= 0:
+            return self.last_metrics
         data = make_stream(self.trainer.cfg.batch_size, seed=self.trainer.cfg.seed + self.trainer.state.step)
-        for _ in range(steps):
+        pending = self.trainer.put_batch(next(data))
+        metrics_dev = None
+        materialized = True
+        for i in range(steps):
             if self._stop.is_set():
                 break
-            self.last_metrics = self.trainer.train_step(next(data))
+            current = pending
+            if i + 1 < steps:
+                pending = self.trainer.put_batch(next(data))
+            metrics_dev = self.trainer.train_step_device(current)
+            materialized = False
             step = self.trainer.state.step
-            if self.config.swap_every and step % self.config.swap_every == 0:
+            at_swap = self.config.swap_every and step % self.config.swap_every == 0
+            at_ckpt = (self.config.checkpoint_every
+                       and step % self.config.checkpoint_every == 0)
+            if at_swap or at_ckpt or i + 1 >= steps or i % 10 == 0:
+                self.last_metrics = self.trainer.materialize_metrics(metrics_dev)
+                materialized = True
+            if at_swap:
                 self._swap()
-            if self.config.checkpoint_every and step % self.config.checkpoint_every == 0:
+            if at_ckpt:
                 save_checkpoint(self.config.checkpoint_dir, self.trainer.state)
                 self.checkpoints += 1
+        if metrics_dev is not None and not materialized:
+            # A stop() mid-stride must not leave last_metrics stale: the
+            # final computed step's metrics are already on device.
+            self.last_metrics = self.trainer.materialize_metrics(metrics_dev)
         return self.last_metrics
 
     def _swap(self) -> None:

@@ -154,9 +154,14 @@ class Trainer:
         self.state = TrainState(params=params, opt_state=opt_state, step=self.state.step + 1)
         return metrics
 
-    def train_step(self, batch: Batch) -> dict[str, float]:
-        metrics = self.train_step_device(self.put_batch(batch))
+    @staticmethod
+    def materialize_metrics(metrics) -> dict[str, float]:
+        """Device metrics tree -> host floats in ONE packed transfer —
+        the single place the metrics D2H policy lives."""
         return {k: float(v) for k, v in jax.device_get(metrics).items()}
+
+    def train_step(self, batch: Batch) -> dict[str, float]:
+        return self.materialize_metrics(self.train_step_device(self.put_batch(batch)))
 
     def fit(
         self,
@@ -179,9 +184,8 @@ class Trainer:
                 pending = self.put_batch(next(data))
             metrics = self.train_step_device(current)
             if log_fn is not None and (i + 1) % log_every == 0:
-                log_fn(self.state.step,
-                       {k: float(v) for k, v in jax.device_get(metrics).items()})
-        return {k: float(v) for k, v in jax.device_get(metrics).items()}
+                log_fn(self.state.step, self.materialize_metrics(metrics))
+        return self.materialize_metrics(metrics)
 
     def step_cost(self, batch: Batch) -> dict[str, float]:
         """XLA's per-step FLOPs/bytes for this trainer's compiled step
