@@ -1,0 +1,36 @@
+#!/bin/sh
+# Opportunistic on-device artifact capture — run the moment the tunnel
+# probe succeeds (it can re-wedge between back-to-back runs, so order is
+# by evidence value). Each harness carries its own wedge guard; artifacts
+# are honestly labeled either way. Usage: sh benchmarks/device_capture.sh
+set -x
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p artifacts_r05
+
+# 1. Headline driver bench (the round's official metric shape).
+timeout 1200 python bench.py > artifacts_r05/BENCH_device.json 2> artifacts_r05/BENCH_device.log
+
+# 2. Sustained wire soak, int8 transport — every-window compliance.
+timeout 1500 env WIRE_DTYPE=int8 SOAK_DURATION_S=60 python benchmarks/soak.py --wire \
+  > artifacts_r05/SOAK_int8.json 2> artifacts_r05/SOAK_int8.log
+
+# 3. Sustained wire soak, default f32 (comparable with SOAK_r03).
+timeout 1500 env SOAK_DURATION_S=60 python benchmarks/soak.py --wire \
+  > artifacts_r05/SOAK_f32.json 2> artifacts_r05/SOAK_f32.log
+
+# 3b. Paced soak at 110k txns/s offered: latency AT the SLO rate.
+timeout 1500 env SOAK_DURATION_S=60 SOAK_TARGET_RATE=110000 python benchmarks/soak.py --wire \
+  > artifacts_r05/SOAK_paced110k.json 2> artifacts_r05/SOAK_paced110k.log
+
+# 4. Full five-config matrix (now with MFU/HBM-util fields).
+timeout 5400 python benchmarks/run_all.py > artifacts_r05/BENCH_MATRIX.json 2> artifacts_r05/BENCH_MATRIX.log
+
+# 5. Model-quality eval on device.
+timeout 3600 python -m igaming_platform_tpu.train.eval --out artifacts_r05/EVAL_device.json \
+  > artifacts_r05/EVAL_device.log 2>&1
+
+# 6. Trained-model TPU-vs-CPU numerics parity.
+timeout 3600 python -m igaming_platform_tpu.train.device_parity --out artifacts_r05/DEVICE_PARITY.json \
+  > artifacts_r05/DEVICE_PARITY.log 2>&1
+
+echo done

@@ -146,10 +146,16 @@ W8_CEIL, W8_LINEAR = _wire8_domain_tables()
 
 
 def wire_quantize_int8(x: np.ndarray) -> np.ndarray:
-    """Host side: raw f32 [B, 30] -> int8 [B, 30] (numpy, pre-H2D)."""
+    """Host side: raw f32 [B, 30] -> int8 [B, 30] (numpy, pre-H2D).
+
+    Non-finite inputs (an upstream divide-by-zero etc.) must not reach the
+    int8 cast: casting NaN to int8 is undefined in C and would ship an
+    arbitrary code. NaN maps to 0 (the schema's "absent" value); ±inf
+    saturates to the domain edge like any beyond-ceiling value.
+    """
     x = np.asarray(x, np.float32)
     t = np.where(W8_LINEAR > 0, x, np.sign(x) * np.log1p(np.abs(x)))
-    q = np.rint(t * (127.0 / W8_CEIL))
+    q = np.nan_to_num(np.rint(t * (127.0 / W8_CEIL)), nan=0.0)
     return np.clip(q, -127, 127).astype(np.int8)
 
 

@@ -55,16 +55,23 @@ class _PgConnAdapter:
     def __init__(self, store: "PostgresStore"):
         self._store = store
 
-    def execute(self, sql: str, params: tuple = ()):
+    def execute(self, sql: str, params: tuple = (), *, error_mapper=None):
         from igaming_platform_tpu.platform.pgwire import PgProtocolError
 
         try:
-            return self._store._pg.execute(sql, tuple(params))
+            if self._store._tx_depth > 0:
+                # Inside a unit of work: PIPELINE — frames buffer on the
+                # connection and the whole batch ships with one Sync when
+                # a result is inspected or the UoW commits (pgwire._Cursor
+                # docstring). Cuts the wallet op to ~3 round trips.
+                return self._store._pg.execute_pipelined(
+                    sql, tuple(params), error_mapper=error_mapper)
+            return self._store._pg.execute(sql, tuple(params), error_mapper=error_mapper)
         except PgProtocolError:
             if self._store._tx_depth > 0:
                 raise
             self._store._reconnect()
-            return self._store._pg.execute(sql, tuple(params))
+            return self._store._pg.execute(sql, tuple(params), error_mapper=error_mapper)
 
 
 class _PgTransactions(_SQLiteTransactions):
@@ -73,21 +80,26 @@ class _PgTransactions(_SQLiteTransactions):
     SQLSTATE-based duplicate mapping (postgres.go:446-453)."""
 
     def create(self, t: Transaction) -> None:
+        # The duplicate mapping travels WITH the statement (error_mapper):
+        # under pipelining the server error surfaces at flush time — which
+        # may be a later statement's cursor or the COMMIT — so a local
+        # try/except here would never see it.
+        def _map(exc: PgError):
+            if exc.sqlstate == UNIQUE_VIOLATION:
+                return DuplicateTransactionError(t.idempotency_key)
+            return exc
+
         with self._s._lock:
-            try:
-                self._s._conn.execute(
-                    "INSERT INTO transactions (id, account_id, idempotency_key, type, amount,"
-                    " balance_before, balance_after, status, reference, game_id, round_id,"
-                    " risk_score, created_at, completed_at) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
-                    (t.id, t.account_id, t.idempotency_key or None, t.type.value, t.amount,
-                     t.balance_before, t.balance_after, t.status.value, t.reference,
-                     t.game_id, t.round_id, t.risk_score, t.created_at, t.completed_at),
-                )
-                self._s._commit()
-            except PgError as exc:
-                if exc.sqlstate == UNIQUE_VIOLATION:
-                    raise DuplicateTransactionError(t.idempotency_key) from exc
-                raise
+            self._s._conn.execute(
+                "INSERT INTO transactions (id, account_id, idempotency_key, type, amount,"
+                " balance_before, balance_after, status, reference, game_id, round_id,"
+                " risk_score, created_at, completed_at) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                (t.id, t.account_id, t.idempotency_key or None, t.type.value, t.amount,
+                 t.balance_before, t.balance_after, t.status.value, t.reference,
+                 t.game_id, t.round_id, t.risk_score, t.created_at, t.completed_at),
+                error_mapper=_map,
+            )
+            self._s._commit()
 
     def list_by_account(self, account_id, limit=50, offset=0, *, types=None,
                         from_ts=None, to_ts=None, game_id=None):
@@ -148,7 +160,9 @@ class PostgresStore(DedupeStoreMixin):
         wrapper of postgres.go:393-443); reentrant like the SQLite one."""
         with self._lock:
             if self._tx_depth == 0:
-                self._pg.begin()
+                # Lazy BEGIN: rides the first flush's round trip together
+                # with the statements it opens the transaction for.
+                self._pg.begin_pipelined()
             self._tx_depth += 1
             try:
                 yield self

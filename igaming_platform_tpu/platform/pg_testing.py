@@ -150,6 +150,10 @@ class PgSqliteServer:
                 sock, _ = self._listener.accept()
             except OSError:
                 return
+            # Response frames must not sit in Nagle's buffer waiting for a
+            # delayed ACK — the client blocks on every reply (~40 ms
+            # stalls otherwise, dwarfing statement cost).
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             threading.Thread(
                 target=_Session(self, sock).run, daemon=True
             ).start()
@@ -344,8 +348,18 @@ class _Session:
                     "end of transaction block")
 
         if upper.startswith(("SET ", "RESET ")):
-            # Session parameters (read-only mode, timezones, …): accepted
-            # and ignored — the rig arbitrates writes via SQLite itself.
+            # Session parameters: read-only mode is ENFORCED (mapped onto
+            # SQLite's per-connection query_only pragma) so the scan jobs'
+            # "incapable of writing" guarantee is exercised in every rig
+            # run, not only against live Postgres; everything else
+            # (timezones, …) is accepted and ignored.
+            if "DEFAULT_TRANSACTION_READ_ONLY" in upper:
+                if upper.startswith("RESET "):
+                    ro = False
+                else:
+                    value = upper.split("=", 1)[-1].split()[-1].strip("'\" ;")
+                    ro = value in ("ON", "TRUE", "1", "YES")
+                self.db.execute(f"PRAGMA query_only={'ON' if ro else 'OFF'}")
             return _msg(b"C", _cstr(upper.split(None, 1)[0]))
 
         if upper in ("BEGIN", "START TRANSACTION"):

@@ -396,14 +396,14 @@ class SQLiteStore(DedupeStoreMixin):
         self._conn = sqlite3.connect(path, check_same_thread=False)
         if path != ":memory:":
             self._conn.execute("PRAGMA journal_mode=WAL")
-            # Batched fsync: in WAL mode, NORMAL syncs at checkpoints
-            # instead of per-commit — the group-commit analog that lifts
-            # the wallet hot path off the per-op fsync floor. Durability
-            # window: an OS crash can lose the tail of the WAL (commits
-            # since the last checkpoint); the database itself stays
-            # consistent, and the ledger reconciles what persisted.
-            # SQLITE_SYNCHRONOUS=FULL restores per-commit sync.
-            sync = os.environ.get("SQLITE_SYNCHRONOUS", "NORMAL").upper()
+            # Durable by default: FULL syncs per commit, matching the
+            # reference's default-durable Postgres — a gRPC-acknowledged
+            # wallet commit survives power loss. Benches/soaks opt into
+            # SQLITE_SYNCHRONOUS=NORMAL explicitly (batched fsync at WAL
+            # checkpoints — the group-commit analog that lifts the hot
+            # path off the per-op fsync floor, at the cost of an OS crash
+            # losing the WAL tail; the ledger reconciles what persisted).
+            sync = os.environ.get("SQLITE_SYNCHRONOUS", "FULL").upper()
             if sync not in ("OFF", "NORMAL", "FULL", "EXTRA"):
                 raise ValueError(f"SQLITE_SYNCHRONOUS={sync!r} not a sqlite mode")
             self._conn.execute(f"PRAGMA synchronous={sync}")

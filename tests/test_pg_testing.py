@@ -174,3 +174,40 @@ def test_rig_survives_adversarial_bytes(server):
     conn.execute("INSERT INTO fz VALUES (?)", (1,))
     assert conn.execute("SELECT COUNT(*) FROM fz").fetchone()[0] == 1
     conn.close()
+
+
+def test_read_only_session_rejects_writes(server):
+    """SET default_transaction_read_only=on is ENFORCED by the rig (mapped
+    to SQLite query_only), so the scan jobs' write guard is exercised in
+    CI, not only against live Postgres (advisor round-4 item)."""
+    setup = _connect(server)
+    setup.execute("CREATE TABLE ro (x BIGINT)")
+    setup.execute("INSERT INTO ro VALUES (?)", (1,))
+
+    conn = _connect(server)
+    conn.execute("SET default_transaction_read_only = on")
+    assert conn.execute("SELECT COUNT(*) FROM ro").fetchone()[0] == 1  # reads fine
+    with pytest.raises(PgError):
+        conn.execute("INSERT INTO ro VALUES (?)", (2,))
+    # RESET restores writability for the same session.
+    conn.execute("RESET default_transaction_read_only")
+    conn.execute("INSERT INTO ro VALUES (?)", (3,))
+    assert setup.execute("SELECT COUNT(*) FROM ro").fetchone()[0] == 2
+    conn.close()
+    setup.close()
+
+
+def test_wallet_reader_cannot_write_through_rig(server, tmp_path):
+    """open_wallet_reader on a postgres:// URL yields a handle that is
+    incapable of writing — end-to-end through the rig's enforcement."""
+    from igaming_platform_tpu.platform.repository import open_wallet_reader
+
+    setup = _connect(server)
+    setup.execute("CREATE TABLE w (x BIGINT)")
+
+    query, close = open_wallet_reader(server.url)
+    with pytest.raises(PgError):
+        query("INSERT INTO w VALUES (9)")
+    assert query("SELECT COUNT(*) FROM w")[0][0] == 0
+    close()
+    setup.close()

@@ -177,3 +177,24 @@ def test_wire_int8_roundtrip_relative_error():
     zq = wire_quantize_int8(np.zeros((4, NUM_FEATURES), np.float32))
     assert (zq == 0).all()
     assert (np.asarray(wire_dequantize_int8(zq)) == 0.0).all()
+
+
+def test_wire_int8_nonfinite_inputs_are_deterministic():
+    """NaN must not reach the int8 cast (undefined in C): NaN -> 0 (the
+    schema's absent value); ±inf saturates like any beyond-ceiling value
+    (advisor round-4 item)."""
+    import numpy as np
+
+    from igaming_platform_tpu.ops.quantize import wire_quantize_int8
+    from igaming_platform_tpu.core.features import NUM_FEATURES
+
+    x = np.zeros((3, NUM_FEATURES), np.float32)
+    x[0, 0] = np.nan
+    x[1, 0] = np.inf
+    x[2, 0] = -np.inf
+    q = wire_quantize_int8(x)
+    assert q[0, 0] == 0
+    assert q[1, 0] == 127
+    assert q[2, 0] == -127
+    # And zero stays exactly zero everywhere else (padding exactness).
+    assert (q[:, 1:] == 0).all()
