@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-fast lint bench bench-all eval native proto run-risk run-wallet dryrun clean soak soak-wire api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
+.PHONY: all test test-fast lint bench bench-all bench-replicas eval native proto run-risk run-wallet dryrun clean soak soak-wire api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
 
 all: native test
 
@@ -26,6 +26,11 @@ bench:
 # The full benchmark matrix (five BASELINE configs + wallet pipeline).
 bench-all:
 	$(PY) benchmarks/run_all.py
+
+# Replica scaling curve: K wallet replica OS processes over one shared
+# PG-wire database (REPLICA_KS, REPLICA_CYCLES; POSTGRES_URL for live PG).
+bench-replicas:
+	$(PY) benchmarks/replicas.py
 
 soak:
 	$(PY) benchmarks/soak.py

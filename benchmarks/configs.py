@@ -633,17 +633,19 @@ def config7_wallet_wire(n_threads: int = 8, cycles: int = 100) -> dict:
 
 def config8_wallet_pg(n_threads: int = 8, cycles: int = 100) -> dict:
     """The wallet wire path on the POSTGRES backend: wallet.v1 gRPC ->
-    WalletService -> PostgresStore -> protocol-v3 wire client -> the
-    in-tree PG server (platform/pg_testing.py, SQLite-arbitrated). Every
-    byte of the production PG deployment's path except the PostgreSQL
-    process itself — honest labeling via the ``backend`` field; the
-    compose `stores` profile provides the real-PG variant of the same
+    WalletService (pooled connection-per-thread, pipelined extended-query
+    batches) -> protocol-v3 wire client -> the in-tree PG server running
+    as its OWN OS PROCESS (the deployment shape: the database is never a
+    thread of the app server, and the bench must not charge the wallet
+    for the rig's GIL time). Honest labeling via the ``backend`` field;
+    the compose `stores` profile provides the real-PG variant of the same
     figure (docs/operations.md)."""
+    import subprocess
+    import sys
     import tempfile
 
     from igaming_platform_tpu.platform.outbox import OutboxPublisher
     from igaming_platform_tpu.platform.pg_store import PostgresStore
-    from igaming_platform_tpu.platform.pg_testing import PgSqliteServer
     from igaming_platform_tpu.platform.wallet import WalletService
     from igaming_platform_tpu.serve.grpc_server import (
         WalletGrpcService,
@@ -652,21 +654,37 @@ def config8_wallet_pg(n_threads: int = 8, cycles: int = 100) -> dict:
     )
 
     with tempfile.TemporaryDirectory() as tmp:
-        pg = PgSqliteServer(os.path.join(tmp, "wallet_pg.db"))
-        store = PostgresStore(pg.url)
-        wallet = WalletService(
-            store.accounts, store.transactions, store.ledger,
-            events=OutboxPublisher(store), audit=store.audit,
+        rig_env = dict(os.environ, JAX_PLATFORMS="cpu")
+        rig = subprocess.Popen(
+            [sys.executable, "-m", "igaming_platform_tpu.platform.pg_testing",
+             os.path.join(tmp, "wallet_pg.db")],
+            stdout=subprocess.PIPE, text=True, env=rig_env,
         )
-        server, health, port = serve_wallet(WalletGrpcService(wallet), port=0)
         try:
-            lat, errors, wall = _wallet_mix(
-                lambda tid: _WireWalletClient(f"localhost:{port}", tid),
-                n_threads, cycles)
+            try:
+                ready = rig.stdout.readline().strip()
+                port = int(ready.split("=", 1)[1])
+            except (ValueError, IndexError) as exc:
+                raise RuntimeError(f"pg rig failed to boot: {ready!r}") from exc
+            store = PostgresStore(f"postgres://tester@127.0.0.1:{port}/wallet")
+            wallet = WalletService(
+                store.accounts, store.transactions, store.ledger,
+                events=OutboxPublisher(store), audit=store.audit,
+            )
+            server, health, port = serve_wallet(WalletGrpcService(wallet), port=0)
+            try:
+                lat, errors, wall = _wallet_mix(
+                    lambda tid: _WireWalletClient(f"localhost:{port}", tid),
+                    n_threads, cycles)
+            finally:
+                graceful_stop(server, health, grace=5)
+                store.close()
         finally:
-            graceful_stop(server, health, grace=5)
-            store.close()
-            pg.close()
+            rig.terminate()
+            try:
+                rig.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                rig.kill()
 
     return {
         "metric": "wallet_pg_ops_per_sec",

@@ -37,9 +37,11 @@ from __future__ import annotations
 import os
 import re
 import socket
+import functools
 import sqlite3
 import struct
 import threading
+import time
 
 _NULL = b"\x00"
 
@@ -126,6 +128,11 @@ class PgSqliteServer:
         boot.close()
         self._advisory_locks: dict[int, threading.Lock] = {}
         self._advisory_guard = threading.Lock()
+        # Fair write gate: explicit write transactions queue here instead
+        # of spinning in SQLite's busy-wait (whose progressive sleeps
+        # reach ~100 ms — pooled clients would thrash). Real Postgres
+        # arbitrates with row locks; a FIFO mutex is the rig's analogue.
+        self.write_gate = threading.Lock()
         self._listener = socket.socket()
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind(("127.0.0.1", port))
@@ -187,6 +194,7 @@ class _Session:
         self.db.execute("PRAGMA synchronous=NORMAL")
         self.in_tx = False
         self.aborted = False
+        self.holds_write_gate = False
         self.held_advisory: set[int] = set()
         self._buf = b""
         self._pending_sql: str | None = None
@@ -209,6 +217,11 @@ class _Session:
         if self.aborted:
             return b"E"
         return b"T" if self.in_tx else b"I"
+
+    def _release_write_gate(self) -> None:
+        if self.holds_write_gate:
+            self.holds_write_gate = False
+            self.server.write_gate.release()
 
     # -- main loop ----------------------------------------------------------
 
@@ -249,6 +262,7 @@ class _Session:
                     self.db.execute("ROLLBACK")
                 except sqlite3.Error:
                     pass
+            self._release_write_gate()
             for key in list(self.held_advisory):
                 self.server.advisory_release(key)
             self.db.close()
@@ -297,9 +311,18 @@ class _Session:
             return
         sql = self._pending_sql or ""
         try:
-            self._out += self._run_statement(sql, self._pending_params)
+            out = self._run_statement(sql, self._pending_params)
         except sqlite3.Error as exc:
             self._out += self._sql_error(exc)
+            self._skip_to_sync = True
+            return
+        self._out += out
+        if out[:1] == b"E":
+            # RETURNED errors (gate/advisory timeouts, 25P02) must skip
+            # the rest of the batch exactly like raised ones — PG's
+            # extended protocol discards everything until Sync after ANY
+            # error, and pipelined clients rely on it (a BEGIN that fails
+            # must not let the batch autocommit statement-by-statement).
             self._skip_to_sync = True
 
     def _on_sync(self, payload: bytes) -> None:
@@ -363,20 +386,33 @@ class _Session:
             return _msg(b"C", _cstr(upper.split(None, 1)[0]))
 
         if upper in ("BEGIN", "START TRANSACTION"):
-            # IMMEDIATE: take the write lock up front so concurrent
-            # replicas' write transactions serialize instead of
-            # deadlocking on lock upgrades mid-transaction.
-            self.db.execute("BEGIN IMMEDIATE")
+            # Queue at the server's fair write gate, THEN take SQLite's
+            # write lock (IMMEDIATE — up front, so transactions never
+            # deadlock on lock upgrades mid-transaction). The gate keeps
+            # pooled/replica clients from spinning in SQLite's busy-wait.
+            if not self.server.write_gate.acquire(timeout=30.0):
+                return _error_msg("40001", "write gate timeout")
+            self.holds_write_gate = True
+            try:
+                self.db.execute("BEGIN IMMEDIATE")
+            except sqlite3.Error:
+                # An autocommit writer may hold SQLite's lock past the
+                # busy timeout; the gate must not stay held by a session
+                # with no transaction open.
+                self._release_write_gate()
+                raise
             self.in_tx, self.aborted = True, False
             return _msg(b"C", _cstr("BEGIN"))
         if upper in ("COMMIT", "END"):
             self.db.execute("ROLLBACK" if self.aborted else "COMMIT")
             was_aborted, self.in_tx, self.aborted = self.aborted, False, False
+            self._release_write_gate()
             return _msg(b"C", _cstr("ROLLBACK" if was_aborted else "COMMIT"))
         if upper == "ROLLBACK":
             if self.in_tx:
                 self.db.execute("ROLLBACK")
             self.in_tx, self.aborted = False, False
+            self._release_write_gate()
             return _msg(b"C", _cstr("ROLLBACK"))
 
         m = _ADVISORY.search(stripped)
@@ -430,24 +466,54 @@ class _Session:
         return bytes(out)
 
     def _translate(self, sql: str) -> tuple[str, list[str]]:
-        """PG dialect -> SQLite: $n params, BIGSERIAL, FOR UPDATE."""
-        s = _DOLLAR_PARAM.sub("?", sql)
-        s = re.sub(r"\s+FOR\s+UPDATE\b", "", s, flags=re.IGNORECASE)
-        post_ddl: list[str] = []
-        if _BIGSERIAL_PK.search(s):
-            s = _BIGSERIAL_PK.sub("INTEGER PRIMARY KEY AUTOINCREMENT", s)
-        cols = [m.group(1) for m in _BIGSERIAL_COL.finditer(s)]
-        if cols:
-            s = _BIGSERIAL_COL.sub(lambda m: f"{m.group(1)} INTEGER", s)
-            m_table = _CREATE_TABLE.search(s)
-            if m_table is not None:
-                table = m_table.group(1)
-                # Insertion-order sequence for plain BIGSERIAL columns
-                # (the PG transactions.seq tiebreak).
-                for col in cols:
-                    post_ddl.append(
-                        f"CREATE TRIGGER IF NOT EXISTS {table}_{col}_fill "
-                        f"AFTER INSERT ON {table} WHEN NEW.{col} IS NULL "
-                        f"BEGIN UPDATE {table} SET {col} = NEW.rowid "
-                        f"WHERE rowid = NEW.rowid; END")
-        return s, post_ddl
+        return _translate_cached(sql)
+
+
+@functools.lru_cache(maxsize=1024)
+def _translate_cached(sql: str) -> tuple[str, list[str]]:
+    """PG dialect -> SQLite: $n params, BIGSERIAL, FOR UPDATE. Cached —
+    the platform speaks a small fixed statement set, and this regex
+    pipeline would otherwise run on EVERY execute."""
+    s = _DOLLAR_PARAM.sub("?", sql)
+    s = re.sub(r"\s+FOR\s+UPDATE\b", "", s, flags=re.IGNORECASE)
+    post_ddl: list[str] = []
+    if _BIGSERIAL_PK.search(s):
+        s = _BIGSERIAL_PK.sub("INTEGER PRIMARY KEY AUTOINCREMENT", s)
+    cols = [m.group(1) for m in _BIGSERIAL_COL.finditer(s)]
+    if cols:
+        s = _BIGSERIAL_COL.sub(lambda m: f"{m.group(1)} INTEGER", s)
+        m_table = _CREATE_TABLE.search(s)
+        if m_table is not None:
+            table = m_table.group(1)
+            # Insertion-order sequence for plain BIGSERIAL columns
+            # (the PG transactions.seq tiebreak).
+            for col in cols:
+                post_ddl.append(
+                    f"CREATE TRIGGER IF NOT EXISTS {table}_{col}_fill "
+                    f"AFTER INSERT ON {table} WHEN NEW.{col} IS NULL "
+                    f"BEGIN UPDATE {table} SET {col} = NEW.rowid "
+                    f"WHERE rowid = NEW.rowid; END")
+    return s, post_ddl
+
+
+def _serve_forever(argv: list[str]) -> None:
+    """CLI: serve one rig as its OWN OS process — the deployment shape of
+    a real database server (benchmarks and multi-process suites point
+    replicas at it). Prints `PG_RIG_PORT=<port>` when ready."""
+    db_path = argv[1]
+    port = int(argv[2]) if len(argv) > 2 else 0
+    server = PgSqliteServer(db_path, port=port)
+    print(f"PG_RIG_PORT={server.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+
+
+if __name__ == "__main__":
+    import sys as _sys
+
+    _serve_forever(_sys.argv)

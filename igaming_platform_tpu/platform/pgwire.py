@@ -44,6 +44,7 @@ class PgProtocolError(RuntimeError):
 
 
 UNIQUE_VIOLATION = "23505"
+CHECK_VIOLATION = "23514"
 SERIALIZATION_FAILURE = "40001"
 
 
@@ -69,11 +70,26 @@ class PgUrl:
         )
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=512)
+def _returns_rows(sql: str) -> bool:
+    """Whether a statement can produce a RowDescription (needs Describe)."""
+    head = sql.lstrip()[:8].upper()
+    if head.startswith(("SELECT", "WITH", "SHOW", "VALUES")):
+        return True
+    return "RETURNING" in sql.upper()
+
+
+@functools.lru_cache(maxsize=512)
 def qmark_to_dollar(sql: str) -> str:
     """Translate '?' placeholders to $1..$n, skipping string literals.
 
     Lets the repository layer keep ONE set of SQL statements for both the
-    SQLite ('?') and Postgres ('$n') dialects.
+    SQLite ('?') and Postgres ('$n') dialects. Cached: the repository's
+    statement set is small and fixed, and the per-character scan would
+    otherwise run on every single operation.
     """
     out: list[str] = []
     n = 0
@@ -351,12 +367,14 @@ class PgConnection:
                     v = str(p).encode()
                 bind += struct.pack(">I", len(v)) + v
         bind += struct.pack(">H", 0)  # results in text format
-        self._pending_frames += (
-            self._msg(b"P", b"\x00" + parse)
-            + self._msg(b"B", bytes(bind))
-            + self._msg(b"D", b"P\x00")
-            + self._msg(b"E", b"\x00" + struct.pack(">I", 0))
-        )
+        frames = self._msg(b"P", b"\x00" + parse) + self._msg(b"B", bytes(bind))
+        if _returns_rows(sql):
+            # Describe is only needed where a RowDescription will follow —
+            # writes (INSERT/UPDATE/DELETE without RETURNING) skip the
+            # frame and its NoData reply.
+            frames += self._msg(b"D", b"P\x00")
+        frames += self._msg(b"E", b"\x00" + struct.pack(">I", 0))
+        self._pending_frames += frames
         cur = _Cursor(self, error_mapper)
         self._pending.append(cur)
         return cur

@@ -33,6 +33,7 @@ from igaming_platform_tpu.core.enums import (
 )
 from igaming_platform_tpu.platform.domain import (
     Account,
+    AccountNotFoundError,
     AccountSuspendedError,
     ConcurrentUpdateError,
     InsufficientBalanceError,
@@ -165,11 +166,10 @@ class WalletService:
         ip: str = "", device_id: str = "", fingerprint: str = "",
     ) -> OpResult:
         self._check_amount(amount)
-        replay = self._replay(account_id, idempotency_key)
+        replay, account = self._begin_op(account_id, idempotency_key)
         if replay is not None:
             return replay
 
-        account = self._active_account(account_id)
         risk_score = self._risk_gate_open(
             account_id, amount, "deposit", ip=ip, device_id=device_id, fingerprint=fingerprint
         )
@@ -186,11 +186,9 @@ class WalletService:
         max_bet_check=None,
     ) -> OpResult:
         self._check_amount(amount)
-        replay = self._replay(account_id, idempotency_key)
+        replay, account = self._begin_op(account_id, idempotency_key)
         if replay is not None:
             return replay
-
-        account = self._active_account(account_id)
 
         # Sufficient total balance: real + bonus (wallet_service.go:371-375).
         total = account.balance + account.bonus
@@ -232,13 +230,11 @@ class WalletService:
         win_type: str = "normal",
     ) -> OpResult:
         self._check_amount(amount)
-        replay = self._replay(account_id, idempotency_key)
-        if replay is not None:
-            return replay
-
         # Wins skip the risk gate entirely (SURVEY.md §3.2) and credit the
         # real balance only (wallet_service.go:497-500).
-        account = self.accounts.get_by_id(account_id)
+        replay, account = self._begin_op(account_id, idempotency_key, require_active=False)
+        if replay is not None:
+            return replay
         new_balance = account.balance + amount
         tx = self._pending_tx(
             account, idempotency_key, TxType.WIN, amount,
@@ -255,11 +251,9 @@ class WalletService:
         payout_method: str = "", ip: str = "", device_id: str = "", fingerprint: str = "",
     ) -> OpResult:
         self._check_amount(amount)
-        replay = self._replay(account_id, idempotency_key)
+        replay, account = self._begin_op(account_id, idempotency_key)
         if replay is not None:
             return replay
-
-        account = self._active_account(account_id)
 
         # Only real balance withdraws (wallet_service.go:589-593).
         if account.balance < amount:
@@ -315,10 +309,9 @@ class WalletService:
 
     def grant_bonus(self, account_id: str, amount: int, idempotency_key: str, rule_id: str = "") -> OpResult:
         self._check_amount(amount)
-        replay = self._replay(account_id, idempotency_key)
+        replay, account = self._begin_op(account_id, idempotency_key)
         if replay is not None:
             return replay
-        account = self._active_account(account_id)
         new_bonus = account.bonus + amount
         tx = self._pending_tx(
             account, idempotency_key, TxType.BONUS_GRANT, amount, f"bonus:{rule_id}"
@@ -357,6 +350,35 @@ class WalletService:
         if amount <= 0:
             raise InvalidAmountError(f"amount must be positive: {amount}")
 
+    def _begin_op(
+        self, account_id: str, idempotency_key: str, *, require_active: bool = True,
+    ) -> tuple[OpResult | None, Account | None]:
+        """Op prologue: idempotency replay check + account fetch.
+
+        On backends exposing a combined pipelined read (PostgresStore's
+        get_idem_and_account) both rows arrive in ONE wire round trip;
+        otherwise two eager reads. Semantics identical either way: failed
+        rows do not satisfy idempotency (_replay docstring), a missing
+        account raises, and a replay hit returns before any status check
+        (a suspended account still replays its past result)."""
+        combo = getattr(self.transactions, "get_idem_and_account", None)
+        if combo is None:
+            replay = self._replay(account_id, idempotency_key)
+            if replay is not None:
+                return replay, None
+            account = (self._active_account(account_id) if require_active
+                       else self.accounts.get_by_id(account_id))
+            return None, account
+        existing, account = combo(account_id, idempotency_key)
+        replay = self._replay_result(existing)
+        if replay is not None:
+            return replay, None
+        if account is None:
+            raise AccountNotFoundError(account_id)
+        if require_active:
+            self._check_active(account)
+        return None, account
+
     def _replay(self, account_id: str, idempotency_key: str) -> OpResult | None:
         """Idempotency replay (wallet_service.go:242-248).
 
@@ -366,14 +388,24 @@ class WalletService:
         attempt lost the version race would silently never apply.)
         """
         existing = self.transactions.get_by_idempotency_key(account_id, idempotency_key)
+        return self._replay_result(existing)
+
+    @staticmethod
+    def _replay_result(existing: Transaction | None) -> OpResult | None:
+        """The one place the replay rule lives: failed rows never satisfy
+        idempotency (both prologue paths share this filter)."""
         if existing is None or existing.status == TxStatus.FAILED:
             return None
         return OpResult(existing, existing.balance_after)
 
-    def _active_account(self, account_id: str) -> Account:
-        account = self.accounts.get_by_id(account_id)
+    @staticmethod
+    def _check_active(account: Account) -> None:
         if account.status != AccountStatus.ACTIVE:
             raise AccountSuspendedError(f"account is not active: {account.status.value}")
+
+    def _active_account(self, account_id: str) -> Account:
+        account = self.accounts.get_by_id(account_id)
+        self._check_active(account)
         return account
 
     def _risk_gate_open(
