@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-fast lint bench bench-all bench-replicas eval native proto run-risk run-wallet dryrun clean soak soak-wire api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
+.PHONY: all test test-fast lint bench bench-all bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
 
 all: native test
 
@@ -31,6 +31,11 @@ bench-all:
 # PG-wire database (REPLICA_KS, REPLICA_CYCLES; POSTGRES_URL for live PG).
 bench-replicas:
 	$(PY) benchmarks/replicas.py
+
+# End-to-end rehearsal of the on-device capture script in CPU mode
+# (all six artifact stages into a scratch dir, asserted non-empty+JSON).
+drill:
+	CAPTURE_DRILL=1 $(CPU_ENV) $(PY) -m pytest tests/test_device_capture_drill.py -q
 
 soak:
 	$(PY) benchmarks/soak.py

@@ -66,6 +66,11 @@ def device_pipeline_numbers() -> dict:
         out = fn(params, pool[i % len(pool)].copy(), blacklisted, thresholds)
     jax.block_until_ready(out)
 
+    # The stream is fenced by a REAL readback of each batch's packed
+    # score array (what the serving collect thread does) — NOT
+    # block_until_ready, which on the tunneled backend can return at
+    # dispatch-acknowledgement and inflate throughput ~30x
+    # (obs/perfmodel.device_step_time docstring).
     lat = []
     inflight = []
     start = time.perf_counter()
@@ -75,26 +80,24 @@ def device_pipeline_numbers() -> dict:
         inflight.append((t0, out))
         if len(inflight) > pipeline_depth:
             t0_old, old = inflight.pop(0)
-            old["score"].block_until_ready()
+            jax.device_get(old["score"])
             lat.append((time.perf_counter() - t0_old) * 1000.0)
     for t0_old, old in inflight:
-        old["score"].block_until_ready()
+        jax.device_get(old["score"])
         lat.append((time.perf_counter() - t0_old) * 1000.0)
     total = time.perf_counter() - start
 
-    # Pure device-step time with device-resident inputs.
+    # Pure device-step time with device-resident inputs: two-point fit
+    # with a readback fence (the only honest step timing through an
+    # async/tunneled dispatch path).
+    from igaming_platform_tpu.obs.perfmodel import device_step_time
+
     fn_nd = jax.jit(make_score_fn(cfg, ml_backend="multitask"))
     xd = jax.device_put(pool[0])
     bld = jax.device_put(blacklisted)
     thrd = jax.device_put(thresholds)
-    out = fn_nd(params, xd, bld, thrd)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    dev_iters = 30
-    for _ in range(dev_iters):
-        out = fn_nd(params, xd, bld, thrd)
-    jax.block_until_ready(out)
-    device_step_ms = (time.perf_counter() - t0) / dev_iters * 1000.0
+    step_s = device_step_time(lambda: fn_nd(params, xd, bld, thrd)["score"])
+    device_step_ms = round(step_s * 1e3, 3) if step_s == step_s else None
 
     # Utilization vs chip peaks (obs/perfmodel): the [B,30] ensemble is
     # bandwidth-bound, so hbm_util is the meaningful figure; mfu rides
@@ -103,15 +106,16 @@ def device_pipeline_numbers() -> dict:
 
     util = utilization(
         cost_of(fn_nd, params, xd, bld, thrd),
-        device_step_ms / 1000.0, jax.devices()[0],
+        step_s, jax.devices()[0],
     )
 
     lat = np.array(lat)
     return {
         "device_stream_txns_per_sec": round(batch_size * iters / total, 1),
         "device_stream_p99_batch_ms": round(float(np.percentile(lat, 99)), 3),
-        "device_step_ms": round(device_step_ms, 3),
-        "device_txns_per_sec": round(batch_size / (device_step_ms / 1000.0), 1),
+        "device_step_ms": device_step_ms,
+        "device_txns_per_sec": (round(batch_size / step_s, 1)
+                                if step_s == step_s else None),
         "batch_size": batch_size,
         "pipeline_depth": pipeline_depth,
         "hbm_util": util["hbm_util"],

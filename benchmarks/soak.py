@@ -130,6 +130,7 @@ def main_wire() -> None:
     rpc_done: list[tuple[float, float]] = []  # (end time, ms)
     probe_lat: list[float] = []
     errors: list[str] = []
+    shed = [0]
 
     def batch_worker(k: int) -> None:
         ch = grpc.insecure_channel(addr)
@@ -155,8 +156,16 @@ def main_wire() -> None:
             try:
                 call(payloads[i % len(payloads)], timeout=60)
             except grpc.RpcError as exc:
-                with lock:
-                    errors.append(repr(exc)[:120])
+                if exc.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    # Admission-control shed: LOUD backpressure, not a
+                    # failure — the bulk caller's contract is retry with
+                    # backoff while interactive traffic keeps its SLO.
+                    with lock:
+                        shed[0] += 1
+                    time.sleep(0.02 * (1 + (i % 4)))
+                else:
+                    with lock:
+                        errors.append(repr(exc)[:120])
             else:
                 t1 = time.perf_counter()
                 with lock:
@@ -224,6 +233,7 @@ def main_wire() -> None:
         **({"offered_txns_per_sec": target_rate} if target_rate else {}),
         "rpcs": len(rpc_done),
         "errors": len(errors),
+        "bulk_shed": shed[0],
         "window_txns_per_sec": windows,
         "window_min": min(windows) if windows else None,
         "window_max": max(windows) if windows else None,

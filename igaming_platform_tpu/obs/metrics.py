@@ -205,6 +205,12 @@ class ServiceMetrics:
             "CheckBonusAbuse requests shed with UNAVAILABLE "
             "(ABUSE_CPU_POLICY=shed on a degraded deployment)",
         )
+        self.bulk_shed_total = self.registry.counter(
+            f"{service}_bulk_shed_total",
+            "Bulk ScoreBatch RPCs rejected RESOURCE_EXHAUSTED by admission "
+            "control (BULK_MAX_INFLIGHT) so the single-txn fast lane keeps "
+            "its latency SLO under overload",
+        )
         # Business-level series backing the Grafana dashboards the reference
         # README promises (README.md:196-202) but ships no data for: per-type
         # transaction flow (bonus conversion = bonus_grant rate vs deposit

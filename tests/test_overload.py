@@ -1,0 +1,139 @@
+"""Server-side overload control (round-4 verdict ask 4).
+
+The reference has NO admission control: a burst of bulk scoring above
+capacity queues unboundedly and interactive latency collapses (the
+round-4 flat-out control measured 167-220 ms single-txn p99). Here bulk
+ScoreBatch work passes a bounded admission gate (BULK_MAX_INFLIGHT):
+excess bulk is shed LOUDLY with RESOURCE_EXHAUSTED (+ metric) while the
+single-txn Score fast lane keeps serving. These tests drive a real gRPC
+server: a bulk flood far beyond the gate must produce sheds and zero
+silent failures, and single-txn probes must keep succeeding promptly
+throughout the flood.
+"""
+
+import threading
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from igaming_platform_tpu.core.config import BatcherConfig
+from igaming_platform_tpu.serve.grpc_server import (
+    RiskGrpcService,
+    graceful_stop,
+    serve_risk,
+)
+from igaming_platform_tpu.serve.scorer import TPUScoringEngine
+
+from risk.v1 import risk_pb2
+
+
+@pytest.fixture()
+def overload_server(monkeypatch):
+    monkeypatch.setenv("BULK_MAX_INFLIGHT", "1")
+    monkeypatch.setenv("BULK_ADMIT_WAIT_S", "0.01")
+    engine = TPUScoringEngine(batcher_config=BatcherConfig(batch_size=256, max_wait_ms=1))
+    service = RiskGrpcService(engine)
+    server, health, port = serve_risk(service, 0)
+    yield service, port
+    graceful_stop(server, health, grace=3)
+    engine.close()
+
+
+def _batch_request(n: int) -> risk_pb2.ScoreBatchRequest:
+    return risk_pb2.ScoreBatchRequest(transactions=[
+        risk_pb2.ScoreTransactionRequest(
+            account_id=f"bulk-{i % 50}", amount=1000 + i,
+            transaction_type="deposit")
+        for i in range(n)
+    ])
+
+
+def test_bulk_flood_sheds_loudly_while_singles_survive(overload_server):
+    service, port = overload_server
+    ch = grpc.insecure_channel(f"localhost:{port}")
+    batch = ch.unary_unary(
+        "/risk.v1.RiskService/ScoreBatch",
+        request_serializer=risk_pb2.ScoreBatchRequest.SerializeToString,
+        response_deserializer=risk_pb2.ScoreBatchResponse.FromString)
+    single = ch.unary_unary(
+        "/risk.v1.RiskService/ScoreTransaction",
+        request_serializer=risk_pb2.ScoreTransactionRequest.SerializeToString,
+        response_deserializer=risk_pb2.ScoreTransactionResponse.FromString)
+
+    req = _batch_request(2048)
+    ok = [0]
+    shed = [0]
+    hard_errors = []
+    stop = time.perf_counter() + 3.0
+
+    def flood():
+        while time.perf_counter() < stop:
+            try:
+                resp = batch(req, timeout=30)
+                assert len(resp.results) == 2048
+                ok[0] += 1
+            except grpc.RpcError as exc:
+                if exc.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    shed[0] += 1  # loud, typed backpressure
+                else:
+                    hard_errors.append(exc.code())
+
+    floods = [threading.Thread(target=flood) for _ in range(8)]
+    single_lat = []
+    single_errors = []
+
+    def probe():
+        i = 0
+        while time.perf_counter() < stop:
+            t0 = time.perf_counter()
+            try:
+                single(risk_pb2.ScoreTransactionRequest(
+                    account_id=f"p-{i % 16}", amount=500,
+                    transaction_type="deposit"), timeout=10)
+                single_lat.append((time.perf_counter() - t0) * 1e3)
+            except grpc.RpcError as exc:
+                single_errors.append(exc.code())
+            i += 1
+            time.sleep(0.01)
+
+    prober = threading.Thread(target=probe)
+    for t in floods:
+        t.start()
+    prober.start()
+    for t in floods:
+        t.join()
+    prober.join()
+    ch.close()
+
+    # Bulk: work flowed AND the gate shed the excess — loudly, zero
+    # silent failures.
+    assert ok[0] > 0
+    assert shed[0] > 0, "8 floods vs BULK_MAX_INFLIGHT=1 must shed"
+    assert not hard_errors, hard_errors
+    assert service.metrics.bulk_shed_total.value() >= shed[0]
+
+    # Fast lane: singles kept being served throughout the flood. (A
+    # latency SLO assertion would be machine-speed-dependent in CI; the
+    # on-device flat-out soak carries the p99 number. Here: liveness +
+    # a sane median on the host tier.)
+    assert not single_errors, single_errors
+    assert len(single_lat) >= 20
+    assert float(np.median(single_lat)) < 1000.0
+
+
+def test_exhausted_deadline_is_rejected_upfront(overload_server):
+    _service, port = overload_server
+    ch = grpc.insecure_channel(f"localhost:{port}")
+    batch = ch.unary_unary(
+        "/risk.v1.RiskService/ScoreBatch",
+        request_serializer=risk_pb2.ScoreBatchRequest.SerializeToString,
+        response_deserializer=risk_pb2.ScoreBatchResponse.FromString)
+    with pytest.raises(grpc.RpcError) as exc_info:
+        batch(_batch_request(2048), timeout=0.03)
+    assert exc_info.value.code() in (
+        grpc.StatusCode.RESOURCE_EXHAUSTED,  # rejected up front (the point)
+        grpc.StatusCode.DEADLINE_EXCEEDED,   # or the deadline fired in flight
+    )
+    ch.close()

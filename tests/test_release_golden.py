@@ -1,0 +1,82 @@
+"""Released-checkpoint golden scores (round-4 verdict ask 8).
+
+The committed checkpoint (tests/golden/, generated once by
+tools/make_release_golden.py) must keep producing its exact committed
+scores through the REAL serving score fn — the trained-model extension
+of the mock-backend golden discipline the reference uses
+(onnx_model.go:258-308). Catches regressions in the model stack, the
+normalize/standardize pipeline, checkpoint (de)serialization, and the
+int8 quantizer in every CI run, with no TPU and no retraining.
+"""
+
+import json
+import os
+
+import numpy as np
+
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")
+
+
+def _load():
+    import jax
+    from flax import serialization
+
+    from igaming_platform_tpu.models.multitask import init_multitask
+
+    with open(os.path.join(GOLDEN_DIR, "released_scores.json")) as f:
+        golden = json.load(f)
+    template = init_multitask(jax.random.key(0), trunk=tuple(golden["trunk"]))
+    with open(os.path.join(GOLDEN_DIR, "released_multitask.msgpack"), "rb") as f:
+        params = serialization.from_bytes(template, f.read())
+    data = np.load(os.path.join(GOLDEN_DIR, "released_features.npz"))
+    return golden, params, data["x"], data["y"]
+
+
+def test_released_checkpoint_scores_exactly():
+    from igaming_platform_tpu.core.config import ScoringConfig
+    from igaming_platform_tpu.models.ensemble import make_score_fn
+
+    golden, params, x, _y = _load()
+    out = make_score_fn(ScoringConfig(), "multitask")(
+        {"multitask": params}, x, np.zeros((x.shape[0],), dtype=bool))
+    np.testing.assert_array_equal(
+        np.asarray(out["score"]).astype(int), golden["f32"]["score"])
+    np.testing.assert_array_equal(
+        np.asarray(out["action"]).astype(int), golden["f32"]["action"])
+    # CPU XLA is deterministic; the committed ml_score floats must
+    # reproduce to rounding (8 decimals committed).
+    np.testing.assert_allclose(
+        np.asarray(out["ml_score"], dtype=float),
+        np.array(golden["f32"]["ml_score"]), atol=1e-6)
+
+
+def test_released_checkpoint_quantized_within_envelope():
+    """The int8 serving path of the SAME released checkpoint: its own
+    committed golden scores exactly, and every score within ±1 point of
+    the f32 path (the quantize accuracy contract, ops/quantize.py)."""
+    from igaming_platform_tpu.core.config import ScoringConfig
+    from igaming_platform_tpu.models.ensemble import make_score_fn
+    from igaming_platform_tpu.ops.quantize import quantize_multitask_fraud
+
+    golden, params, x, _y = _load()
+    from igaming_platform_tpu.core.features import normalize, standardize_for_model
+
+    q = quantize_multitask_fraud(
+        params, calibration_x=standardize_for_model(normalize(x)))
+    out = make_score_fn(ScoringConfig(), "multitask_int8")(
+        {"multitask_int8": q}, x, np.zeros((x.shape[0],), dtype=bool))
+    scores = np.asarray(out["score"]).astype(int)
+    np.testing.assert_array_equal(scores, golden["int8"]["score"])
+    assert np.max(np.abs(scores - np.array(golden["f32"]["score"]))) <= 1
+
+
+def test_released_checkpoint_separates_fraud():
+    """Sanity on the labeled golden rows: the released model actually
+    ranks fraud above legit (it is a real trained artifact, not noise)."""
+    from igaming_platform_tpu.models.multitask import fraud_predict
+    from igaming_platform_tpu.core.features import normalize, standardize_for_model
+
+    _golden, params, x, y = _load()
+    xn = standardize_for_model(normalize(x))
+    p = np.asarray(fraud_predict(params, xn)).ravel()
+    assert p[y > 0].mean() > p[y == 0].mean() + 0.2
