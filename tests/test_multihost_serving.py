@@ -1,0 +1,227 @@
+"""Multi-host serving AT THE WIRE (round-4 verdict ask 9).
+
+Two REAL OS processes form one jax.distributed mesh (2 procs x 2 local
+CPU devices = data=4 over "DCN"): process 0 runs the FULL risk gRPC
+server (serve/multihost.py front — continuous batcher, feature store,
+health, real socket) whose every device step executes over the global
+mesh; process 1 is a follower mirroring each step through the work
+channel. The parent drives ScoreBatch + ScoreTransaction against the
+front's real port and parity-checks every score against an identically
+provisioned single-process engine — the serving analogue of the
+cross-process DP-training proof, at the layer clients see.
+
+Feature provisioning follows the dryrun's exact-parity discipline
+(__graft_entry__.py stage 6): event ages OUTSIDE every velocity window
+and past the session TTL, one shared seed timestamp, calls back-to-back.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+
+from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
+from igaming_platform_tpu.serve.feature_store import TransactionEvent
+from igaming_platform_tpu.serve.scorer import ScoreRequest, TPUScoringEngine
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PREAMBLE = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["REPO_ROOT"])
+"""
+
+_WORKER = _PREAMBLE + """
+import time
+import numpy as np
+
+from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
+from igaming_platform_tpu.models.multitask import init_multitask
+from igaming_platform_tpu.parallel.distributed import global_mesh, initialize_from_env
+from igaming_platform_tpu.parallel.mesh import MeshSpec
+from igaming_platform_tpu.serve.feature_store import TransactionEvent
+from igaming_platform_tpu.serve import multihost
+
+assert initialize_from_env() is True
+mesh = global_mesh(MeshSpec(data=-1))
+cfg = ScoringConfig()
+params = jax.device_get({"multitask": init_multitask(jax.random.key(0))})
+follower_port = int(os.environ["FOLLOWER_PORT"])
+seed_now = float(os.environ["SEED_NOW"])
+done_path = os.environ["DONE_PATH"]
+
+if jax.process_index() == 1:
+    multihost.follower_serve(follower_port, cfg, "multitask", params, mesh)
+    sys.exit(0)
+
+# Front: the follower's listener must be up before the channel dials.
+time.sleep(1.0)
+engine = multihost.multihost_engine(
+    mesh, [follower_port], config=cfg,
+    batcher_config=BatcherConfig(batch_size=16, max_wait_ms=1.0),
+    ml_backend="multitask", params=params,
+)
+for a in range(24):
+    for k, age_s in enumerate((4000.0, 4500.0, 5000.0, 6000.0)):
+        engine.update_features(TransactionEvent(
+            account_id=f"mh-{a}", amount=900 + 37 * a + 11 * k,
+            tx_type=("deposit", "bet", "win")[k % 3],
+            ip=f"10.9.{a}.{k}", device_id=f"dev-{a % 8}",
+            timestamp=seed_now - age_s,
+        ))
+
+from igaming_platform_tpu.serve.grpc_server import (
+    RiskGrpcService, graceful_stop, serve_risk,
+)
+
+server, health, port = serve_risk(RiskGrpcService(engine), 0)
+print(f"FRONT_PORT={port}", flush=True)
+while not os.path.exists(done_path):
+    time.sleep(0.1)
+graceful_stop(server, health, grace=3)
+engine.close()
+print("FRONT_CLEAN_EXIT", flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_full_server_parity(tmp_path):
+    coord, follower_port = _free_port(), _free_port()
+    seed_now = time.time()
+    done_path = str(tmp_path / "done")
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent(_WORKER))
+
+    env = dict(
+        os.environ,
+        REPO_ROOT=REPO,
+        COORDINATOR_ADDRESS=f"localhost:{coord}",
+        NUM_PROCESSES="2",
+        FOLLOWER_PORT=str(follower_port),
+        SEED_NOW=repr(seed_now),
+        DONE_PATH=done_path,
+    )
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker)], env={**env, "PROCESS_ID": str(i)},
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        # Wait for the front's real gRPC port.
+        port = None
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            line = procs[0].stdout.readline()
+            if line.startswith("FRONT_PORT="):
+                port = int(line.split("=", 1)[1])
+                break
+            if procs[0].poll() is not None:
+                raise AssertionError("front died: " + procs[0].stdout.read()[-2000:])
+        assert port is not None, "front never reported its port"
+
+        # Identically provisioned single-process reference engine.
+        ref = TPUScoringEngine(
+            ScoringConfig(), ml_backend="multitask",
+            params={"multitask": __import__(
+                "igaming_platform_tpu.models.multitask",
+                fromlist=["init_multitask"]).init_multitask(
+                    __import__("jax").random.key(0))},
+            batcher_config=BatcherConfig(batch_size=16, max_wait_ms=1.0),
+        )
+        for a in range(24):
+            for k, age_s in enumerate((4000.0, 4500.0, 5000.0, 6000.0)):
+                ref.update_features(TransactionEvent(
+                    account_id=f"mh-{a}", amount=900 + 37 * a + 11 * k,
+                    tx_type=("deposit", "bet", "win")[k % 3],
+                    ip=f"10.9.{a}.{k}", device_id=f"dev-{a % 8}",
+                    timestamp=seed_now - age_s,
+                ))
+
+        import grpc
+
+        from risk.v1 import risk_pb2
+
+        txs = [
+            risk_pb2.ScoreTransactionRequest(
+                account_id=f"mh-{i % 24}", amount=500 + 313 * i,
+                transaction_type=("deposit", "bet", "withdraw")[i % 3],
+                ip_address=f"10.9.{i % 24}.9", device_id=f"dev-{i % 8}",
+            )
+            for i in range(24)  # 1.5x the ladder batch: chunking + padding
+        ]
+        ch = grpc.insecure_channel(f"localhost:{port}")
+        batch = ch.unary_unary(
+            "/risk.v1.RiskService/ScoreBatch",
+            request_serializer=risk_pb2.ScoreBatchRequest.SerializeToString,
+            response_deserializer=risk_pb2.ScoreBatchResponse.FromString)
+        single = ch.unary_unary(
+            "/risk.v1.RiskService/ScoreTransaction",
+            request_serializer=risk_pb2.ScoreTransactionRequest.SerializeToString,
+            response_deserializer=risk_pb2.ScoreTransactionResponse.FromString)
+
+        # Warm the multi-host compiled path, then the parity pair
+        # back-to-back (time-derived features drift with wall time).
+        batch(risk_pb2.ScoreBatchRequest(transactions=txs), timeout=180)
+        resp = batch(risk_pb2.ScoreBatchRequest(transactions=txs), timeout=60)
+        ref_out = ref.score_batch([
+            ScoreRequest(t.account_id, amount=t.amount,
+                         tx_type=t.transaction_type, ip=t.ip_address,
+                         device_id=t.device_id)
+            for t in txs
+        ])
+
+        got_scores = [r.score for r in resp.results]
+        want_scores = [r.score for r in ref_out]
+        np.testing.assert_allclose(got_scores, want_scores, atol=1)
+        got_ml = np.array([r.ml_score for r in resp.results])
+        want_ml = np.array([r.ml_score for r in ref_out])
+        np.testing.assert_allclose(got_ml, want_ml, atol=5e-4)
+
+        # Single-txn RPC rides the same multi-host engine.
+        s = single(txs[0], timeout=60)
+        assert abs(s.score - want_scores[0]) <= 1
+
+        # Runtime threshold updates must reach the multi-host step (the
+        # always-fresh self._thresholds copy): block everything.
+        upd = ch.unary_unary(
+            "/risk.v1.RiskService/UpdateThresholds",
+            request_serializer=risk_pb2.UpdateThresholdsRequest.SerializeToString,
+            response_deserializer=risk_pb2.UpdateThresholdsResponse.FromString)
+        upd(risk_pb2.UpdateThresholdsRequest(block_threshold=1, review_threshold=0),
+            timeout=30)
+        resp2 = batch(risk_pb2.ScoreBatchRequest(transactions=txs), timeout=60)
+        assert all(r.action == 3 for r in resp2.results), \
+            [r.action for r in resp2.results]
+
+        ref.close()
+        ch.close()
+    finally:
+        with open(done_path, "w") as f:
+            f.write("done")
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-2000:]}"
+    assert "FRONT_CLEAN_EXIT" in outs[0]
