@@ -195,6 +195,7 @@ class _Session:
         self.in_tx = False
         self.aborted = False
         self.holds_write_gate = False
+        self._stmts: dict[bytes, str] = {}  # named prepared statements
         self.held_advisory: set[int] = set()
         self._buf = b""
         self._pending_sql: str | None = None
@@ -277,16 +278,34 @@ class _Session:
         if self._skip_to_sync:
             return
         # name \0 sql \0 H n_param_oids ...
-        _, rest = payload.split(_NULL, 1)
+        name, rest = payload.split(_NULL, 1)
         sql, _ = rest.split(_NULL, 1)
+        if name:
+            # Named prepared statement (the client's per-connection
+            # statement cache): parsed once, bound many times.
+            self._stmts[name] = sql.decode()
         self._pending_sql = sql.decode()
         self._out += _msg(b"1", b"")
 
     def _on_bind(self, payload: bytes) -> None:
         if self._skip_to_sync:
             return
-        pos = payload.index(_NULL) + 1          # portal name
-        pos = payload.index(_NULL, pos) + 1     # statement name
+        end_portal = payload.index(_NULL)
+        pos = end_portal + 1                    # portal name
+        end_stmt = payload.index(_NULL, pos)
+        stmt_name = payload[pos:end_stmt]
+        if stmt_name:
+            self._pending_sql = self._stmts.get(stmt_name)
+            if self._pending_sql is None:
+                self._out += _error_msg(
+                    "26000", f"prepared statement {stmt_name!r} does not exist")
+                if self.in_tx:
+                    # Real PG: ANY extended-protocol error inside an
+                    # explicit transaction aborts it.
+                    self.aborted = True
+                self._skip_to_sync = True
+                return
+        pos = end_stmt + 1                      # statement name
         (nfmt,) = struct.unpack_from(">H", payload, pos)
         pos += 2 + 2 * nfmt
         (nparams,) = struct.unpack_from(">H", payload, pos)

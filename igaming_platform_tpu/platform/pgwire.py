@@ -232,6 +232,18 @@ class PgConnection:
         # Pipeline state: frames + cursors buffered since the last flush.
         self._pending: list[_Cursor] = []
         self._pending_frames = bytearray()
+        # Named prepared statements (pgx's automatic statement cache):
+        # each distinct SQL is Parse'd ONCE per connection under a name;
+        # later executions send only Bind/Execute — the server skips
+        # re-parsing and the wire skips re-shipping the SQL text. Names
+        # are monotonic and never reused. New names COMMIT into the cache
+        # only when their batch flushes cleanly: a rollback may drop
+        # never-sent Parse frames, and an error makes the server skip
+        # later Parses — assuming either exists would bind a statement
+        # the server never saw (26000) forever.
+        self._stmt_names: dict[str, bytes] = {}
+        self._pending_stmt_names: dict[str, bytes] = {}
+        self._stmt_counter = 0
 
     # -- IO -----------------------------------------------------------------
 
@@ -349,8 +361,15 @@ class PgConnection:
         be triggered by a LATER statement's cursor, so the mapping must
         travel with the statement it belongs to)."""
         sql = qmark_to_dollar(sql)
-        parse = sql.encode() + b"\x00" + struct.pack(">H", 0)
-        bind = bytearray(b"\x00\x00")  # unnamed portal, unnamed statement
+        name = self._stmt_names.get(sql) or self._pending_stmt_names.get(sql)
+        parse_frame = b""
+        if name is None:
+            self._stmt_counter += 1
+            name = b"s%d" % self._stmt_counter
+            self._pending_stmt_names[sql] = name  # committed at clean flush
+            parse_frame = self._msg(
+                b"P", name + b"\x00" + sql.encode() + b"\x00" + struct.pack(">H", 0))
+        bind = bytearray(b"\x00" + name + b"\x00")  # unnamed portal, named stmt
         bind += struct.pack(">H", 0)  # all params text format
         bind += struct.pack(">H", len(params))
         for p in params:
@@ -367,7 +386,7 @@ class PgConnection:
                     v = str(p).encode()
                 bind += struct.pack(">I", len(v)) + v
         bind += struct.pack(">H", 0)  # results in text format
-        frames = self._msg(b"P", b"\x00" + parse) + self._msg(b"B", bytes(bind))
+        frames = parse_frame + self._msg(b"B", bytes(bind))
         if _returns_rows(sql):
             # Describe is only needed where a RowDescription will follow —
             # writes (INSERT/UPDATE/DELETE without RETURNING) skip the
@@ -416,10 +435,21 @@ class PgConnection:
                 c._done = True  # dead socket: never re-flush from a cursor
             raise
         if stmt_error is not None:
+            # The server skipped everything after the failed statement —
+            # any Parse in THIS batch may not exist server-side, so its
+            # names are dropped un-committed (fresh names re-Parse on
+            # next use; a Parse that DID run before the error leaves a
+            # harmless orphan statement, bounded by error count).
+            # Established cache entries stay valid: protocol-level
+            # prepared statements survive transaction aborts.
+            self._pending_stmt_names.clear()
             idx, err = stmt_error
             mapper = cursors[idx]._mapper
             mapped = mapper(err) if mapper is not None else err
             raise mapped from (err if mapped is not err else None)
+        if self._pending_stmt_names:
+            self._stmt_names.update(self._pending_stmt_names)
+            self._pending_stmt_names.clear()
         if trailing_error is not None:
             raise trailing_error
 
@@ -497,6 +527,9 @@ class PgConnection:
         for c in self._pending:
             c._done = True  # dropped with the transaction; never re-flush
         self._pending, self._pending_frames = [], bytearray()
+        # Parse frames dropped here never reached the server — their
+        # names must not enter the cache (26000 forever otherwise).
+        self._pending_stmt_names.clear()
 
     def rollback(self) -> None:
         if self._pending and not self.in_transaction:

@@ -184,3 +184,22 @@ def test_failed_first_statement_skips_rest_of_batch(pg):
         conn.flush()
     assert conn.execute("SELECT COUNT(*) FROM skiptest").fetchone()[0] == 0
     conn.close()
+
+
+def test_rollback_does_not_poison_statement_cache(pg):
+    """A rollback that drops never-sent frames must not leave their
+    prepared-statement names in the cache — the server never saw those
+    Parse frames, and binding them later would 26000 forever (review
+    finding, round 5)."""
+    from igaming_platform_tpu.platform.pgwire import PgConnection
+
+    conn = PgConnection(pg.url)
+    conn.connect()
+    conn.execute("CREATE TABLE pc (x BIGINT)")
+    conn.begin_pipelined()
+    conn.execute_pipelined("INSERT INTO pc VALUES (?)", (1,))  # new SQL, never sent
+    conn.rollback()  # drops the buffered batch without touching the socket
+    # Same SQL must re-Parse cleanly under a fresh name and work.
+    conn.execute("INSERT INTO pc VALUES (?)", (2,))
+    assert conn.execute("SELECT COUNT(*) FROM pc").fetchone()[0] == 1
+    conn.close()
