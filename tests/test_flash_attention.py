@@ -85,7 +85,7 @@ def test_tiled_variant_matches_dense():
     q = jnp.asarray(rng.normal(size=(b * h, s, dh)), jnp.float32)
     k = jnp.asarray(rng.normal(size=(b * h, s, dh)), jnp.float32)
     v = jnp.asarray(rng.normal(size=(b * h, s, dh)), jnp.float32)
-    out = _run_tiled(q, k, v, block_q=128, block_k=128, interpret=True)
+    out, _lse = _run_tiled(q, k, v, block_q=128, block_k=128, interpret=True)
     ref = dense(q.reshape(b, h, s, dh), k.reshape(b, h, s, dh),
                 v.reshape(b, h, s, dh)).reshape(b * h, s, dh)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -103,7 +103,7 @@ def test_variant_selection_by_length(monkeypatch):
     def fake(which):
         def run(q, k, v, *, block_q, block_k, interpret):
             calls.append(which)
-            return q
+            return q, q[..., :1]  # (out, lse) contract
 
         return run
 
@@ -113,3 +113,72 @@ def test_variant_selection_by_length(monkeypatch):
         q = jnp.zeros((1, 1, s, 16), jnp.float32)
         fa.flash_attention(q, q, q, interpret=True)
         assert calls[-1] == expect, s
+
+
+def test_flash_backward_matches_dense_grads():
+    """The blockwise FlashAttention-2 backward (dQ/dKV kernels, driven by
+    the saved row-LSE) must match autodiff through the dense einsum path
+    on all three inputs — training through the kernel is exact, not
+    approximate."""
+    rng = np.random.default_rng(11)
+    b, h, s, dh = 2, 2, 256, 16
+    q = jnp.asarray(rng.normal(size=(b, h, s, dh)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h, s, dh)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h, s, dh)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(b, h, s, dh)), jnp.float32)  # cotangent mixer
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, interpret=True) * w)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense(q, k, v) * w)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_flash_backward_long_sequence_xla_branch(monkeypatch):
+    """Past the resident budget the backward takes the XLA recompute
+    branch (exact, O(S^2) HBM) — force the boundary low and pin parity."""
+    from igaming_platform_tpu.ops.pallas import flash_attention as fa
+
+    monkeypatch.setattr(fa, "_RESIDENT_MAX_S", 128)
+    fa._flash_with_vjp.cache_clear()
+    try:
+        rng = np.random.default_rng(13)
+        b, h, s, dh = 1, 2, 256, 16
+        q = jnp.asarray(rng.normal(size=(b, h, s, dh)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(b, h, s, dh)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(b, h, s, dh)), jnp.float32)
+
+        gf = jax.grad(lambda q, k, v: jnp.sum(
+            fa.flash_attention(q, k, v, interpret=True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(lambda q, k, v: jnp.sum(dense(q, k, v) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-4, atol=2e-4)
+    finally:
+        fa._flash_with_vjp.cache_clear()
+
+
+def test_training_through_flash_kernel_does_not_crash():
+    """Round-5 latent-bug regression: on a TPU backend with
+    block-divisible S, the abuse trainer's loss differentiates THROUGH
+    the flash kernel — before the custom VJP this raised 'Linearization
+    failed' and on-device abuse training crashed. Interpret mode runs the
+    same dispatch path on CPU."""
+    from igaming_platform_tpu.ops.pallas import flash_attention as fa
+
+    rng = np.random.default_rng(17)
+    x = jnp.asarray(rng.normal(size=(1, 4, 256, 16)), jnp.float32)
+
+    def loss(x):
+        return jnp.sum(fa.flash_attention(x, x, x, interpret=True))
+
+    g = jax.grad(loss)(x)
+    assert np.isfinite(np.asarray(g)).all()
