@@ -37,6 +37,8 @@ import numpy as np
 
 MAGIC_WORK = b"W"
 MAGIC_PARAMS = b"P"
+MAGIC_HELLO = b"H"
+MAGIC_NACK = b"N"
 MAGIC_STOP = b"S"
 
 from time import monotonic as _monotonic, sleep as _sleep
@@ -163,6 +165,7 @@ class WorkChannel:
 
     def __init__(self, ports: list[int], dial_timeout_s: float = 60.0):
         self._socks = []
+        self._readers = []
         for port in ports:
             deadline = _monotonic() + dial_timeout_s
             while True:
@@ -178,6 +181,7 @@ class WorkChannel:
                     _sleep(0.2)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._socks.append(s)
+            self._readers.append(_Reader(s))
         self._lock = threading.Lock()
 
     def broadcast(self, xp: np.ndarray, blp: np.ndarray, thr: np.ndarray) -> None:
@@ -190,6 +194,29 @@ class WorkChannel:
             for s in self._socks:
                 _send_frame(s, MAGIC_PARAMS, *leaves)
 
+    def broadcast_hello(self, fingerprint: np.ndarray) -> None:
+        """Handshake is BIDIRECTIONAL: send the fingerprint, then wait
+        for every follower's ACK before any work frame — a mismatched
+        follower NACKs and dies, and without the read the front's first
+        collective would wedge waiting for a dead participant."""
+        with self._lock:
+            for s in self._socks:
+                _send_frame(s, MAGIC_HELLO, fingerprint)
+            for i, reader in enumerate(self._readers):
+                try:
+                    magic, arrays = _recv_frame(reader)
+                except ConnectionError as exc:
+                    raise RuntimeError(
+                        f"multihost follower {i} closed the channel during "
+                        "the model handshake (likely a model mismatch — "
+                        "check its logs)") from exc
+                if magic == MAGIC_NACK:
+                    msg = bytes(np.asarray(arrays[0])).decode(errors="replace")                         if arrays else "follower rejected the handshake"
+                    raise RuntimeError(f"multihost follower {i} NACK: {msg}")
+                if magic != MAGIC_HELLO:
+                    raise RuntimeError(
+                        f"multihost follower {i}: bad handshake reply {magic!r}")
+
     def close(self) -> None:
         with self._lock:
             for s in self._socks:
@@ -199,6 +226,25 @@ class WorkChannel:
                 except OSError:
                     pass
             self._socks = []
+
+
+def model_fingerprint(ml_backend: str, params) -> np.ndarray:
+    """Digest of (backend, every param leaf's bytes) as a uint8 vector.
+    Front and follower jit the SAME SPMD program in lockstep — a host
+    whose checkpoint silently degraded to a different backend/params
+    would execute a DIFFERENT program over the shared mesh (wrong scores
+    on its shards, or a wedge). The boot handshake compares this."""
+    import hashlib
+
+    import jax
+
+    h = hashlib.sha256(ml_backend.encode())
+    for leaf in jax.tree_util.tree_leaves(params):
+        arr = np.asarray(leaf)
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return np.frombuffer(h.digest(), dtype=np.uint8).copy()
 
 
 def follower_serve(port: int, cfg, ml_backend: str, params, mesh) -> None:
@@ -218,6 +264,21 @@ def follower_serve(port: int, cfg, ml_backend: str, params, mesh) -> None:
 
     treedef = jax.tree_util.tree_structure(params)
     try:
+        # Boot handshake: the front's model fingerprint must match ours
+        # BEFORE any lockstep step — a degraded-to-mock host must fail
+        # loudly here, not execute a divergent SPMD program on the mesh.
+        magic, arrays = _recv_frame(reader)
+        if magic != MAGIC_HELLO:
+            raise RuntimeError(f"expected HELLO handshake, got {magic!r}")
+        mine = model_fingerprint(ml_backend, params)
+        if not np.array_equal(np.asarray(arrays[0]), mine):
+            msg = ("multihost model mismatch: this follower resolved a "
+                   f"different ({ml_backend!r}) backend/params than the "
+                   "front — check FRAUD_MODEL_PATH/ML_BACKEND on every host")
+            _send_frame(conn, MAGIC_NACK,
+                        np.frombuffer(msg.encode(), dtype=np.uint8))
+            raise RuntimeError(msg)
+        _send_frame(conn, MAGIC_HELLO)  # ACK: front may start work frames
         while True:
             magic, arrays = _recv_frame(reader)
             if magic == MAGIC_PARAMS:
@@ -294,9 +355,13 @@ def multihost_engine(mesh, follower_ports: list[int], *, batcher_config=None,
             """AOT-warm the GLOBAL executable for every ladder shape (in
             lockstep with the followers) before health can flip to
             SERVING — the stock warmup would only compile the local path
-            this engine never serves. Also warms the host tier."""
+            this engine never serves. Also warms the host tier. Starts
+            with the model-fingerprint handshake: a follower that
+            resolved different params dies loudly instead of running a
+            divergent program."""
             from igaming_platform_tpu.core.features import NUM_FEATURES
 
+            self._chan.broadcast_hello(model_fingerprint(ml_backend, params))
             thr = np.asarray(self._thresholds, np.int32)
             for shape in self._shapes:
                 xz = np.zeros((shape, NUM_FEATURES), np.float32)

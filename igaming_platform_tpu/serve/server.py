@@ -32,6 +32,55 @@ from igaming_platform_tpu.serve.scorer import ScoreRequest, TPUScoringEngine
 logger = logging.getLogger(__name__)
 
 
+def resolve_model_boot(config, ml_backend: str = "mock", params=None):
+    """FRAUD_MODEL_PATH -> (ml_backend, params): Orbax checkpoint load
+    with the reference's degrade-to-mock-on-missing semantics
+    (risk/cmd/main.go:62-63, onnx_model.go:51-59), then the ML_BACKEND
+    override (routed validated against the full expert bundle). Shared by
+    the RiskServer boot and the multi-host follower — both sides of a
+    multi-host mesh MUST resolve identical params."""
+    if params is None and config.fraud_model_path:
+        import os as _os
+
+        from igaming_platform_tpu.train.checkpoint import restore_params_for_serving
+
+        if _os.path.exists(config.fraud_model_path):
+            try:
+                params = {"multitask": restore_params_for_serving(config.fraud_model_path)}
+                ml_backend = "multitask"
+                logger.info("loaded fraud model from %s", config.fraud_model_path)
+            except Exception:
+                logger.warning(
+                    "failed to load model at %s; using mock scorer",
+                    config.fraud_model_path, exc_info=True,
+                )
+        else:
+            logger.warning(
+                "model path %s not found; using mock scorer", config.fraud_model_path
+            )
+
+    # Explicit backend override (ML_BACKEND env): wins over the
+    # checkpoint-derived default. "routed" needs the full expert
+    # bundle — fail with a config error, not a trace-time crash.
+    if config.ml_backend:
+        ml_backend = config.ml_backend
+    if ml_backend == "routed":
+        from igaming_platform_tpu.models.ensemble import ROUTED_PARAM_KEYS
+
+        missing = [
+            k for k in ROUTED_PARAM_KEYS
+            if not isinstance(params, dict) or (k != "mock" and params.get(k) is None)
+        ]
+        if missing:
+            raise RuntimeError(
+                "ML_BACKEND=routed requires a checkpoint bundle with "
+                f"params for {ROUTED_PARAM_KEYS}; missing {missing}. "
+                "Build one from trained checkpoints (or "
+                "models.ensemble.init_routed_params for dev boots)."
+            )
+    return ml_backend, params
+
+
 class RiskServer:
     """Assembled risk service: TPU engine + gRPC + HTTP sidecar + bridge."""
 
@@ -45,53 +94,12 @@ class RiskServer:
         broker: InMemoryBroker | None = None,
         grpc_port: int | None = None,
         http_port: int | None = None,
+        engine_factory=None,
     ):
         self.config = config or RiskServiceConfig.from_env()
         self.metrics = ServiceMetrics("risk")
 
-        # Model boot: FRAUD_MODEL_PATH names an Orbax checkpoint (replacing
-        # the reference's .onnx file path, risk/cmd/main.go:62-63); like the
-        # reference, a missing model degrades to the deterministic mock
-        # scorer (onnx_model.go:51-59) instead of failing boot.
-        if params is None and self.config.fraud_model_path:
-            import os as _os
-
-            from igaming_platform_tpu.train.checkpoint import restore_params_for_serving
-
-            if _os.path.exists(self.config.fraud_model_path):
-                try:
-                    params = {"multitask": restore_params_for_serving(self.config.fraud_model_path)}
-                    ml_backend = "multitask"
-                    logger.info("loaded fraud model from %s", self.config.fraud_model_path)
-                except Exception:
-                    logger.warning(
-                        "failed to load model at %s; using mock scorer",
-                        self.config.fraud_model_path, exc_info=True,
-                    )
-            else:
-                logger.warning(
-                    "model path %s not found; using mock scorer", self.config.fraud_model_path
-                )
-
-        # Explicit backend override (ML_BACKEND env): wins over the
-        # checkpoint-derived default. "routed" needs the full expert
-        # bundle — fail with a config error, not a trace-time crash.
-        if self.config.ml_backend:
-            ml_backend = self.config.ml_backend
-        if ml_backend == "routed":
-            from igaming_platform_tpu.models.ensemble import ROUTED_PARAM_KEYS
-
-            missing = [
-                k for k in ROUTED_PARAM_KEYS
-                if not isinstance(params, dict) or (k != "mock" and params.get(k) is None)
-            ]
-            if missing:
-                raise RuntimeError(
-                    "ML_BACKEND=routed requires a checkpoint bundle with "
-                    f"params for {ROUTED_PARAM_KEYS}; missing {missing}. "
-                    "Build one from trained checkpoints (or "
-                    "models.ensemble.init_routed_params for dev boots)."
-                )
+        ml_backend, params = resolve_model_boot(self.config, ml_backend, params)
 
         # Serving mesh from config: MESH_DEVICES=N shards the scoring batch
         # over the first N devices (DP over ICI); -1 takes every visible
@@ -143,14 +151,24 @@ class RiskServer:
                 logger.info("native feature store unavailable; using Python store")
 
         # Engine (AOT warm-up happens in the constructor, before SERVING).
-        self.engine = TPUScoringEngine(
-            self.config.scoring,
-            ml_backend=ml_backend,
-            params=params,
-            mesh=mesh,
-            batcher_config=self.config.batcher,
-            feature_store=feature_store,
-        )
+        # engine_factory lets a deployment swap the engine construction
+        # (the multi-host front uses serve/multihost.multihost_engine)
+        # while keeping EVERYTHING else — abuse detector, bridge, gRPC,
+        # health, sidecar — the stock assembly.
+        if engine_factory is not None:
+            self.engine = engine_factory(
+                self.config.scoring, ml_backend=ml_backend, params=params,
+                batcher_config=self.config.batcher, feature_store=feature_store,
+            )
+        else:
+            self.engine = TPUScoringEngine(
+                self.config.scoring,
+                ml_backend=ml_backend,
+                params=params,
+                mesh=mesh,
+                batcher_config=self.config.batcher,
+                feature_store=feature_store,
+            )
         # Sequence-parallel abuse scoring when the mesh has a `seq` axis:
         # ring attention shards each event history across chips (CP).
         seq_sharded = mesh is not None and int(mesh.shape.get("seq", 1)) > 1
@@ -388,12 +406,100 @@ def device_gate() -> None:
     raise SystemExit(1)
 
 
+def _multihost_mesh():
+    from igaming_platform_tpu.parallel.distributed import global_mesh, initialize_from_env
+    from igaming_platform_tpu.parallel.mesh import MeshSpec
+
+    if not initialize_from_env():
+        raise RuntimeError(
+            "MULTIHOST_ROLE requires the jax.distributed env contract "
+            "(COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID)")
+    return global_mesh(MeshSpec(data=-1))
+
+
 def main() -> None:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    # Multi-host serving roles (serve/multihost.py): one FRONT process
+    # runs the full risk server with its device step spanning the global
+    # mesh; FOLLOWER processes mirror each step via the work channel.
+    # jax.distributed.initialize must run BEFORE anything touches the
+    # XLA backend (device_gate probes jax.devices), so the role branch
+    # comes first.
+    role = os.environ.get("MULTIHOST_ROLE", "").lower()
+    if role and os.environ.get("SERVE_DEVICE_FALLBACK", "").lower() == "cpu":
+        # A single process silently pinning itself to host CPU while its
+        # mesh peers stay on TPU would assemble an inconsistent global
+        # mesh (opaque failure on EVERY host). Multi-host roles demand
+        # the device or a loud refusal — never a per-process fallback.
+        raise RuntimeError(
+            "SERVE_DEVICE_FALLBACK=cpu is not valid with MULTIHOST_ROLE: "
+            "a per-process CPU fallback would diverge the global mesh; "
+            "fix the device or remove the fallback")
+    # The wedge fast-fail probe runs in a killable SUBPROCESS
+    # (core/devices.ensure_responsive_device), so it is safe before
+    # jax.distributed.initialize — every role keeps it.
     device_gate()
-    cache_dir = enable_persistent_compile_cache()
-    if cache_dir:
-        logging.getLogger(__name__).info("persistent compile cache: %s", cache_dir)
+    if not role:
+        cache_dir = enable_persistent_compile_cache()
+        if cache_dir:
+            logging.getLogger(__name__).info("persistent compile cache: %s", cache_dir)
+    if role == "follower":
+        import jax
+
+        from igaming_platform_tpu.serve.multihost import follower_serve
+
+        config = RiskServiceConfig.from_env()
+        port_env = os.environ.get("MULTIHOST_WORK_PORT", "")
+        if not port_env:
+            raise RuntimeError("MULTIHOST_ROLE=follower requires MULTIHOST_WORK_PORT")
+        mesh = _multihost_mesh()
+        enable_persistent_compile_cache()
+        ml_backend, params = resolve_model_boot(config)
+        port = int(port_env)
+        logger.info("multihost follower: process %d/%d, work port %d",
+                    jax.process_index(), jax.process_count(), port)
+        follower_serve(port, config.scoring, ml_backend, params, mesh)
+        return
+    if role == "front":
+        import dataclasses
+
+        from igaming_platform_tpu.serve.multihost import multihost_engine
+
+        ports = [int(p) for p in
+                 os.environ.get("MULTIHOST_FOLLOWER_PORTS", "").split(",") if p]
+        if not ports:
+            # An empty channel would make the first global collective
+            # wait forever for followers that don't exist — a silent
+            # pre-SERVING wedge. Config errors must fail at boot.
+            raise RuntimeError(
+                "MULTIHOST_ROLE=front requires MULTIHOST_FOLLOWER_PORTS "
+                "(comma-separated follower work ports)")
+        mesh = _multihost_mesh()
+        enable_persistent_compile_cache()
+
+        def factory(scoring_cfg, *, ml_backend, params, batcher_config, feature_store):
+            return multihost_engine(
+                mesh, ports, config=scoring_cfg, ml_backend=ml_backend,
+                params=params, batcher_config=batcher_config,
+                feature_store=feature_store,
+            )
+
+        # The multihost engine OWNS the mesh; RiskServer must not build a
+        # second one from MESH_DEVICES (the abuse detector would jit
+        # collectives over it that followers never mirror — a mid-RPC
+        # mesh wedge).
+        config = RiskServiceConfig.from_env()
+        if config.mesh_devices:
+            logger.warning("MULTIHOST_ROLE=front ignores MESH_DEVICES/MESH_SEQ/"
+                           "MESH_EXPERT — the multihost mesh owns the devices")
+            config = dataclasses.replace(config, mesh_devices=0)
+        server = RiskServer(config, engine_factory=factory)
+        server.wait_for_signal()
+        return
+    if role:
+        raise RuntimeError(f"MULTIHOST_ROLE={role!r} not recognized (front|follower)")
+
     server = RiskServer()
     server.wait_for_signal()
 

@@ -225,3 +225,69 @@ def test_two_process_full_server_parity(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out[-2000:]}"
     assert "FRONT_CLEAN_EXIT" in outs[0]
+
+
+def test_model_mismatch_fails_handshake(tmp_path):
+    """A follower that resolved DIFFERENT params (e.g. its checkpoint
+    silently degraded to mock) must die loudly at the boot handshake —
+    never execute a divergent SPMD program on the shared mesh."""
+    coord, follower_port = _free_port(), _free_port()
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent(_PREAMBLE + """
+import numpy as np
+
+from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
+from igaming_platform_tpu.models.multitask import init_multitask
+from igaming_platform_tpu.parallel.distributed import global_mesh, initialize_from_env
+from igaming_platform_tpu.parallel.mesh import MeshSpec
+from igaming_platform_tpu.serve import multihost
+
+assert initialize_from_env() is True
+mesh = global_mesh(MeshSpec(data=-1))
+cfg = ScoringConfig()
+seed = 0 if jax.process_index() == 0 else 999  # DIVERGENT follower params
+params = jax.device_get({"multitask": init_multitask(jax.random.key(seed))})
+follower_port = int(os.environ["FOLLOWER_PORT"])
+
+if jax.process_index() == 1:
+    multihost.follower_serve(follower_port, cfg, "multitask", params, mesh)
+    sys.exit(0)
+
+import time
+time.sleep(1.0)
+try:
+    engine = multihost.multihost_engine(
+        mesh, [follower_port], config=cfg,
+        batcher_config=BatcherConfig(batch_size=16, max_wait_ms=1.0),
+        ml_backend="multitask", params=params)
+except Exception as exc:
+    print(f"FRONT_SAW: {type(exc).__name__}", flush=True)
+    sys.exit(0)
+print("FRONT_BOOTED_ANYWAY", flush=True)
+"""))
+    env = dict(
+        os.environ, REPO_ROOT=REPO,
+        COORDINATOR_ADDRESS=f"localhost:{coord}", NUM_PROCESSES="2",
+        FOLLOWER_PORT=str(follower_port),
+    )
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker)], env={**env, "PROCESS_ID": str(i)},
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    # The follower must refuse with the mismatch error (nonzero exit),
+    # and the front must never have completed a lockstep warmup.
+    assert procs[1].returncode != 0, outs[1][-1500:]
+    assert "multihost model mismatch" in outs[1], outs[1][-1500:]
+    assert "FRONT_BOOTED_ANYWAY" not in outs[0], outs[0][-1500:]
