@@ -15,13 +15,23 @@ from igaming_platform_tpu.core import devices
 
 @pytest.fixture(autouse=True)
 def _clean_probe_env(monkeypatch):
-    for var in ("BENCH_DEVICE_PROBED", "BENCH_DEVICE_FALLBACK",
-                "JAX_PLATFORMS", "DEVICE_PROBE_BUDGET_S",
-                devices._PREPIN_ENV):
+    probe_vars = ("BENCH_DEVICE_PROBED", "BENCH_DEVICE_FALLBACK",
+                  "JAX_PLATFORMS", "DEVICE_PROBE_BUDGET_S",
+                  devices._PREPIN_ENV)
+    for var in probe_vars:
         monkeypatch.delenv(var, raising=False)
     # Never let the stubbed paths pin the test process's real jax.
     monkeypatch.setattr(devices, "_pin_cpu", lambda: None)
     monkeypatch.setattr(devices, "_last_reprobe_at", 0.0)
+    yield
+    # monkeypatch.delenv(raising=False) on an ABSENT var records no undo,
+    # so values the CODE under test writes (ensure_responsive_device sets
+    # BENCH_DEVICE_PROBED / BENCH_DEVICE_FALLBACK) would LEAK into every
+    # later test's child processes — a synthetic "tunnel unresponsive"
+    # label poisoned the multihost boot test's servers. Scrub explicitly.
+    for var in probe_vars:
+        if var != "JAX_PLATFORMS":  # conftest's pin is restored by monkeypatch
+            os.environ.pop(var, None)
 
 
 def test_probe_retries_until_tunnel_recovers(monkeypatch):

@@ -42,7 +42,7 @@ def _free_port() -> int:
 
 
 def test_env_driven_front_follower_boot(tmp_path):
-    coord, work, gport, hport = (_free_port() for _ in range(4))
+    coord, work = _free_port(), _free_port()
     wrapper = tmp_path / "boot.py"
     wrapper.write_text(textwrap.dedent(_WRAPPER))
 
@@ -67,10 +67,12 @@ def test_env_driven_front_follower_boot(tmp_path):
         env={**base, "MULTIHOST_ROLE": "follower", "PROCESS_ID": "1"},
         stdout=fol_log, stderr=subprocess.STDOUT, text=True,
     )
+    # The SERVER picks its own gRPC/HTTP ports (0 = ephemeral) and logs
+    # them — a test-side bind-then-close pick races other suites' ports.
     front = subprocess.Popen(
         [sys.executable, str(wrapper)],
         env={**base, "MULTIHOST_ROLE": "front", "PROCESS_ID": "0",
-             "GRPC_PORT": str(gport), "HTTP_PORT": str(hport)},
+             "GRPC_PORT": "0", "HTTP_PORT": "0"},
         stdout=fro_log, stderr=subprocess.STDOUT, text=True,
     )
 
@@ -79,16 +81,26 @@ def test_env_driven_front_follower_boot(tmp_path):
         f.seek(0)
         return f.read()[-3000:]
     try:
-        # Wait for readiness through the real sidecar.
+        # Wait for readiness through the real sidecar, learning the
+        # server-chosen ports from its own log line.
+        import re
         import urllib.request
 
         deadline = time.time() + 240
         ready = False
+        gport = hport = None
         while time.time() < deadline:
             for p, name, f in ((front, "front", fro_log),
                                (follower, "follower", fol_log)):
                 if p.poll() is not None:
                     raise AssertionError(f"{name} died during boot:\n{tail(f)}")
+            if hport is None:
+                m = re.search(r"risk server up: grpc=(\d+) http=(\d+)", tail(fro_log))
+                if m:
+                    gport, hport = int(m.group(1)), int(m.group(2))
+                else:
+                    time.sleep(0.5)
+                    continue
             try:
                 with urllib.request.urlopen(
                         f"http://localhost:{hport}/ready", timeout=2) as r:
@@ -97,7 +109,7 @@ def test_env_driven_front_follower_boot(tmp_path):
                         break
             except OSError:
                 time.sleep(0.5)
-        assert ready, "front never became ready"
+        assert ready, f"front never became ready:\n{tail(fro_log)}"
 
         ch = grpc.insecure_channel(f"localhost:{gport}")
         score = ch.unary_unary(
