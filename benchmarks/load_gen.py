@@ -8,9 +8,15 @@ round 1 asked for: the serving path, not the device path
 (engine.go:262-323 is the matching reference surface; its README claims
 < 50 ms per scoring call).
 
-Run standalone:  python benchmarks/load_gen.py [addr]
+Run standalone:  python benchmarks/load_gen.py [addr] [--wire-mode=row|index]
 (no addr: starts an in-process server on a free port with the native
 feature store and the multitask backend — the production wiring).
+
+``--wire-mode=index`` drives the device-resident feature cache: each RPC
+ships the compact index-mode frame (serve/wire.py) instead of a protobuf
+of full transactions, and the server's device step gathers feature rows
+from the HBM-resident table — only int32 slot indices + per-txn context
+cross the host->device link (serve/device_cache.py).
 """
 
 from __future__ import annotations
@@ -53,6 +59,27 @@ def _build_request_payloads(
     return payloads
 
 
+def _build_index_payloads(
+    rows_per_rpc: int, n_variants: int = 4, n_accounts: int = 512
+) -> list[bytes]:
+    """Pre-serialized index-mode frames — the SAME account/amount/type mix
+    as the protobuf payloads, encoded as compact columns."""
+    from igaming_platform_tpu.serve.wire import encode_index_batch
+
+    rng = np.random.default_rng(7)
+    tx_types = ("deposit", "bet", "withdraw")
+    payloads = []
+    for v in range(n_variants):
+        payloads.append(encode_index_batch(
+            [f"lg-{int(rng.integers(0, n_accounts))}" for _ in range(rows_per_rpc)],
+            [int(rng.integers(100, 100_000)) for _ in range(rows_per_rpc)],
+            [tx_types[int(rng.integers(0, 3))] for _ in range(rows_per_rpc)],
+            ips=[f"10.{v}.{i % 200}.{i % 251}" for i in range(rows_per_rpc)],
+            devices=[f"dev-{int(rng.integers(0, 64))}" for i in range(rows_per_rpc)],
+        ))
+    return payloads
+
+
 def _seed_store(engine, n_accounts: int = 512, events_per_acct: int = 6) -> None:
     """Give the feature store history so gathers do real work."""
     from igaming_platform_tpu.serve.feature_store import TransactionEvent
@@ -78,14 +105,20 @@ def run_grpc_load(
     rows_per_rpc: int = 4096,
     concurrency: int = 4,
     warmup_rpcs: int = 3,
+    wire_mode: str = "row",
 ) -> dict:
     """Drive ScoreBatch at ``addr`` from ``concurrency`` client threads for
-    ``duration_s``; returns sustained txns/s + RPC latency percentiles."""
-    payloads = _build_request_payloads(rows_per_rpc)
+    ``duration_s``; returns sustained txns/s + RPC latency percentiles.
+    ``wire_mode='index'`` ships index-mode frames (HBM feature cache)."""
+    if wire_mode == "index":
+        payloads = _build_index_payloads(rows_per_rpc)
+    else:
+        payloads = _build_request_payloads(rows_per_rpc)
 
     stop_at = [0.0]
     results: list[list[tuple[float, float]]] = [[] for _ in range(concurrency)]
     errors = [0]
+    shed = [0]
 
     def worker(k: int) -> None:
         # Own channel per worker: one HTTP/2 connection each, so the test
@@ -116,11 +149,22 @@ def run_grpc_load(
             t0 = time.perf_counter()
             try:
                 call(payloads[i % len(payloads)], timeout=60)
-            except grpc.RpcError:
-                # Failed RPCs scored nothing — they must not count toward
-                # throughput or latency, or a failing server inflates the
-                # headline exactly when it shouldn't.
-                errors[0] += 1
+            except grpc.RpcError as exc:
+                # Shed vs failure must not conflate (the soak harness's
+                # discipline, benchmarks/soak.py): RESOURCE_EXHAUSTED is
+                # the admission gate's LOUD backpressure — the bulk
+                # caller's contract is retry-with-backoff — while any
+                # other status is a real serving failure. Folding sheds
+                # into `errors` made headline artifacts report a healthy
+                # gate as a sick server (VERDICT r05 Weak #2).
+                if exc.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    shed[0] += 1
+                    time.sleep(0.02 * (1 + (i % 4)))
+                else:
+                    # Failed RPCs scored nothing — they must not count
+                    # toward throughput or latency, or a failing server
+                    # inflates the headline exactly when it shouldn't.
+                    errors[0] += 1
             else:
                 t1 = time.perf_counter()
                 results[k].append((t1, (t1 - t0) * 1000.0))
@@ -146,11 +190,13 @@ def run_grpc_load(
         "metric": "e2e_grpc_fraud_score_txns_per_sec",
         "value": round(txns / duration_s, 1),
         "unit": "txns/s",
+        "wire_mode": wire_mode,
         "rows_per_rpc": rows_per_rpc,
         "concurrency": concurrency,
         "duration_s": duration_s,
         "rpcs": n_rpcs,
         "errors": errors[0],
+        "bulk_shed": shed[0],
         "rpc_p50_ms": round(float(np.percentile(lat, 50)), 3) if n_rpcs else None,
         "rpc_p99_ms": round(float(np.percentile(lat, 99)), 3) if n_rpcs else None,
         "wall_s": round(wall, 3),
@@ -219,7 +265,17 @@ def start_inprocess_server(
 
 
 def main() -> None:
-    addr = sys.argv[1] if len(sys.argv) > 1 else None
+    wire_mode = os.environ.get("LOAD_WIRE_MODE", "row")
+    addr = None
+    for arg in sys.argv[1:]:
+        if arg.startswith("--wire-mode="):
+            wire_mode = arg.split("=", 1)[1]
+        elif arg == "--wire-mode":
+            raise SystemExit("use --wire-mode=row|index")
+        else:
+            addr = arg
+    if wire_mode not in ("row", "index"):
+        raise SystemExit(f"unknown wire mode {wire_mode!r} (row|index)")
     shutdown = None
     if addr is None:
         addr, shutdown = start_inprocess_server(
@@ -231,6 +287,7 @@ def main() -> None:
             duration_s=float(os.environ.get("LOAD_DURATION_S", 8.0)),
             rows_per_rpc=int(os.environ.get("LOAD_ROWS_PER_RPC", 4096)),
             concurrency=int(os.environ.get("LOAD_CONCURRENCY", 4)),
+            wire_mode=wire_mode,
         )
         print(json.dumps(load), flush=True)
         probe = run_single_txn_probe(addr)

@@ -1,8 +1,11 @@
 """Run every BASELINE config and print one JSON line per result.
 
 Usage: python benchmarks/run_all.py [config ...]
-Configs: grpc_e2e single_txn replay sequence ltv train wallet
-wallet_wire (default: all).
+Configs: grpc_e2e grpc_e2e_index single_txn replay sequence ltv train
+wallet wallet_wire wallet_pg (default: all). grpc_e2e_index is the
+device-resident feature-cache arm (index-mode wire frames, HBM table —
+serve/device_cache.py); its artifact line carries the same schema plus
+`wire_mode`, and both e2e lines separate `bulk_shed` from `errors`.
 
 Each config runs in its OWN subprocess when several are requested: the
 serving configs leave device queues / batcher threads / allocator state

@@ -1,0 +1,39 @@
+"""Version compatibility shims for the pinned container toolchain.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+``jax`` top level (and its ``check_rep`` kwarg was renamed
+``check_vma``) after the jax version this image bakes in. Call sites
+import from here so the same code runs on both sides of the move.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.5: top-level export, check_vma kwarg
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+
+    _NATIVE_VMA = True
+except ImportError:  # jax 0.4.x: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _NATIVE_VMA = False
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool | None = None):
+    """``jax.shard_map`` with the modern keyword surface on any jax."""
+    kwargs = {}
+    if check_vma is not None:
+        kwargs["check_vma" if _NATIVE_VMA else "check_rep"] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+
+
+def axis_size(name) -> int:
+    """Static mesh-axis size inside a shard_map body, on any jax
+    (``lax.axis_size`` post-move; ``jax.core.axis_frame`` — which returns
+    the bound size directly — before it)."""
+    from jax import lax as _lax
+
+    if hasattr(_lax, "axis_size"):
+        return _lax.axis_size(name)
+    import jax.core as _core
+
+    return int(_core.axis_frame(name))

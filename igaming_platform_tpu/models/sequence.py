@@ -28,9 +28,10 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from igaming_platform_tpu.core.compat import axis_size as _axis_size, shard_map
 from igaming_platform_tpu.parallel.mesh import AXIS_DATA, AXIS_SEQ
 
 Params = dict[str, Any]
@@ -143,7 +144,7 @@ def _ring_attention_local(q, k, v):
     softmax normaliser accumulates online (flash-attention style), so no
     [S, S] matrix and no full-sequence KV ever exist on one device.
     """
-    n = lax.axis_size(AXIS_SEQ)
+    n = _axis_size(AXIS_SEQ)
     scale = 1.0 / math.sqrt(q.shape[-1])
     b, h, s_loc, dh = q.shape
 

@@ -211,6 +211,36 @@ class ServiceMetrics:
             "control (BULK_MAX_INFLIGHT) so the single-txn fast lane keeps "
             "its latency SLO under overload",
         )
+        self.bulk_gate_limit = self.registry.gauge(
+            f"{service}_bulk_gate_limit",
+            "Current bulk-admission in-flight limit (p99-feedback controller "
+            "tightens it below BULK_MAX_INFLIGHT when single-txn latency "
+            "breaches BULK_P99_SLO_MS)",
+        )
+        # Device-resident HBM feature cache (serve/device_cache.py): the
+        # index-mode wire ships int32 slot indices instead of feature rows;
+        # these series are the cache's health dashboard.
+        self.feature_cache_hits_total = self.registry.counter(
+            f"{service}_feature_cache_hits_total",
+            "ScoreBatch rows served from the device-resident feature table",
+        )
+        self.feature_cache_misses_total = self.registry.counter(
+            f"{service}_feature_cache_misses_total",
+            "Rows host-gathered and promoted into the device table (cold "
+            "account or capacity miss)",
+        )
+        self.feature_cache_evictions_total = self.registry.counter(
+            f"{service}_feature_cache_evictions_total",
+            "Resident rows reclaimed by the CLOCK hand to admit new accounts",
+        )
+        self.feature_cache_deltas_total = self.registry.counter(
+            f"{service}_feature_cache_deltas_total",
+            "Per-account delta rows folded into HBM by the jitted scatter",
+        )
+        self.feature_cache_occupancy = self.registry.gauge(
+            f"{service}_feature_cache_occupancy",
+            "Device feature-table slots currently resident",
+        )
         # Business-level series backing the Grafana dashboards the reference
         # README promises (README.md:196-202) but ships no data for: per-type
         # transaction flow (bonus conversion = bonus_grant rate vs deposit

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from igaming_platform_tpu.core.compat import shard_map
 from igaming_platform_tpu.parallel.mesh import AXIS_EXPERT
 
 
@@ -155,7 +156,7 @@ def routed_ensemble_forward(
         dropped = jax.lax.psum(jnp.sum(~kept), stat_axes)
         return prob, load, dropped
 
-    shard = jax.shard_map(
+    shard = shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(P(), P(), P(shard_rows_over, None)),

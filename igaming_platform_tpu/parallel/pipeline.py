@@ -23,9 +23,10 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from igaming_platform_tpu.core.compat import shard_map
 from igaming_platform_tpu.parallel.mesh import AXIS_MODEL
 
 

@@ -83,6 +83,15 @@ class InMemoryFeatureStore:
         self._lock = threading.RLock()
         self._hll_precision = hll_precision
         self._blacklists: dict[str, set[str]] = {"device": set(), "ip": set(), "fingerprint": set()}
+        # Delta hook for the device-resident feature cache: called with the
+        # account id after EVERY write so the cache can enqueue a compact
+        # per-account delta (serve/device_cache.py note_update). Must be
+        # cheap and non-throwing — it runs on the write-back hot path.
+        self.delta_listener = None
+
+    def _emit_delta(self, account_id: str) -> None:
+        if self.delta_listener is not None:
+            self.delta_listener(account_id)
 
     def _state(self, account_id: str, now: float) -> _AccountState:
         st = self._accounts.get(account_id)
@@ -146,6 +155,7 @@ class InMemoryFeatureStore:
             elif event.tx_type == "win":
                 st.total_wins += event.amount
                 st.win_count += 1
+        self._emit_delta(event.account_id)
 
     def load_batch_features(
         self, account_id: str, *,
@@ -174,6 +184,7 @@ class InMemoryFeatureStore:
                 st.bonus_claim_count = bonus_claim_count
             if created_at is not None:
                 st.created_at = created_at
+        self._emit_delta(account_id)
 
     def record_bonus_claim(self, account_id: str, wager_complete_rate: float | None = None) -> None:
         with self._lock:
@@ -181,6 +192,7 @@ class InMemoryFeatureStore:
             st.bonus_claim_count += 1
             if wager_complete_rate is not None:
                 st.bonus_wager_complete = wager_complete_rate
+        self._emit_delta(account_id)
 
     # -- reads --------------------------------------------------------------
 

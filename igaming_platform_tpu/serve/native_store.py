@@ -176,6 +176,12 @@ class NativeFeatureStore:
         # sets (fs_blacklist_add) are the ones the wire decoder consults.
         self._blacklists: dict[str, set[str]] = {"device": set(), "ip": set(), "fingerprint": set()}
         self._bl_codes = {"device": 0, "ip": 1, "fingerprint": 2}
+        # Device-cache delta hook (see InMemoryFeatureStore.delta_listener).
+        self.delta_listener = None
+
+    def _emit_delta(self, account_id: str) -> None:
+        if self.delta_listener is not None:
+            self.delta_listener(account_id)
 
     def __del__(self):
         handle = getattr(self, "_handle", None)
@@ -211,6 +217,7 @@ class NativeFeatureStore:
             _TX_TYPE_CODES.get(event.tx_type, 4),
             _hash64(event.device_id), _hash64(event.ip),
         )
+        self._emit_delta(event.account_id)
 
     def update_batch(self, events) -> None:
         """Batched ingest: one native call for a whole event chunk."""
@@ -232,6 +239,9 @@ class NativeFeatureStore:
             dev[i] = _hash64(e.device_id)
             ips[i] = _hash64(e.ip)
         self._lib.fs_update_batch(self._handle, n, idxs, ts, amounts, types, dev, ips)
+        if self.delta_listener is not None:
+            for e in events:
+                self._emit_delta(e.account_id)
 
     def load_batch_features(
         self, account_id: str, *,
@@ -250,12 +260,14 @@ class NativeFeatureStore:
             -1 if bonus_claim_count is None else bonus_claim_count,
             -1.0 if created_at is None else created_at,
         )
+        self._emit_delta(account_id)
 
     def record_bonus_claim(self, account_id: str, wager_complete_rate: float | None = None) -> None:
         idx = self._idx(account_id)
         if idx >= 0:
             rate = -1.0 if wager_complete_rate is None else float(wager_complete_rate)
             self._lib.fs_record_bonus(self._handle, idx, rate)
+            self._emit_delta(account_id)
 
     # -- reads --------------------------------------------------------------
 
@@ -369,6 +381,9 @@ class NativeFeatureStore:
         dev = np.fromiter((_hash64(d) for d in devices), np.uint64, n)
         ip = np.fromiter((_hash64(i) for i in ips), np.uint64, n)
         self._lib.fs_update_batch(self._handle, n, idxs, ts, amts, types, dev, ip)
+        if self.delta_listener is not None:
+            for a in account_ids:
+                self._emit_delta(a)
 
     def num_accounts(self) -> int:
         return int(self._lib.fs_num_accounts(self._handle))

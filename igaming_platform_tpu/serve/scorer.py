@@ -29,7 +29,7 @@ import numpy as np
 
 from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
 from igaming_platform_tpu.core.enums import ReasonCode, action_from_code, decode_reason_mask
-from igaming_platform_tpu.core.features import NUM_FEATURES, FeatureVector
+from igaming_platform_tpu.core.features import F, NUM_FEATURES, FeatureVector
 from igaming_platform_tpu.models.ensemble import make_score_fn
 from igaming_platform_tpu.obs.tracing import annotate, span
 from igaming_platform_tpu.parallel.mesh import AXIS_DATA, validate_batch_for_mesh
@@ -122,6 +122,7 @@ class TPUScoringEngine:
         batcher_config: BatcherConfig | None = None,
         feature_store: InMemoryFeatureStore | None = None,
         warmup: bool = True,
+        feature_cache: bool | int | None = None,
     ):
         self.config = config or ScoringConfig()
         self.ml_backend = ml_backend
@@ -204,6 +205,10 @@ class TPUScoringEngine:
         # serves raw float32 — it must compile the UNWRAPPED graph (the
         # int8-wrapped one would dequantize raw f32 features to inf).
         packed_fn_host = _pack_outputs(fn_f32)
+        # Kept unjitted for the device-cache path (ensure_cache): the
+        # cached step gathers f32 rows already resident in HBM, so it
+        # always wraps the raw-f32 graph regardless of WIRE_DTYPE.
+        self._packed_fn_f32 = packed_fn_host
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -281,6 +286,30 @@ class TPUScoringEngine:
                 self._thresholds_host = jax.device_put(self._thresholds, cpu)
                 self._host_cpu = cpu
 
+        # Device-resident HBM feature cache (serve/device_cache.py): built
+        # lazily on the first index-mode request (ensure_cache), or
+        # eagerly when `feature_cache` / FEATURE_CACHE asks for it — lazy
+        # keeps the extra jit compile off engines that never serve index
+        # traffic. `wire_mode=index` (WIRE_MODE env) additionally routes
+        # the columnar score_batch_wire path through the cached step.
+        self.cache = None
+        self._cached_fn = None
+        self._cache_supported = True
+        self._cache_metrics_sink = None
+        self._cache_lock = threading.Lock()
+        if feature_cache is None:
+            feature_cache = os.environ.get("FEATURE_CACHE", "") not in ("", "0")
+        self._cache_capacity = (
+            feature_cache if isinstance(feature_cache, int)
+            and not isinstance(feature_cache, bool)
+            else int(os.environ.get("FEATURE_CACHE_CAPACITY", "65536"))
+        )
+        self._cache_eager = bool(feature_cache)
+        self.wire_mode = os.environ.get("WIRE_MODE", "row").lower()
+        if self.wire_mode not in ("row", "index"):
+            raise ValueError(
+                f"WIRE_MODE={self.wire_mode!r} not supported (use 'row' or 'index')")
+
         self._batcher = ContinuousBatcher(
             cfg=batcher_config,
             dispatch=self._dispatch_requests,
@@ -310,6 +339,8 @@ class TPUScoringEngine:
                 jax.device_get(
                     self._fn_host(self._params_host, x32, bl, self._thresholds_host)
                 )
+        if self._cache_eager or self.wire_mode == "index":
+            self.ensure_cache()
 
     def close(self) -> None:
         self._batcher.stop()
@@ -358,6 +389,217 @@ class TPUScoringEngine:
     def update_features(self, event: TransactionEvent) -> None:
         """Post-transaction write-back (engine.go:486-488)."""
         self.features.update(event)
+
+    # -- device-resident feature cache (serve/device_cache.py) ---------------
+
+    def bind_cache_metrics(self, metrics) -> None:
+        """Route cache hit/miss/evict/occupancy counters into a
+        ServiceMetrics registry (called by the gRPC layer); applied to the
+        cache now if built, or at ensure_cache() time otherwise."""
+        self._cache_metrics_sink = metrics
+        if self.cache is not None:
+            self.cache.bind_metrics(metrics)
+
+    def ensure_cache(self):
+        """Build (once) the HBM feature table + the jitted cached score
+        step, and AOT-warm every ladder shape — called lazily on the
+        first index-mode request or eagerly from warmup()."""
+        if self.cache is not None:
+            return self.cache
+        if not self._cache_supported:
+            raise RuntimeError(
+                "device feature cache unsupported on this engine "
+                "(multihost front: the table cannot ride the work channel)")
+        with self._cache_lock:
+            if self.cache is not None:
+                return self.cache
+            from igaming_platform_tpu.serve.device_cache import DeviceFeatureCache
+
+            max_age = os.environ.get("FEATURE_CACHE_MAX_AGE_S")
+            cache = DeviceFeatureCache(
+                self.features,
+                capacity=self._cache_capacity,
+                mesh=self._mesh,
+                max_age_s=float(max_age) if max_age else None,
+                metrics=self._cache_metrics_sink,
+            )
+            # The store's write-back hook: every feature update enqueues a
+            # compact per-account delta the next lookup folds into HBM.
+            if hasattr(self.features, "delta_listener"):
+                self.features.delta_listener = cache.note_update
+
+            packed = self._packed_fn_f32
+            txa, td, tw, tb = (
+                int(F.TX_AMOUNT), int(F.TX_TYPE_DEPOSIT),
+                int(F.TX_TYPE_WITHDRAW), int(F.TX_TYPE_BET),
+            )
+
+            def cached_step(params, table, flags, idxs, amounts, types, bl, thr):
+                x = table[idxs]
+                f32 = x.dtype
+                x = x.at[:, txa].set(amounts)
+                x = x.at[:, td].set((types == 0).astype(f32))
+                x = x.at[:, tw].set((types == 1).astype(f32))
+                x = x.at[:, tb].set((types == 2).astype(f32))
+                return packed(params, x, jnp.logical_or(bl, flags[idxs]), thr)
+
+            if self._mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                repl = NamedSharding(self._mesh, P())
+                vec = NamedSharding(self._mesh, P(AXIS_DATA))
+                self._cached_fn = jax.jit(
+                    cached_step,
+                    in_shardings=(None, repl, repl, vec, vec, vec, vec, repl),
+                    out_shardings=NamedSharding(self._mesh, P(None, AXIS_DATA)),
+                )
+            else:
+                self._cached_fn = jax.jit(cached_step)
+            # AOT-warm every ladder shape before the first live index RPC.
+            for shape in self._shapes:
+                idxs = np.zeros((shape,), dtype=np.int32)
+                amounts = np.zeros((shape,), dtype=np.float32)
+                types = np.full((shape,), 4, dtype=np.int32)
+                bl = np.zeros((shape,), dtype=bool)
+                with self._params_lock:
+                    params = self._params
+                out = self._cached_fn(
+                    params, cache.table, cache.flags, idxs, amounts, types,
+                    bl, self._thresholds)
+                jax.device_get(out)
+            self.cache = cache
+        return cache
+
+    def _launch_cached(self, idxs: np.ndarray, amounts: np.ndarray,
+                       types: np.ndarray, bl: np.ndarray):
+        """Dispatch the cached score step: the device gathers rows from
+        the HBM-resident table; only int32 indices + per-txn context
+        cross the link. Pad rows index slot 0 — scored and discarded,
+        same as zero-row padding on the full-row path."""
+        n = idxs.shape[0]
+        shape = self._pick_shape(n)
+        idxsp, _ = pad_batch(idxs, shape)
+        amtp, _ = pad_batch(amounts, shape)
+        typp, _ = pad_batch(types, shape)
+        blp, _ = pad_batch(bl, shape)
+        with self._params_lock:
+            params = self._params
+        out = self._cached_fn(
+            params, self.cache.table, self.cache.flags,
+            idxsp, amtp, typp, blp, self._thresholds)
+        if hasattr(out, "copy_to_host_async"):
+            out.copy_to_host_async()
+        return out, n
+
+    def _blacklist_flags(self, n: int, ips, devices, fingerprints) -> np.ndarray:
+        """Per-request blacklist vector from the host sets — the cheap
+        half of the gather the cached path keeps on the host."""
+        bl = np.zeros((n,), dtype=bool)
+        lists = getattr(self.features, "_blacklists", None)
+        if lists is None or not any(lists.values()):
+            return bl
+        dev_bl, ip_bl, fp_bl = lists["device"], lists["ip"], lists["fingerprint"]
+
+        def _s(v):
+            return v.decode() if isinstance(v, (bytes, memoryview)) else v
+
+        for i in range(n):
+            d = _s(devices[i]) if devices is not None else ""
+            p = _s(ips[i]) if ips is not None else ""
+            f = _s(fingerprints[i]) if fingerprints is not None else ""
+            bl[i] = (
+                (bool(d) and d in dev_bl)
+                or (bool(f) and f in fp_bl)
+                or (bool(p) and p in ip_bl)
+            )
+        return bl
+
+    def _indexed_outputs(self, account_ids, amounts, types, bl,
+                         start: float, now: float | None = None):
+        """Pipelined chunked scoring through the cached step -> (result
+        dict, per-row response times). Each chunk's lookup folds pending
+        deltas into HBM between device steps."""
+        from collections import deque
+
+        total = len(account_ids)
+        amounts32 = np.ascontiguousarray(amounts, dtype=np.float32)
+        types32 = np.ascontiguousarray(types, dtype=np.int32)
+        keys = ("score", "action", "reason_mask", "rule_score", "ml_score")
+        parts: dict[str, list[np.ndarray]] = {k: [] for k in keys}
+        rtms = np.empty((total,), dtype=np.int64)
+        inflight: deque = deque()
+
+        def read_one() -> None:
+            out, lo, n = inflight.popleft()
+            with span("score.readback", batch=n):
+                host = _unpack_host(jax.device_get(out))
+            for k in keys:
+                parts[k].append(host[k][:n])
+            rtms[lo:lo + n] = int((time.monotonic() - start) * 1000.0)
+
+        for lo in range(0, total, self.batch_size):
+            hi = min(lo + self.batch_size, total)
+            with span("score.cache_lookup", batch=hi - lo):
+                idxs = self.cache.lookup(account_ids[lo:hi], now=now)
+            with span("score.dispatch", batch=hi - lo), annotate("score_step"):
+                out, n = self._launch_cached(
+                    idxs, amounts32[lo:hi], types32[lo:hi], bl[lo:hi])
+            inflight.append((out, lo, n))
+            if len(inflight) > self._pipeline_depth:
+                read_one()
+        while inflight:
+            read_one()
+
+        cat = {k: np.concatenate(v) if len(v) > 1 else v[0] for k, v in parts.items()}
+        if self.score_observer is not None:
+            try:
+                self.score_observer(cat["score"])
+            except Exception:  # noqa: BLE001 — metrics must not fail scoring
+                pass
+        return cat, rtms
+
+    def score_columns_cached(
+        self, account_ids, amounts, tx_types,
+        ips=None, devices=None, fingerprints=None, now: float | None = None,
+    ) -> dict:
+        """Columnar scoring through the device-resident table; returns the
+        canonical result dict (score/action/reason_mask/rule_score/
+        ml_score as host arrays). Bit-identical to the host-gather path
+        for the same `now` — pinned by tests/test_device_cache.py."""
+        self.ensure_cache()
+        from igaming_platform_tpu.serve.wire import TX_TYPE_CODES
+
+        n = len(account_ids)
+        types = [TX_TYPE_CODES.get(t, 4) for t in tx_types]
+        bl = self._blacklist_flags(n, ips, devices, fingerprints)
+        cat, _ = self._indexed_outputs(
+            list(account_ids), amounts, types, bl, time.monotonic(), now=now)
+        return cat
+
+    def score_batch_wire_index(self, payload: bytes) -> tuple[bytes, int]:
+        """Index-mode ScoreBatch frame bytes -> risk.v1 ScoreBatchResponse
+        wire bytes. The steady-state hot path ships only indices + deltas
+        to the device; the feature echo is omitted (rows never exist on
+        the host). Raises ValueError on a malformed frame, RuntimeError
+        when the native response encoder is unavailable."""
+        from igaming_platform_tpu.serve.wire import (
+            decode_index_batch,
+            encode_score_batch,
+        )
+
+        start = time.monotonic()
+        ids, amounts, codes, ips, devices, fingerprints = decode_index_batch(payload)
+        if len(ids) == 0:
+            return b"", 0
+        self.ensure_cache()
+        with span("score.blacklist", batch=len(ids)):
+            bl = self._blacklist_flags(len(ids), ips, devices, fingerprints)
+        cat, rtms = self._indexed_outputs(ids, amounts, codes, bl, start)
+        payload_out = encode_score_batch(
+            cat["score"], cat["action"], cat["reason_mask"], cat["rule_score"],
+            cat["ml_score"], rtms, None,
+        )
+        return payload_out, len(ids)
 
     # -- internals -----------------------------------------------------------
 
@@ -478,6 +720,25 @@ class TPUScoringEngine:
         """
         start = time.monotonic()
         total = len(account_ids)
+        if self.wire_mode == "index":
+            # Server-side index mode (WIRE_MODE=index): the same columnar
+            # request rides the HBM-resident table — no [N, 30] feature
+            # matrix is gathered or shipped. The feature echo is omitted
+            # (the rows never exist on the host).
+            from igaming_platform_tpu.serve.wire import (
+                TX_TYPE_CODES,
+                encode_score_batch,
+            )
+
+            self.ensure_cache()
+            types = [TX_TYPE_CODES.get(t, 4) for t in tx_types]
+            bl = self._blacklist_flags(total, ips, devices, fingerprints)
+            cat, rtms = self._indexed_outputs(
+                list(account_ids), amounts, types, bl, start)
+            return encode_score_batch(
+                cat["score"], cat["action"], cat["reason_mask"],
+                cat["rule_score"], cat["ml_score"], rtms, None,
+            )
         with span("score.gather", batch=total):
             if hasattr(self.features, "gather_columns"):
                 x, bl = self.features.gather_columns(

@@ -1,0 +1,276 @@
+"""Device-resident HBM feature cache (serve/device_cache.py, ISSUE 1).
+
+The load-bearing property is BIT-EXACTNESS: a cached index-mode gather
+(device table + per-txn context scatter) must produce byte-identical
+results to the host-gather path on the same traffic with the same
+``now`` — that is what makes the cache safe to enable by default. On
+top of that: slot assignment / CLOCK eviction, compact delta apply on
+feature updates, miss-path promotion, the sticky flags column, metrics
+export, and gather parity on a multi-device sharded mesh (batch sharded
+along ``data``, table replicated — the virtual 8-CPU-device mesh of
+conftest.py).
+"""
+
+import numpy as np
+import pytest
+
+from igaming_platform_tpu.core.config import BatcherConfig
+from igaming_platform_tpu.serve.device_cache import DeviceFeatureCache
+from igaming_platform_tpu.serve.feature_store import InMemoryFeatureStore, TransactionEvent
+from igaming_platform_tpu.serve.scorer import TPUScoringEngine, _unpack_host
+
+T0 = 1_700_000_000.0
+
+
+def _seed(store, n_accounts=24, base_ts=T0):
+    for a in range(n_accounts):
+        for k, age in enumerate((30.0, 90.0, 400.0, 4000.0)):
+            store.update(TransactionEvent(
+                account_id=f"acct-{a}", amount=900 + 37 * a + 11 * k,
+                tx_type=("deposit", "bet", "win")[k % 3],
+                ip=f"10.7.{a}.{k}", device_id=f"dev-{a % 8}",
+                timestamp=base_ts - age,
+            ))
+
+
+def _host_outputs(engine, store, ids, amounts, tx_types, now):
+    """Reference path: host gather_batch -> the engine's stock device
+    step, chunked exactly like the cached path."""
+    import jax
+
+    class _R:
+        __slots__ = ("account_id", "amount", "tx_type", "device_id",
+                     "fingerprint", "ip", "ip_flags")
+
+        def __init__(self, a, amt, t):
+            self.account_id, self.amount, self.tx_type = a, amt, t
+            self.device_id = self.fingerprint = self.ip = ""
+            self.ip_flags = None
+
+    x, bl = store.gather_batch(
+        [_R(ids[i], amounts[i], tx_types[i]) for i in range(len(ids))], now=now)
+    keys = ("score", "action", "reason_mask", "rule_score", "ml_score")
+    parts = {k: [] for k in keys}
+    for lo in range(0, len(ids), engine.batch_size):
+        out, n = engine._launch_device(x[lo:lo + engine.batch_size],
+                                       bl[lo:lo + engine.batch_size])
+        host = _unpack_host(jax.device_get(out))
+        for k in keys:
+            parts[k].append(host[k][:n])
+    return {k: np.concatenate(v) for k, v in parts.items()}
+
+
+def _assert_bit_identical(cached, host):
+    for k in ("score", "action", "reason_mask", "rule_score"):
+        np.testing.assert_array_equal(cached[k], host[k], err_msg=k)
+    # ml_score compared as raw IEEE bits: bit-identical, not just close.
+    np.testing.assert_array_equal(
+        cached["ml_score"].view(np.int32), host["ml_score"].view(np.int32),
+        err_msg="ml_score bits")
+
+
+# -- slot management ---------------------------------------------------------
+
+
+def test_slot_assignment_and_hit_tracking():
+    store = InMemoryFeatureStore()
+    _seed(store, 8)
+    cache = DeviceFeatureCache(store, capacity=16)
+    ids = [f"acct-{i}" for i in range(8)]
+    idxs = cache.lookup(ids, now=T0)
+    assert len(set(idxs.tolist())) == 8, "distinct slots per account"
+    s = cache.stats()
+    assert s["misses"] == 8 and s["hits"] == 0 and s["occupancy"] == 8
+
+    idxs2 = cache.lookup(ids, now=T0)
+    np.testing.assert_array_equal(idxs, idxs2)  # stable slots on hits
+    s = cache.stats()
+    assert s["hits"] == 8 and s["misses"] == 8
+    assert s["evictions"] == 0
+
+
+def test_clock_eviction_reclaims_slots():
+    store = InMemoryFeatureStore()
+    _seed(store, 12)
+    cache = DeviceFeatureCache(store, capacity=4)
+    cache.lookup([f"acct-{i}" for i in range(4)], now=T0)
+    assert cache.stats()["occupancy"] == 4
+    # 4 new accounts into a full table: every admission evicts.
+    cache.lookup([f"acct-{i}" for i in range(4, 8)], now=T0)
+    s = cache.stats()
+    assert s["evictions"] == 4
+    assert s["occupancy"] == 4  # never exceeds capacity
+    for a in range(4):
+        assert not cache.contains(f"acct-{a}")
+    # The evicted account is re-admitted as a fresh miss with a row
+    # gathered NOW — not a stale resurrection.
+    idxs = cache.lookup(["acct-0"], now=T0)
+    assert cache.contains("acct-0")
+    assert 0 <= int(idxs[0]) < 4
+
+
+def test_dirty_delta_reapplied_on_next_lookup():
+    store = InMemoryFeatureStore()
+    _seed(store, 4)
+    cache = DeviceFeatureCache(store, capacity=8)
+    cache.lookup(["acct-1"], now=T0)
+    deltas0 = cache.stats()["deltas_applied"]
+    # A write-back marks the resident row dirty; an uncached account not.
+    cache.note_update("acct-1")
+    cache.note_update("acct-never-cached")
+    cache.lookup(["acct-1"], now=T0)
+    s = cache.stats()
+    assert s["deltas_applied"] == deltas0 + 1
+    assert s["hits"] == 1  # dirty refresh is not a miss
+
+
+# -- bit-exact scoring parity ------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def engine():
+    store = InMemoryFeatureStore()
+    _seed(store)
+    eng = TPUScoringEngine(
+        batcher_config=BatcherConfig(batch_size=32, max_wait_ms=1.0),
+        feature_store=store,
+    )
+    yield eng
+    eng.close()
+
+
+def test_cached_scoring_bit_identical_to_host_gather(engine):
+    """The acceptance bar: replayed traffic through the cached index
+    path == the host-gather path, bit for bit (same ``now``)."""
+    n = 48  # 1.5x the compiled shape: chunking + padding on both paths
+    ids = [f"acct-{i % 24}" for i in range(n)]
+    amounts = [500 + 13 * i for i in range(n)]
+    tx_types = [("deposit", "bet", "withdraw")[i % 3] for i in range(n)]
+
+    cached = engine.score_columns_cached(ids, amounts, tx_types, now=T0)
+    host = _host_outputs(engine, engine.features, ids, amounts, tx_types, T0)
+    _assert_bit_identical(cached, host)
+
+
+def test_delta_apply_matches_recomputed_host_features(engine):
+    """Feature updates between scoring steps: the async delta path must
+    land the EXACT recomputed rows (not approximations) before the next
+    step reads them."""
+    ids = [f"acct-{i % 24}" for i in range(24)]
+    amounts = [1000 + i for i in range(24)]
+    tx_types = ["deposit"] * 24
+    engine.score_columns_cached(ids, amounts, tx_types, now=T0)
+
+    # Write-backs change velocity windows, sums and session state.
+    for a in (1, 5, 9):
+        engine.update_features(TransactionEvent(
+            account_id=f"acct-{a}", amount=77_000, tx_type="deposit",
+            ip="9.9.9.9", device_id="dev-new", timestamp=T0 - 2.0))
+
+    t1 = T0 + 1.0
+    cached = engine.score_columns_cached(ids, amounts, tx_types, now=t1)
+    host = _host_outputs(engine, engine.features, ids, amounts, tx_types, t1)
+    _assert_bit_identical(cached, host)
+
+
+def test_miss_path_promotion(engine):
+    """Never-seen accounts score correctly on first touch (host gather +
+    promote) and hit the table on the second."""
+    ids = [f"fresh-{i}" for i in range(6)]
+    amounts = [250] * 6
+    tx_types = ["bet"] * 6
+    before = engine.cache.stats()
+    cached = engine.score_columns_cached(ids, amounts, tx_types, now=T0)
+    host = _host_outputs(engine, engine.features, ids, amounts, tx_types, T0)
+    _assert_bit_identical(cached, host)
+    mid = engine.cache.stats()
+    assert mid["misses"] >= before["misses"] + 6
+    engine.score_columns_cached(ids, amounts, tx_types, now=T0)
+    after = engine.cache.stats()
+    assert after["misses"] == mid["misses"], "second touch must be all hits"
+    assert after["hits"] >= mid["hits"] + 6
+
+
+def test_flags_column_forces_blacklist_semantics(engine):
+    """The sticky per-account device flag ORs into the step's blacklist
+    input — same output as the host path given blacklisted=True."""
+    import jax
+
+    engine.cache.set_account_flag("acct-2", True)
+    cached = engine.score_columns_cached(
+        ["acct-2"], [1234], ["deposit"], now=T0)
+
+    x, _ = engine.features.gather_batch(
+        [type("R", (), dict(account_id="acct-2", amount=1234,
+                            tx_type="deposit", device_id="", fingerprint="",
+                            ip="", ip_flags=None))()], now=T0)
+    out, n = engine._launch_device(x, np.ones((1,), dtype=bool))
+    host = {k: v[:n] for k, v in _unpack_host(jax.device_get(out)).items()}
+    _assert_bit_identical(cached, host)
+    engine.cache.set_account_flag("acct-2", False)
+
+
+def test_cache_metrics_export():
+    from igaming_platform_tpu.obs.metrics import ServiceMetrics
+
+    store = InMemoryFeatureStore()
+    _seed(store, 4)
+    metrics = ServiceMetrics("risktest")
+    cache = DeviceFeatureCache(store, capacity=2, metrics=metrics)
+    cache.lookup(["acct-0", "acct-1"], now=T0)
+    cache.lookup(["acct-0", "acct-2"], now=T0)  # 1 hit, 1 miss+evict
+    assert metrics.feature_cache_misses_total.value() == 3
+    assert metrics.feature_cache_hits_total.value() == 1
+    assert metrics.feature_cache_evictions_total.value() == 1
+    assert metrics.feature_cache_occupancy.value() == 2
+    assert metrics.feature_cache_deltas_total.value() == 3
+    rendered = metrics.registry.render_text()
+    assert "risktest_feature_cache_hits_total 1" in rendered
+
+
+# -- multi-device sharded mesh ----------------------------------------------
+
+
+def test_sharded_table_gather_parity():
+    """On the virtual 8-device mesh the batch shards along ``data`` and
+    the table is replicated: cached scoring must equal the host-gather
+    path of the SAME mesh engine."""
+    from igaming_platform_tpu.parallel.mesh import MeshSpec, create_mesh
+
+    mesh = create_mesh(MeshSpec(data=8))
+    store = InMemoryFeatureStore()
+    _seed(store)
+    eng = TPUScoringEngine(
+        batcher_config=BatcherConfig(batch_size=32, max_wait_ms=1.0),
+        feature_store=store,
+        mesh=mesh,
+    )
+    try:
+        n = 40
+        ids = [f"acct-{i % 24}" for i in range(n)]
+        amounts = [321 + 7 * i for i in range(n)]
+        tx_types = [("deposit", "bet", "withdraw")[i % 3] for i in range(n)]
+        cached = eng.score_columns_cached(ids, amounts, tx_types, now=T0)
+        host = _host_outputs(eng, store, ids, amounts, tx_types, T0)
+        _assert_bit_identical(cached, host)
+        assert eng.cache.stats()["occupancy"] == 24
+    finally:
+        eng.close()
+
+
+def test_engine_update_features_emits_delta(engine):
+    """engine.update_features -> store write-back -> delta_listener ->
+    dirty row; the next cached score reflects the new state without an
+    explicit cache call anywhere."""
+    ids = ["acct-7"]
+    engine.score_columns_cached(ids, [100], ["bet"], now=T0)
+    s0 = engine.score_columns_cached(ids, [100], ["bet"], now=T0)["score"][0]
+    # Hammer the velocity windows hard enough to move the score.
+    for k in range(12):
+        engine.update_features(TransactionEvent(
+            account_id="acct-7", amount=90_000, tx_type="deposit",
+            timestamp=T0 - 0.5 - 0.01 * k))
+    s1 = engine.score_columns_cached(ids, [100], ["bet"], now=T0)["score"][0]
+    host = _host_outputs(engine, engine.features, ids, [100], ["bet"], T0)
+    assert s1 == host["score"][0]
+    assert s1 != s0, "write-backs must reach the device table"

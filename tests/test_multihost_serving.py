@@ -227,6 +227,133 @@ def test_two_process_full_server_parity(tmp_path):
     assert "FRONT_CLEAN_EXIT" in outs[0]
 
 
+# Minimal follower speaking the real work-channel protocol (handshake +
+# per-step ACK) WITHOUT a jax.distributed mesh: the channel-discipline
+# tests below exercise the front's dead/wedged-follower detection across
+# real OS processes and real sockets even on backends where multi-process
+# SPMD itself is unavailable (the CPU backend of this jax refuses
+# multi-process computations — the full-stack tests above cover it where
+# supported).
+_FOLLOWER_STUB = """
+import os, socket, sys, time
+sys.path.insert(0, os.environ["REPO_ROOT"])
+from igaming_platform_tpu.serve import multihost as mh
+
+port = int(os.environ["PORT"])
+mode = os.environ.get("MODE", "ack")
+listener = socket.socket()
+listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+listener.bind(("127.0.0.1", port))
+listener.listen(1)
+print("READY", flush=True)
+conn, _ = listener.accept()
+reader = mh._Reader(conn)
+magic, arrays = mh._recv_frame(reader)
+assert magic == mh.MAGIC_HELLO
+mh._send_frame(conn, mh.MAGIC_HELLO)
+n = 0
+while True:
+    magic, arrays = mh._recv_frame(reader)
+    if magic != mh.MAGIC_WORK:
+        break
+    n += 1
+    if mode == "wedge" and n > 3:
+        time.sleep(3600)  # wedged mid-step: never ACKs again
+    conn.sendall(mh.ACK_BYTE)
+"""
+
+
+def _start_follower_stub(tmp_path, port: int, mode: str = "ack"):
+    stub = tmp_path / "follower_stub.py"
+    stub.write_text(_FOLLOWER_STUB)
+    proc = subprocess.Popen(
+        [sys.executable, str(stub)],
+        env=dict(os.environ, REPO_ROOT=REPO, PORT=str(port), MODE=mode,
+                 JAX_PLATFORMS="cpu"),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    line = proc.stdout.readline()
+    assert "READY" in line, line
+    return proc
+
+
+def test_follower_death_degrades_loudly_not_wedged(tmp_path):
+    """Kill the follower under load: the next broadcast must raise a
+    typed MultihostChannelError within the io timeout — BEFORE the front
+    would enter the dead collective — and every later call must fail
+    fast (VERDICT r05 Missing #3)."""
+    from igaming_platform_tpu.serve.multihost import (
+        MultihostChannelError,
+        WorkChannel,
+    )
+
+    port = _free_port()
+    proc = _start_follower_stub(tmp_path, port)
+    chan = WorkChannel([port], io_timeout_s=5.0, ack_window=4)
+    try:
+        chan.broadcast_hello(np.zeros((32,), dtype=np.uint8))
+        xp = np.zeros((16, 30), np.float32)
+        blp = np.zeros((16,), bool)
+        thr = np.array([80, 60], np.int32)
+        for _ in range(5):  # steady load, ACKs flowing
+            chan.broadcast(xp, blp, thr)
+
+        proc.kill()
+        proc.wait(timeout=10)
+
+        t0 = time.monotonic()
+        with np.testing.assert_raises(MultihostChannelError):
+            # EOF lands with the next reap; allow a couple of broadcasts
+            # for the FIN to arrive, never a wedge.
+            for _ in range(10):
+                chan.broadcast(xp, blp, thr)
+                time.sleep(0.05)
+        assert time.monotonic() - t0 < 10.0, "detection must not wedge"
+
+        # Dead channel fails FAST from now on — no timeout, no retry.
+        t0 = time.monotonic()
+        try:
+            chan.broadcast(xp, blp, thr)
+            raise AssertionError("dead channel must keep failing")
+        except MultihostChannelError:
+            pass
+        assert time.monotonic() - t0 < 0.5
+    finally:
+        chan.close()
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_wedged_follower_ack_timeout(tmp_path):
+    """A follower that stays CONNECTED but stops completing steps (no
+    ACKs) must trip the ACK timeout once the un-ACKed window fills —
+    bounded detection instead of running unboundedly ahead of a wedged
+    mesh participant."""
+    from igaming_platform_tpu.serve.multihost import (
+        MultihostChannelError,
+        WorkChannel,
+    )
+
+    port = _free_port()
+    proc = _start_follower_stub(tmp_path, port, mode="wedge")
+    chan = WorkChannel([port], io_timeout_s=1.0, ack_window=2)
+    try:
+        chan.broadcast_hello(np.zeros((32,), dtype=np.uint8))
+        xp = np.zeros((16, 30), np.float32)
+        blp = np.zeros((16,), bool)
+        thr = np.array([80, 60], np.int32)
+        t0 = time.monotonic()
+        with np.testing.assert_raises(MultihostChannelError):
+            for _ in range(20):
+                chan.broadcast(xp, blp, thr)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 15.0, f"ACK timeout must bound detection, took {elapsed}"
+    finally:
+        chan.close()
+        if proc.poll() is None:
+            proc.kill()
+
+
 def test_model_mismatch_fails_handshake(tmp_path):
     """A follower that resolved DIFFERENT params (e.g. its checkpoint
     silently degraded to mock) must die loudly at the boot handshake —

@@ -2,7 +2,12 @@
 
 Checks the classes of slip that have actually bitten this codebase:
 syntax errors (compile), unused imports, duplicate imports, bare
-`except:`, `== None`/`!= None`, and mutable default arguments. AST-only,
+`except:`, `== None`/`!= None`, mutable default arguments, and
+`block_until_ready()` inside a timed region outside obs/perfmodel.py
+(the round-5 measurement-integrity rule: on the tunneled backend
+block_until_ready can return at dispatch-ACK and inflate step
+throughput ~30x — every step timing must go through
+obs/perfmodel.device_step_time's two-point readback fence). AST-only,
 stdlib-only, zero configuration; not a style tool.
 
 Deliberate side-effect imports (descriptor-pool registration, plugin
@@ -36,6 +41,66 @@ def _imported_names(node: ast.AST):
                 yield name, name, node.lineno
 
 
+_CLOCK_CALLS = {"perf_counter", "monotonic", "perf_counter_ns", "monotonic_ns"}
+
+
+def _call_name(node: ast.Call) -> str | None:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _scope_calls(body: list[ast.stmt]):
+    """Yield Call nodes in ``body`` WITHOUT descending into nested
+    function definitions (each function is its own timing scope)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def check_timed_block_until_ready(path: Path, tree: ast.AST,
+                                  noqa_lines: set[int]) -> list[str]:
+    """Flag `block_until_ready` calls bracketed by clock reads in the
+    same scope — i.e. sitting inside a timed region. Only
+    obs/perfmodel.py (the two-point readback fence) may time that way;
+    everywhere else the pattern silently measures dispatch-ACK on
+    tunneled backends."""
+    if path.name == "perfmodel.py" and path.parent.name == "obs":
+        return []
+    problems: list[str] = []
+    scopes: list[list[ast.stmt]] = [tree.body]
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scopes.append(node.body)
+    for body in scopes:
+        clock_lines: list[int] = []
+        bur_lines: list[int] = []
+        for call in _scope_calls(body):
+            name = _call_name(call)
+            if name in _CLOCK_CALLS:
+                clock_lines.append(call.lineno)
+            elif name == "block_until_ready":
+                bur_lines.append(call.lineno)
+        if not clock_lines or not bur_lines:
+            continue
+        lo, hi = min(clock_lines), max(clock_lines)
+        for line in bur_lines:
+            if lo < line < hi and line not in noqa_lines:
+                problems.append(
+                    f"{path}:{line}: block_until_ready() inside a timed "
+                    "region — it can return at dispatch-ACK on tunneled "
+                    "backends; use obs/perfmodel.device_step_time")
+    return problems
+
+
 def lint_file(path: Path) -> list[str]:
     src = path.read_text(encoding="utf-8")
     try:
@@ -47,7 +112,7 @@ def lint_file(path: Path) -> list[str]:
         if "# noqa" in line
     }
 
-    problems: list[str] = []
+    problems: list[str] = list(check_timed_block_until_ready(path, tree, noqa_lines))
     used: set[str] = set()
     for node in ast.walk(tree):
         if isinstance(node, ast.Name):
