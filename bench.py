@@ -133,18 +133,28 @@ def e2e_numbers() -> dict:
         start_inprocess_server,
     )
 
+    from igaming_platform_tpu.obs.flight import DEFAULT_RECORDER, stage_breakdown
+
     addr, shutdown = start_inprocess_server(
         batch_size=int(os.environ.get("BENCH_E2E_BATCH", 8192)),
     )
     try:
+        DEFAULT_RECORDER.clear()  # warm-up RPCs out of the breakdown window
         load = run_grpc_load(
             addr,
             duration_s=float(os.environ.get("BENCH_E2E_DURATION_S", 8.0)),
             rows_per_rpc=int(os.environ.get("BENCH_E2E_ROWS_PER_RPC", 8192)),
             concurrency=int(os.environ.get("BENCH_E2E_CONCURRENCY", 6)),
         )
+        # Per-stage latency decomposition from the flight recorder
+        # (obs/flight.py): where each ScoreBatch RPC's time went
+        # (admission/decode/gather/dispatch/readback/encode) and what
+        # share of the RPC span the stages account for.
+        breakdown = stage_breakdown(DEFAULT_RECORDER.snapshot(), method="ScoreBatch")
         probe = run_single_txn_probe(addr, n=120)
         return {
+            "e2e_stage_breakdown": breakdown,
+            "e2e_stage_coverage_p50": breakdown.get("stage_coverage_p50"),
             "e2e_txns_per_sec": load["value"],
             "e2e_rpc_p50_ms": load["rpc_p50_ms"],
             "e2e_rpc_p99_ms": load["rpc_p99_ms"],

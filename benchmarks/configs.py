@@ -401,13 +401,24 @@ def config0_grpc_e2e(wire_mode: str = "row") -> dict:
     see benchmarks/load_gen.py and bench.py). ``wire_mode='index'`` runs
     the device-resident feature-cache arm: the client ships index-mode
     frames and the device gathers rows from the HBM table
-    (serve/device_cache.py) — no per-RPC feature matrix on the link."""
+    (serve/device_cache.py) — no per-RPC feature matrix on the link.
+
+    The artifact line carries a ``stage_breakdown`` block aggregated from
+    the in-process flight recorder (obs/flight.py): per-stage p50/p99 for
+    the last N ScoreBatch RPCs plus ``stage_coverage_p50`` — what share
+    of the RPC span's duration the stage spans account for (the "where
+    did the latency go" figure the link-bound-vs-device question needs)."""
     from load_gen import run_grpc_load, run_single_txn_probe, start_inprocess_server
+
+    from igaming_platform_tpu.obs.flight import DEFAULT_RECORDER, stage_breakdown
 
     addr, shutdown = start_inprocess_server(batch_size=8192)
     try:
+        DEFAULT_RECORDER.clear()  # warm-up RPCs out of the breakdown window
         load = run_grpc_load(addr, duration_s=6.0, rows_per_rpc=8192,
                              concurrency=6, wire_mode=wire_mode)
+        load["stage_breakdown"] = stage_breakdown(
+            DEFAULT_RECORDER.snapshot(), method="ScoreBatch")
         probe = run_single_txn_probe(addr, n=120)
         load["single_txn_p99_ms"] = probe["value"]
         load["single_txn_p50_ms"] = probe["p50_ms"]

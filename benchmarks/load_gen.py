@@ -26,6 +26,7 @@ import os
 import sys
 import threading
 import time
+import uuid
 
 import numpy as np
 
@@ -78,6 +79,15 @@ def _build_index_payloads(
             devices=[f"dev-{int(rng.integers(0, 64))}" for i in range(rows_per_rpc)],
         ))
     return payloads
+
+
+def _client_traceparent() -> tuple[str, tuple]:
+    """Fresh W3C trace context per RPC, sent as gRPC metadata — the
+    client end of the client -> front (-> follower) trace the server's
+    rpc.* span adopts. Returns (trace_id, metadata)."""
+    trace_id = uuid.uuid4().hex
+    header = f"00-{trace_id}-{uuid.uuid4().hex[:16]}-01"
+    return trace_id, (("traceparent", header),)
 
 
 def _seed_store(engine, n_accounts: int = 512, events_per_acct: int = 6) -> None:
@@ -146,9 +156,10 @@ def run_grpc_load(
             time.sleep(0.001)
         i = k
         while time.perf_counter() < stop_at[0]:
+            _, metadata = _client_traceparent()
             t0 = time.perf_counter()
             try:
-                call(payloads[i % len(payloads)], timeout=60)
+                call(payloads[i % len(payloads)], timeout=60, metadata=metadata)
             except grpc.RpcError as exc:
                 # Shed vs failure must not conflate (the soak harness's
                 # discipline, benchmarks/soak.py): RESOURCE_EXHAUSTED is
@@ -216,8 +227,9 @@ def run_single_txn_probe(addr: str, n: int = 150) -> dict:
     for i in range(n):
         req = risk_pb2.ScoreTransactionRequest(
             account_id=f"lg-{i % 64}", amount=1000 + i, transaction_type="deposit")
+        _, metadata = _client_traceparent()
         t0 = time.perf_counter()
-        call(req, timeout=30)
+        call(req, timeout=30, metadata=metadata)
         lat.append((time.perf_counter() - t0) * 1000.0)
     ch.close()
     lat = np.array(lat[10:])

@@ -6,6 +6,10 @@ wallet wallet_wire wallet_pg (default: all). grpc_e2e_index is the
 device-resident feature-cache arm (index-mode wire frames, HBM table —
 serve/device_cache.py); its artifact line carries the same schema plus
 `wire_mode`, and both e2e lines separate `bulk_shed` from `errors`.
+Both e2e arms also carry a `stage_breakdown` block (per-stage p50/p99 +
+stage coverage of the RPC span, sourced from the flight recorder —
+obs/flight.py) so the artifact itself says whether a gap is wire decode,
+feature gather, the device step, or readback.
 
 Each config runs in its OWN subprocess when several are requested: the
 serving configs leave device queues / batcher threads / allocator state

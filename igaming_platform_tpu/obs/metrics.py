@@ -48,7 +48,11 @@ class Counter:
     def render(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} counter"
-        for key, v in sorted(self._values.items()):
+        # Snapshot under the lock: a concurrent inc() during a /metrics
+        # scrape must not race the dict iteration.
+        with self._lock:
+            values = sorted(self._values.items())
+        for key, v in values:
             yield f"{self.name}{_fmt_labels(key)} {v}"
 
 
@@ -69,7 +73,9 @@ class Gauge:
     def render(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} gauge"
-        for key, v in sorted(self._values.items()):
+        with self._lock:
+            values = sorted(self._values.items())
+        for key, v in values:
             yield f"{self.name}{_fmt_labels(key)} {v}"
 
 
@@ -81,9 +87,25 @@ class Histogram:
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = {}
         self._totals: dict[tuple, int] = {}
+        # Latest exemplar per (labelset, bucket index): (trace_id, value,
+        # unix_ts). Rendered OpenMetrics-style on the bucket line, so a
+        # p99 breach on the dashboard links straight to a trace id in the
+        # flight recorder / Jaeger. len(buckets) indexes the +Inf bucket.
+        self._exemplars: dict[tuple, dict[int, tuple[str, float, float]]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float, **labels: str) -> None:
+    def _bucket_index(self, value: float) -> int:
+        for i, bound in enumerate(self.buckets):
+            if value <= bound:
+                return i
+        return len(self.buckets)
+
+    def _note_exemplar(self, key: tuple, value: float, exemplar: str) -> None:
+        """Caller holds the lock."""
+        self._exemplars.setdefault(key, {})[self._bucket_index(value)] = (
+            str(exemplar), float(value), time.time())
+
+    def observe(self, value: float, exemplar: str | None = None, **labels: str) -> None:
         key = _label_key(labels)
         with self._lock:
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
@@ -92,8 +114,10 @@ class Histogram:
                     counts[i] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
+            if exemplar is not None:
+                self._note_exemplar(key, value, exemplar)
 
-    def observe_many(self, values, **labels: str) -> None:
+    def observe_many(self, values, exemplar: str | None = None, **labels: str) -> None:
         """Vectorized observe for batch paths: one lock hold + one
         histogram pass for N values (a per-row observe() on an 8192-row
         wire batch would put Python loops back on the hot path)."""
@@ -112,6 +136,10 @@ class Histogram:
                 counts[i] += int(c)
             self._sums[key] = self._sums.get(key, 0.0) + float(arr.sum())
             self._totals[key] = self._totals.get(key, 0) + int(arr.size)
+            if exemplar is not None:
+                # One exemplar per batch: the worst value is the one a
+                # latency investigation wants to click through to.
+                self._note_exemplar(key, float(arr.max()), exemplar)
 
     def percentile(self, q: float, **labels: str) -> float:
         """Approximate percentile from bucket boundaries (upper bound)."""
@@ -130,18 +158,33 @@ class Histogram:
     def count(self, **labels: str) -> int:
         return self._totals.get(_label_key(labels), 0)
 
+    @staticmethod
+    def _exemplar_suffix(ex: tuple[str, float, float] | None) -> str:
+        if ex is None:
+            return ""
+        trace_id, value, ts = ex
+        return f' # {{trace_id="{trace_id}"}} {value} {round(ts, 3)}'
+
     def render(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} histogram"
-        for key in sorted(self._totals):
-            counts = self._counts[key]
-            for bound, c in zip(self.buckets, counts):
+        with self._lock:
+            snap = {
+                key: (list(self._counts[key]), self._sums[key],
+                      self._totals[key], dict(self._exemplars.get(key, {})))
+                for key in self._totals
+            }
+        for key in sorted(snap):
+            counts, _sum, _total, exemplars = snap[key]
+            for i, (bound, c) in enumerate(zip(self.buckets, counts)):
                 lk = key + (("le", str(bound)),)
-                yield f"{self.name}_bucket{_fmt_labels(tuple(sorted(lk)))} {c}"
+                yield (f"{self.name}_bucket{_fmt_labels(tuple(sorted(lk)))} {c}"
+                       f"{self._exemplar_suffix(exemplars.get(i))}")
             lk = key + (("le", "+Inf"),)
-            yield f"{self.name}_bucket{_fmt_labels(tuple(sorted(lk)))} {self._totals[key]}"
-            yield f"{self.name}_sum{_fmt_labels(key)} {self._sums[key]}"
-            yield f"{self.name}_count{_fmt_labels(key)} {self._totals[key]}"
+            yield (f"{self.name}_bucket{_fmt_labels(tuple(sorted(lk)))} {_total}"
+                   f"{self._exemplar_suffix(exemplars.get(len(self.buckets)))}")
+            yield f"{self.name}_sum{_fmt_labels(key)} {_sum}"
+            yield f"{self.name}_count{_fmt_labels(key)} {_total}"
 
 
 class Registry:
@@ -260,9 +303,53 @@ class ServiceMetrics:
         self.reconciliation_mismatched = self.registry.gauge(
             f"{service}_reconciliation_mismatched", "Balance/ledger mismatches in the last sweep"
         )
+        # Request-lifecycle tracing (obs/tracing.py): every stage span on
+        # the serving path lands here by stage name, with the worst sample
+        # per bucket carrying its trace id as an exemplar — a p99 breach
+        # on the dashboard links straight to a flight-recorder entry.
+        self.stage_latency_ms = self.registry.histogram(
+            f"{service}_stage_latency_ms",
+            "Serving-path stage latency (ms) by lifecycle stage "
+            "(score.admission/decode/gather/cache_lookup/dispatch/"
+            "readback/encode/queue, follower.device_step); bucket lines "
+            "carry trace-id exemplars",
+            buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000),
+        )
+        self.batcher_queue_depth = self.registry.gauge(
+            f"{service}_batcher_queue_depth",
+            "Requests still waiting in the continuous batcher's queue at "
+            "the moment a batch was assembled",
+        )
+        self.batcher_time_in_queue_ms = self.registry.histogram(
+            f"{service}_batcher_time_in_queue_ms",
+            "Per-request wait (ms) between batcher enqueue and batch "
+            "assembly — the batching-window share of single-txn latency",
+            buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250),
+        )
+        self.spans_dropped_total = self.registry.counter(
+            f"{service}_spans_dropped_total",
+            "Host spans evicted from the bounded span ring before export "
+            "(a non-zero rate means /debug/spans and the OTLP drain are "
+            "sampling, not complete)",
+        )
+        self.otlp_export_failures_total = self.registry.counter(
+            f"{service}_otlp_export_failures_total",
+            "OTLP/HTTP span export batches dropped on endpoint errors "
+            "(spans are diagnostics: failures drop the batch, never block "
+            "serving)",
+        )
 
     def observe_rpc(self, method: str, start_time: float, code: str = "OK") -> None:
         self.requests_total.inc(method=method, code=code)
         self.request_duration_ms.observe((time.monotonic() - start_time) * 1000.0, method=method)
         if code != "OK":
             self.errors_total.inc(method=method)
+
+    def observe_stage_span(self, span) -> None:
+        """Span-sink adapter (obs/tracing.set_span_sink): stage spans feed
+        the per-stage histogram keyed by span name; rpc.* roots are the
+        whole-request spans already covered by request_duration_ms."""
+        if span.name.startswith("rpc."):
+            return
+        self.stage_latency_ms.observe(
+            span.duration_ms, exemplar=span.trace_id, stage=span.name)

@@ -53,15 +53,21 @@ def encode_spans(spans: list[Span], service_name: str) -> dict:
                 "scope": {"name": "igaming-platform-tpu", "version": "1.0"},
                 "spans": [
                     {
-                        # Collector trace ids are 16 hex chars; OTLP wants
-                        # 16-byte (32 hex) trace ids and 8-byte span ids.
+                        # OTLP wants 16-byte (32 hex) trace ids and 8-byte
+                        # span ids; the collector's are that shape already,
+                        # but legacy 16-hex trace ids are padded.
                         "traceId": (s.trace_id or uuid.uuid4().hex[:16]).ljust(32, "0"),
-                        "spanId": uuid.uuid4().hex[:16],
+                        "spanId": getattr(s, "span_id", "") or uuid.uuid4().hex[:16],
                         "name": s.name,
                         "kind": 1,  # SPAN_KIND_INTERNAL
                         "startTimeUnixNano": str(int(s.start * 1e9)),
                         "endTimeUnixNano": str(int((s.end or s.start) * 1e9)),
                         "attributes": [_attr(k, v) for k, v in s.attributes.items()],
+                        # Parent linkage: Jaeger renders the stage spans
+                        # UNDER their rpc.* root (and, with traceparent
+                        # propagation, under the remote caller's span).
+                        **({"parentSpanId": s.parent_id}
+                           if getattr(s, "parent_id", "") else {}),
                     }
                     for s in spans
                 ],
@@ -93,6 +99,10 @@ class OtlpExporter:
         self.timeout_s = timeout_s
         self.exported_total = 0
         self.failed_batches = 0
+        # Metrics hook: the service layer binds this to its
+        # <service>_otlp_export_failures_total counter so export loss is
+        # on /metrics, not only in logs.
+        self.on_failure = None  # callable(n_failed_batches: int) | None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -125,6 +135,11 @@ class OtlpExporter:
                 pass
         except (urllib.error.URLError, OSError) as exc:
             self.failed_batches += 1
+            if self.on_failure is not None:
+                try:
+                    self.on_failure(1)
+                except Exception:  # noqa: BLE001 — metrics must not kill export
+                    pass
             logger.warning("OTLP export failed (%d spans dropped): %s", len(spans), exc)
             return 0
         self.exported_total += len(spans)

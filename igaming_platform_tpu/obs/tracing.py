@@ -9,16 +9,28 @@ Here tracing is wired two ways:
 - host side: lightweight spans (``span``) collected into an in-process
   buffer exportable as JSON — the OTLP-shaped record without requiring an
   OTLP endpoint in the image.
+
+Spans form real traces: a ``span()`` opened while another span is active
+on the same thread becomes its CHILD (same trace id, ``parent_id`` set),
+and a remote parent can be adopted from a W3C ``traceparent`` header
+(``parse_traceparent``/``format_traceparent``) — the propagation contract
+the gRPC layer and the multihost work channel use so client, front and
+follower spans share one trace. Each ROOT span accumulates the summed
+duration of its descendant stages (``stage_totals``), which is what the
+flight recorder (obs/flight.py) snapshots per request.
 """
 
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import json
+import re
 import threading
 import time
 import uuid
 from dataclasses import dataclass, field
+from typing import Callable
 
 import jax
 
@@ -29,11 +41,86 @@ class Span:
     start: float
     end: float = 0.0
     trace_id: str = ""
+    span_id: str = ""
+    parent_id: str = ""
     attributes: dict = field(default_factory=dict)
+    # Root spans only: summed child-stage durations (ms) by span name —
+    # the per-request decomposition the flight recorder snapshots.
+    stage_totals: dict | None = field(default=None, repr=False, compare=False)
+    root: "Span | None" = field(default=None, repr=False, compare=False)
 
     @property
     def duration_ms(self) -> float:
         return (self.end - self.start) * 1000.0
+
+
+# -- W3C trace context -------------------------------------------------------
+
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$")
+
+
+def parse_traceparent(header: str | None) -> tuple[str, str] | None:
+    """W3C ``traceparent`` header -> (trace_id, parent_span_id), or None
+    when absent/malformed (a bad header must never fail a request)."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip())
+    if m is None:
+        return None
+    version, trace_id, span_id = m.group(1), m.group(2), m.group(3)
+    if version == "ff" or trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    """(trace_id, span_id) -> W3C ``traceparent`` header (sampled)."""
+    return f"00-{trace_id}-{span_id}-01"
+
+
+# Per-thread active span (contextvars: gRPC worker threads and the
+# batcher's launcher/collector threads each carry their own chain).
+_CURRENT: contextvars.ContextVar[Span | None] = contextvars.ContextVar(
+    "igaming_current_span", default=None)
+
+# Completion hooks. _SPAN_SINK fires for EVERY completed span (the metrics
+# layer feeds per-stage latency histograms from it); _ROOT_SINK fires for
+# completed ROOT spans only (the flight recorder). Both are best-effort:
+# a failing sink must never fail the traced request.
+_SPAN_SINK: Callable[[Span], None] | None = None
+_ROOT_SINK: Callable[[Span], None] | None = None
+
+
+def set_span_sink(fn: Callable[[Span], None] | None) -> None:
+    global _SPAN_SINK
+    _SPAN_SINK = fn
+
+
+def set_root_sink(fn: Callable[[Span], None] | None) -> None:
+    global _ROOT_SINK
+    _ROOT_SINK = fn
+
+
+def current_span() -> Span | None:
+    return _CURRENT.get()
+
+
+def current_traceparent() -> str | None:
+    """W3C header for the active span, or None outside any span — what
+    gets injected into outbound hops (multihost work frames)."""
+    s = _CURRENT.get()
+    if s is None:
+        return None
+    return format_traceparent(s.trace_id, s.span_id)
+
+
+def set_root_attribute(key: str, value) -> None:
+    """Attach an attribute to the CURRENT trace's root span (e.g. the row
+    count, known only deep in a handler). No-op outside a span."""
+    s = _CURRENT.get()
+    if s is not None and s.root is not None:
+        s.root.attributes[key] = value
 
 
 class SpanCollector:
@@ -43,12 +130,26 @@ class SpanCollector:
         self.capacity = capacity
         self._spans: list[Span] = []
         self._lock = threading.Lock()
+        # Past capacity the OLDEST spans are evicted; that loss is counted
+        # (and surfaced as <service>_spans_dropped_total via on_drop) so a
+        # sampling gap in /debug/spans or the OTLP export is visible.
+        self.dropped_total = 0
+        self.on_drop: Callable[[int], None] | None = None
 
     def add(self, span: Span) -> None:
+        dropped = 0
         with self._lock:
             self._spans.append(span)
             if len(self._spans) > self.capacity:
+                dropped = len(self._spans) - self.capacity
                 self._spans = self._spans[-self.capacity:]
+                self.dropped_total += dropped
+            on_drop = self.on_drop
+        if dropped and on_drop is not None:
+            try:
+                on_drop(dropped)
+            except Exception:  # noqa: BLE001 — metrics must not fail tracing
+                pass
 
     def drain(self) -> list[Span]:
         with self._lock:
@@ -61,6 +162,8 @@ class SpanCollector:
                 {
                     "name": s.name,
                     "trace_id": s.trace_id,
+                    "span_id": s.span_id,
+                    "parent_id": s.parent_id,
                     "start_unix_s": s.start,
                     "duration_ms": s.duration_ms,
                     "attributes": s.attributes,
@@ -73,15 +176,55 @@ DEFAULT_COLLECTOR = SpanCollector()
 
 
 @contextlib.contextmanager
-def span(name: str, collector: SpanCollector | None = None, **attributes):
-    """Host-side span around gather -> transfer -> compute stages."""
+def span(name: str, collector: SpanCollector | None = None, *,
+         traceparent: str | None = None, **attributes):
+    """Host-side span around a serving stage.
+
+    Nested use on one thread links parent/child automatically; a root
+    span may instead adopt a remote parent from a ``traceparent`` header
+    (client->front->follower propagation). Roots accumulate child-stage
+    durations into ``stage_totals`` and fire the flight-recorder sink.
+    """
     collector = collector or DEFAULT_COLLECTOR
-    s = Span(name=name, start=time.time(), trace_id=uuid.uuid4().hex[:16], attributes=attributes)
+    parent = _CURRENT.get()
+    trace_id = parent_id = ""
+    if parent is not None:
+        trace_id, parent_id = parent.trace_id, parent.span_id
+    elif traceparent is not None:
+        parsed = parse_traceparent(traceparent)
+        if parsed is not None:
+            trace_id, parent_id = parsed
+    if not trace_id:
+        trace_id = uuid.uuid4().hex
+    s = Span(name=name, start=time.time(), trace_id=trace_id,
+             span_id=uuid.uuid4().hex[:16], parent_id=parent_id,
+             attributes=attributes)
+    if parent is None:
+        s.stage_totals = {}
+        s.root = s
+    else:
+        s.root = parent.root if parent.root is not None else parent
+    token = _CURRENT.set(s)
     try:
         yield s
     finally:
+        _CURRENT.reset(token)
         s.end = time.time()
         collector.add(s)
+        root = s.root
+        if root is not None and root is not s and root.stage_totals is not None:
+            root.stage_totals[s.name] = (
+                root.stage_totals.get(s.name, 0.0) + s.duration_ms)
+        if _SPAN_SINK is not None:
+            try:
+                _SPAN_SINK(s)
+            except Exception:  # noqa: BLE001 — sinks must not fail requests
+                pass
+        if root is s and _ROOT_SINK is not None:
+            try:
+                _ROOT_SINK(s)
+            except Exception:  # noqa: BLE001 — sinks must not fail requests
+                pass
 
 
 @contextlib.contextmanager

@@ -104,6 +104,9 @@ class WalletServer:
         from igaming_platform_tpu.obs.otlp import exporter_from_env
 
         self.otlp = exporter_from_env("wallet")
+        if self.otlp is not None:
+            # Export loss is a metric, not just a log line.
+            self.otlp.on_failure = self.metrics.otlp_export_failures_total.inc
         self._stopped = threading.Event()
         logger.info("wallet server up: grpc=%d http=%d", self.grpc_port, self.http_port)
 

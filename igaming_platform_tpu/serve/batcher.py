@@ -34,6 +34,7 @@ from igaming_platform_tpu.core.config import BatcherConfig
 class _WorkItem:
     payload: Any
     future: Future
+    enqueued_at: float = 0.0
 
 
 _SENTINEL = object()
@@ -172,6 +173,11 @@ class ContinuousBatcher:
         self.batches_run = 0
         self.rows_scored = 0
         self.batches_replayed = 0
+        # Observability hook, set by the serving layer: called once per
+        # assembled batch with (per-request queue waits in ms, queue depth
+        # left behind) — feeds the time-in-queue histogram and queue-depth
+        # gauge. Best-effort: a failing hook must never fail a batch.
+        self.on_batch = None  # callable(waits_ms: list[float], depth: int)
 
     def start(self) -> "ContinuousBatcher":
         if not self._started:
@@ -200,7 +206,7 @@ class ContinuousBatcher:
 
     def submit(self, payload: Any) -> Future:
         fut: Future = Future()
-        self._queue.put(_WorkItem(payload, fut))
+        self._queue.put(_WorkItem(payload, fut, _now()))
         return fut
 
     def score_sync(self, payload: Any, timeout: float = 30.0):
@@ -231,6 +237,16 @@ class ContinuousBatcher:
                     items.append(self._queue.get_nowait())
                 except queue.Empty:
                     break
+
+            if self.on_batch is not None:
+                try:
+                    assembled = _now()
+                    self.on_batch(
+                        [(assembled - it.enqueued_at) * 1000.0 for it in items],
+                        self._queue.qsize(),
+                    )
+                except Exception:  # noqa: BLE001 — metrics must not fail batches
+                    pass
 
             if self._dispatch is not None:
                 try:

@@ -27,9 +27,15 @@ from typing import Callable
 
 import grpc
 
+from igaming_platform_tpu.obs import flight as _flight
+from igaming_platform_tpu.obs import tracing
 from igaming_platform_tpu.obs.metrics import ServiceMetrics
 from igaming_platform_tpu.obs.tracing import span
 from igaming_platform_tpu.serve.reflection import reflection_handler
+
+# Always-on flight recorder: every completed rpc.* root span lands in the
+# bounded ring served at /debug/flightz (obs/flight.py).
+_flight.install()
 from igaming_platform_tpu.serve.wire import (
     INDEX_WIRE_MAGIC,
     RawProtoMessage,
@@ -210,6 +216,20 @@ class _FixedWindowRateLimiter:
             return count <= self.per_minute
 
 
+def _traceparent_from_metadata(context) -> str | None:
+    """W3C trace context off the gRPC metadata (grpc lowercases keys).
+    A missing/malformed header is normal — the span starts a new trace."""
+    if context is None:
+        return None
+    try:
+        for key, value in context.invocation_metadata() or ():
+            if key == "traceparent":
+                return value
+    except Exception:  # noqa: BLE001 — tracing must not fail the RPC
+        pass
+    return None
+
+
 def _rpc(metrics: ServiceMetrics, method: str, fn: Callable):
     """Wrap a handler with metrics + panic recovery (the interceptor chain
     of wallet/cmd/main.go:274-311 collapsed into one decorator)."""
@@ -218,8 +238,13 @@ def _rpc(metrics: ServiceMetrics, method: str, fn: Callable):
         start = time.monotonic()
         # Per-RPC host span (the OTel spans the reference deploys Jaeger
         # for but never emits — SURVEY.md §5); status lands as an attribute
-        # so sampled traces show which calls aborted.
-        with span(f"rpc.{method}") as s:
+        # so sampled traces show which calls aborted. The caller's
+        # `traceparent` metadata (W3C) parents this span, so client, front
+        # and follower spans share one trace id; stage spans opened inside
+        # the handler nest under it and decompose the RPC's latency, and
+        # the completed root lands in the flight recorder (/debug/flightz).
+        with span(f"rpc.{method}",
+                  traceparent=_traceparent_from_metadata(context)) as s:
             try:
                 resp = fn(request, context)
                 metrics.observe_rpc(method, start)
@@ -333,6 +358,25 @@ class RiskGrpcService:
             # service's registry (obs/metrics.py) whether the cache is
             # already built or materializes on the first index-mode RPC.
             engine.bind_cache_metrics(self.metrics)
+        # Request-lifecycle observability: every completed stage span feeds
+        # risk_stage_latency_ms (with trace-id exemplars), span-ring
+        # evictions count in risk_spans_dropped_total, and the continuous
+        # batcher reports per-request queue wait + queue depth. Sinks are
+        # process-global; the most recently constructed risk service owns
+        # them (one serving engine per process in every deployment shape).
+        tracing.set_span_sink(self.metrics.observe_stage_span)
+        tracing.DEFAULT_COLLECTOR.on_drop = self.metrics.spans_dropped_total.inc
+        batcher = getattr(engine, "_batcher", None)
+        if batcher is not None:
+            batcher.on_batch = self._observe_batcher_batch
+
+    def _observe_batcher_batch(self, waits_ms: list, depth: int) -> None:
+        """Batcher hook: time-in-queue histogram + queue-depth gauge, and
+        the queue wait as a `score.queue` stage so the batching window
+        shows up in the same per-stage breakdown as decode/gather/step."""
+        self.metrics.batcher_queue_depth.set(depth)
+        self.metrics.batcher_time_in_queue_ms.observe_many(waits_ms)
+        self.metrics.stage_latency_ms.observe_many(waits_ms, stage="score.queue")
 
     # -- scoring --
 
@@ -415,7 +459,11 @@ class RiskGrpcService:
             self.metrics.bulk_shed_total.inc()
             raise RpcAbort(grpc.StatusCode.RESOURCE_EXHAUSTED,
                            "BULK_SHED: deadline nearly exhausted before start")
-        if not self._bulk_gate.acquire(timeout=self._bulk_admit_wait_s):
+        # The admission wait is a lifecycle stage: under overload it is
+        # real queueing the RPC span would otherwise carry unattributed.
+        with span("score.admission"):
+            admitted = self._bulk_gate.acquire(timeout=self._bulk_admit_wait_s)
+        if not admitted:
             self.metrics.bulk_shed_total.inc()
             raise RpcAbort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
@@ -445,13 +493,15 @@ class RiskGrpcService:
                         grpc.StatusCode.UNIMPLEMENTED,
                         f"index-mode ScoreBatch unavailable: {exc}") from exc
                 self.metrics.txns_scored_total.inc(n)
+                tracing.set_root_attribute("rows", n)
                 return RawProtoMessage(payload)
             if not hasattr(getattr(self.engine, "features", None), "decode_gather"):
                 # Raw mode was enabled for index frames but this is a
                 # protobuf request and the store has no native decoder:
                 # parse here and fall through to the standard paths.
                 try:
-                    request = risk_pb2.ScoreBatchRequest.FromString(buf)
+                    with span("score.decode"):
+                        request = risk_pb2.ScoreBatchRequest.FromString(buf)
                 except Exception as exc:  # noqa: BLE001 — malformed proto
                     raise RpcAbort(
                         grpc.StatusCode.INVALID_ARGUMENT,
@@ -466,33 +516,45 @@ class RiskGrpcService:
                     grpc.StatusCode.INVALID_ARGUMENT, f"bad ScoreBatchRequest: {exc}"
                 ) from exc
             self.metrics.txns_scored_total.inc(n)
+            tracing.set_root_attribute("rows", n)
             return RawProtoMessage(payload)
         return self._score_batch_parsed(request)
 
     def _score_batch_parsed(self, request):
         txs = request.transactions
+        tracing.set_root_attribute("rows", len(txs))
         if _use_wire_fast_path() and hasattr(self.engine, "score_batch_wire"):
             # Errors propagate: once the codec is confirmed available, any
             # failure here (device error, encoder bug) is a real serving
             # failure — silently re-running the batch on the per-row path
             # would double device load exactly when the device is sick.
+            # Column extraction is the proto half of wire decode — spanned
+            # so large-batch RPCs don't carry it as unattributed latency.
+            with span("score.decode", batch=len(txs)):
+                cols = (
+                    [t.account_id for t in txs],
+                    [t.amount for t in txs],
+                    [t.transaction_type or "deposit" for t in txs],
+                    [t.ip_address for t in txs],
+                    [t.device_id for t in txs],
+                    [t.fingerprint for t in txs],
+                )
             payload = self.engine.score_batch_wire(
-                [t.account_id for t in txs],
-                [t.amount for t in txs],
-                [t.transaction_type or "deposit" for t in txs],
-                ips=[t.ip_address for t in txs],
-                devices=[t.device_id for t in txs],
-                fingerprints=[t.fingerprint for t in txs],
+                cols[0], cols[1], cols[2],
+                ips=cols[3], devices=cols[4], fingerprints=cols[5],
             )
             self.metrics.txns_scored_total.inc(len(txs))
             return RawProtoMessage(payload)
-        reqs = [self._request_from_proto(t) for t in txs]
+        with span("score.decode", batch=len(txs)):
+            reqs = [self._request_from_proto(t) for t in txs]
         responses = self.engine.score_batch(reqs)
         self.metrics.txns_scored_total.inc(len(responses))
         # Metric parity with the fast path: the per-row fallback feeds the
         # score histogram too (WIRE_FAST_PATH=0 must not flatline it).
         self.metrics.score_distribution.observe_many([r.score for r in responses])
-        return risk_pb2.ScoreBatchResponse(results=[self._score_to_proto(r) for r in responses])
+        with span("score.encode", batch=len(responses)):
+            return risk_pb2.ScoreBatchResponse(
+                results=[self._score_to_proto(r) for r in responses])
 
     # -- LTV --
 

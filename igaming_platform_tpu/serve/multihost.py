@@ -257,13 +257,20 @@ class WorkChannel:
             if not blocking or self._outstanding[i] < self._ack_window:
                 return
 
-    def broadcast(self, xp: np.ndarray, blp: np.ndarray, thr: np.ndarray) -> None:
+    def broadcast(self, xp: np.ndarray, blp: np.ndarray, thr: np.ndarray,
+                  trace: np.ndarray | None = None) -> None:
+        """Fan one work step out to every follower. ``trace`` is an
+        optional uint8-encoded W3C traceparent header: when present it
+        rides the frame as a 4th array, so the follower's device-step span
+        joins the SAME trace as the front's rpc.* span (and, transitively,
+        the client's). Followers accept 3- and 4-array frames alike."""
+        arrays = (xp, blp, thr) if trace is None else (xp, blp, thr, trace)
         with self._lock:
             self._ensure_alive()
             for i, s in enumerate(self._socks):
                 self._reap_acks(i, need_room=True)
                 try:
-                    _send_frame(s, MAGIC_WORK, xp, blp, thr)
+                    _send_frame(s, MAGIC_WORK, *arrays)
                 except socket.timeout as exc:
                     raise self._mark_dead(
                         i, f"send timed out after {self._io_timeout_s}s") from exc
@@ -375,11 +382,23 @@ def follower_serve(port: int, cfg, ml_backend: str, params, mesh) -> None:
                 continue
             if magic != MAGIC_WORK:
                 return
-            xp, blp, thr = arrays
-            out = _global_step(fn, row, vec, repl, params_global,
-                               np.asarray(xp, np.float32),
-                               np.asarray(blp, bool), thr)
-            del out  # replicated result; the front answers the RPC
+            xp, blp, thr = arrays[:3]
+            # Optional 4th array: the front's traceparent (uint8-encoded
+            # W3C header). The follower's device-step span then shares
+            # ONE trace with client -> front -> follower, visible as a
+            # single Jaeger trace across processes.
+            traceparent = None
+            if len(arrays) > 3:
+                traceparent = bytes(
+                    np.asarray(arrays[3], np.uint8)).decode("ascii", "replace")
+            from igaming_platform_tpu.obs.tracing import span as _span
+
+            with _span("follower.device_step", traceparent=traceparent,
+                       rows=int(np.asarray(xp).shape[0])):
+                out = _global_step(fn, row, vec, repl, params_global,
+                                   np.asarray(xp, np.float32),
+                                   np.asarray(blp, bool), thr)
+                del out  # replicated result; the front answers the RPC
             # Step ACK: one byte per completed work frame, the front's
             # liveness signal (WorkChannel._reap_acks). A follower that
             # wedges mid-step simply never sends it.
@@ -481,12 +500,20 @@ def multihost_engine(mesh, follower_ports: list[int], *, batcher_config=None,
                 return super()._launch_device(x, bl)
             xp, _ = pad_batch(np.asarray(x, np.float32), shape)
             blp, _ = pad_batch(np.asarray(bl, bool), shape)
+            # Propagate the active trace onto the work channel: the
+            # follower's device-step span joins the front's rpc span's
+            # trace (client -> front -> follower, one trace id).
+            from igaming_platform_tpu.obs.tracing import current_traceparent
+
+            tp = current_traceparent()
+            trace = (np.frombuffer(tp.encode("ascii"), dtype=np.uint8)
+                     if tp else None)
             with self._step_lock:
                 # self._thresholds is the ALWAYS-fresh copy
                 # (set_thresholds only refreshes _thresholds_host when a
                 # host tier exists).
                 thr = np.asarray(self._thresholds, np.int32)
-                self._chan.broadcast(xp, blp, thr)
+                self._chan.broadcast(xp, blp, thr, trace=trace)
                 out = _global_step(gfn, row, vec, repl,
                                    self._params_global, xp, blp, thr)
             if hasattr(out, "copy_to_host_async"):

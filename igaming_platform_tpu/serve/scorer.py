@@ -588,17 +588,19 @@ class TPUScoringEngine:
         )
 
         start = time.monotonic()
-        ids, amounts, codes, ips, devices, fingerprints = decode_index_batch(payload)
+        with span("score.decode"):
+            ids, amounts, codes, ips, devices, fingerprints = decode_index_batch(payload)
         if len(ids) == 0:
             return b"", 0
         self.ensure_cache()
         with span("score.blacklist", batch=len(ids)):
             bl = self._blacklist_flags(len(ids), ips, devices, fingerprints)
         cat, rtms = self._indexed_outputs(ids, amounts, codes, bl, start)
-        payload_out = encode_score_batch(
-            cat["score"], cat["action"], cat["reason_mask"], cat["rule_score"],
-            cat["ml_score"], rtms, None,
-        )
+        with span("score.encode", batch=len(ids)):
+            payload_out = encode_score_batch(
+                cat["score"], cat["action"], cat["reason_mask"], cat["rule_score"],
+                cat["ml_score"], rtms, None,
+            )
         return payload_out, len(ids)
 
     # -- internals -----------------------------------------------------------
@@ -735,10 +737,11 @@ class TPUScoringEngine:
             bl = self._blacklist_flags(total, ips, devices, fingerprints)
             cat, rtms = self._indexed_outputs(
                 list(account_ids), amounts, types, bl, start)
-            return encode_score_batch(
-                cat["score"], cat["action"], cat["reason_mask"],
-                cat["rule_score"], cat["ml_score"], rtms, None,
-            )
+            with span("score.encode", batch=total):
+                return encode_score_batch(
+                    cat["score"], cat["action"], cat["reason_mask"],
+                    cat["rule_score"], cat["ml_score"], rtms, None,
+                )
         with span("score.gather", batch=total):
             if hasattr(self.features, "gather_columns"):
                 x, bl = self.features.gather_columns(
@@ -831,10 +834,11 @@ class TPUScoringEngine:
                         "score_observer failed; score histogram will be "
                         "empty for wire batches", exc_info=True,
                     )
-        return encode_score_batch(
-            cat["score"], cat["action"], cat["reason_mask"], cat["rule_score"],
-            cat["ml_score"], rtms, x if include_features else None,
-        )
+        with span("score.encode", batch=total):
+            return encode_score_batch(
+                cat["score"], cat["action"], cat["reason_mask"], cat["rule_score"],
+                cat["ml_score"], rtms, x if include_features else None,
+            )
 
     def step_cost(self, n_rows: int | None = None) -> dict[str, float]:
         """XLA FLOPs/bytes per execution of the compiled packed score

@@ -241,7 +241,13 @@ class RiskServer:
         from igaming_platform_tpu.obs.otlp import exporter_from_env
 
         self.otlp = exporter_from_env("risk")
+        if self.otlp is not None:
+            # Export loss is a metric, not just a log line.
+            self.otlp.on_failure = self.metrics.otlp_export_failures_total.inc
         self._stopped = threading.Event()
+        # On-demand device profile capture (/debug/profilez): one at a
+        # time — jax.profiler traces cannot nest.
+        self._profile_lock = threading.Lock()
 
         # Device-liveness probe (SURVEY.md §5: "health gate tied to device
         # liveness"): one tiny compiled op, pre-warmed here so /ready never
@@ -272,6 +278,32 @@ class RiskServer:
             return self._probe_pool.submit(probe).result(timeout=timeout_s)
         except Exception:  # noqa: BLE001 — timeout or device error
             return False
+
+    def capture_profile(self, seconds: float) -> dict:
+        """On-demand jax.profiler capture (`/debug/profilez?seconds=S`):
+        records a TensorBoard-compatible device trace for ``seconds``
+        while live traffic keeps flowing, via the same ``device_trace``
+        helper the offline drills use. Bounded at 30 s (the capture
+        blocks its HTTP worker thread and profile buffers grow with
+        duration); 409 when a capture is already running."""
+        import tempfile
+        import time as _time
+
+        from igaming_platform_tpu.obs.tracing import device_trace
+
+        seconds = max(0.1, min(float(seconds), 30.0))
+        if not self._profile_lock.acquire(blocking=False):
+            return {"error": "profile capture already in progress"}
+        try:
+            log_dir = tempfile.mkdtemp(prefix="igaming-profilez-")
+            with device_trace(log_dir):
+                _time.sleep(seconds)
+            return {"ok": True, "seconds": seconds, "log_dir": log_dir,
+                    "hint": f"tensorboard --logdir {log_dir}"}
+        except Exception as exc:  # noqa: BLE001 — capture must not kill serving
+            return {"error": f"profile capture failed: {exc}"}
+        finally:
+            self._profile_lock.release()
 
     # -- HTTP sidecar (main.go:160-202 equivalent) ---------------------------
 
@@ -308,6 +340,24 @@ class RiskServer:
                 elif self.path == "/debug/spans":
                     from igaming_platform_tpu.obs.tracing import DEFAULT_COLLECTOR
                     self._send(200, DEFAULT_COLLECTOR.to_json())
+                elif self.path == "/debug/flightz":
+                    # Flight recorder: last N requests, each decomposed
+                    # into stage durations with its trace id — the first
+                    # stop when investigating a slow request.
+                    from igaming_platform_tpu.obs.flight import DEFAULT_RECORDER
+                    self._send(200, DEFAULT_RECORDER.to_json())
+                elif self.path.startswith("/debug/profilez"):
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        seconds = float(q.get("seconds", ["2"])[0])
+                    except ValueError:
+                        self._send(400, '{"error":"bad seconds"}')
+                        return
+                    result = server_ref.capture_profile(seconds)
+                    self._send(409 if "error" in result else 200,
+                               json.dumps(result))
                 else:
                     self._send(404, '{"error":"not found"}')
 
@@ -459,7 +509,17 @@ def main() -> None:
         port = int(port_env)
         logger.info("multihost follower: process %d/%d, work port %d",
                     jax.process_index(), jax.process_count(), port)
-        follower_serve(port, config.scoring, ml_backend, params, mesh)
+        # The follower's device-step spans (parented on the front's trace
+        # via the work-channel traceparent) drain to the same Jaeger as
+        # the front's when OTEL_EXPORTER_OTLP_ENDPOINT is set.
+        from igaming_platform_tpu.obs.otlp import exporter_from_env
+
+        otlp = exporter_from_env("risk-follower")
+        try:
+            follower_serve(port, config.scoring, ml_backend, params, mesh)
+        finally:
+            if otlp is not None:
+                otlp.stop()
         return
     if role == "front":
         import dataclasses

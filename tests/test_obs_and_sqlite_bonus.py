@@ -16,8 +16,8 @@ from igaming_platform_tpu.platform.repository import SQLiteStore
 def test_counter_gauge_histogram_render():
     reg = Registry()
     c = reg.counter("requests_total", "reqs")
-    g = reg.gauge("queue_depth")
-    h = reg.histogram("latency_ms", buckets=(1, 10, 100))
+    g = reg.gauge("queue_depth", "depth")
+    h = reg.histogram("latency_ms", "lat", buckets=(1, 10, 100))
 
     c.inc(method="Score")
     c.inc(2, method="Score")

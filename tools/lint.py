@@ -7,8 +7,11 @@ syntax errors (compile), unused imports, duplicate imports, bare
 (the round-5 measurement-integrity rule: on the tunneled backend
 block_until_ready can return at dispatch-ACK and inflate step
 throughput ~30x — every step timing must go through
-obs/perfmodel.device_step_time's two-point readback fence). AST-only,
-stdlib-only, zero configuration; not a style tool.
+obs/perfmodel.device_step_time's two-point readback fence), and metric
+hygiene (registry-factory calls must carry help text; production code
+must not construct orphan Counter/Gauge/Histogram instances that never
+render on /metrics). AST-only, stdlib-only, zero configuration; not a
+style tool.
 
 Deliberate side-effect imports (descriptor-pool registration, plugin
 hooks) are sanctioned by aliasing to an underscore name —
@@ -101,6 +104,67 @@ def check_timed_block_until_ready(path: Path, tree: ast.AST,
     return problems
 
 
+_METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+
+
+def _is_stringish(node: ast.AST | None) -> bool:
+    return isinstance(node, ast.JoinedStr) or (
+        isinstance(node, ast.Constant) and isinstance(node.value, str))
+
+
+def check_metric_hygiene(path: Path, tree: ast.AST,
+                         noqa_lines: set[int]) -> list[str]:
+    """Metric-construction discipline (ISSUE 2 satellite):
+
+    - every ``registry.counter/gauge/histogram(name, help)`` call must
+      pass non-empty help text — a series without HELP is unreadable on a
+      dashboard six months later;
+    - production code (igaming_platform_tpu/) must not construct
+      Counter/Gauge/Histogram directly: an orphan metric never joins a
+      Registry, so it silently never renders on /metrics. Tests may
+      (unit-testing the classes themselves is their job).
+    """
+    if path.name == "metrics.py" and path.parent.name == "obs":
+        return []
+    problems: list[str] = []
+    metric_imports: set[str] = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.ImportFrom) and node.module
+                and node.module.endswith("obs.metrics")):
+            for alias in node.names:
+                if alias.name in _METRIC_CLASSES:
+                    metric_imports.add(alias.asname or alias.name)
+    in_prod = "igaming_platform_tpu" in path.parts
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if node.lineno in noqa_lines:
+            continue
+        fn = node.func
+        # Registry factory calls: require help text.
+        if (isinstance(fn, ast.Attribute) and fn.attr in _METRIC_FACTORIES
+                and node.args and _is_stringish(node.args[0])):
+            help_arg = node.args[1] if len(node.args) > 1 else next(
+                (kw.value for kw in node.keywords if kw.arg == "help_text"),
+                None)
+            empty = help_arg is None or (
+                isinstance(help_arg, ast.Constant) and not help_arg.value)
+            if empty:
+                problems.append(
+                    f"{path}:{node.lineno}: metric registered without help "
+                    "text — pass a non-empty description so the series is "
+                    "readable on /metrics")
+        # Orphan constructions in production code.
+        if (in_prod and isinstance(fn, ast.Name)
+                and fn.id in metric_imports):
+            problems.append(
+                f"{path}:{node.lineno}: orphan metric: construct via "
+                "Registry.counter/gauge/histogram (a bare "
+                f"{fn.id}() never renders on /metrics)")
+    return problems
+
+
 def lint_file(path: Path) -> list[str]:
     src = path.read_text(encoding="utf-8")
     try:
@@ -113,6 +177,7 @@ def lint_file(path: Path) -> list[str]:
     }
 
     problems: list[str] = list(check_timed_block_until_ready(path, tree, noqa_lines))
+    problems.extend(check_metric_hygiene(path, tree, noqa_lines))
     used: set[str] = set()
     for node in ast.walk(tree):
         if isinstance(node, ast.Name):
