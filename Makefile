@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-fast lint bench bench-all bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
+.PHONY: all test test-fast lint lint-json lint-update-baseline bench bench-all bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
 
 all: native test
 
@@ -14,10 +14,19 @@ test:
 test-fast:
 	$(PY) -m pytest tests/ -x -q -p no:cacheprovider
 
-# In-tree linter (no linter ships in this image): syntax, unused/dup
-# module-level imports, bare except, `== None`, mutable defaults.
+# In-tree static analyzer (no linter ships in this image): rule engine
+# with JAX hot-path (JX*), lock-discipline (CC*), metrics/measurement
+# (MX*), and hygiene (PY*) analyzers; scoped `# noqa: <RULE-ID>`
+# suppression and a shrink-only baseline (tools/analysis/baseline.json).
+# Catalog: docs/static-analysis.md. `lint-json` emits machine output.
 lint:
-	$(PY) tools/lint.py
+	$(PY) -m tools.analysis
+
+lint-json:
+	$(PY) -m tools.analysis --format=json
+
+lint-update-baseline:
+	$(PY) -m tools.analysis --update-baseline
 
 # Headline benchmark (driver contract: one JSON line) — real device.
 bench:

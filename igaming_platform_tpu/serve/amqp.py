@@ -540,9 +540,13 @@ class AmqpPublisher:
         transactional-outbox relay targets (outbox.py OutboxRelay)."""
         body = payload.encode()
         last: Exception | None = None
-        with self._lock:
-            for attempt in range(1 + self.max_retries):
-                try:
+        # The lock serializes channel use per ATTEMPT, not across the
+        # whole retry loop: holding it through the backoff sleep would
+        # convoy every other publishing thread behind one broker outage
+        # (flagged by CC02 — blocking call under lock).
+        for attempt in range(1 + self.max_retries):
+            try:
+                with self._lock:
                     if not self._conn.connected:
                         raise AmqpConnectionClosed("not connected")
                     self._conn.publish(exchange, routing_key, body, persistent=True)
@@ -550,17 +554,18 @@ class AmqpPublisher:
                         raise AmqpError("broker nacked publish")
                     self.published += 1
                     return
-                except (AmqpConnectionClosed, AmqpError, OSError) as exc:
-                    last = exc
-                    if attempt == self.max_retries:
-                        break
-                    # Linear backoff reconnect (publisher.go:91-108).
-                    time.sleep(self.retry_delay * (attempt + 1))
-                    try:
+            except (AmqpConnectionClosed, AmqpError, OSError) as exc:
+                last = exc
+                if attempt == self.max_retries:
+                    break
+                # Linear backoff reconnect (publisher.go:91-108).
+                time.sleep(self.retry_delay * (attempt + 1))
+                try:
+                    with self._lock:
                         self._connect()
                         self.reconnects += 1
-                    except (AmqpError, OSError) as rexc:
-                        last = rexc
+                except (AmqpError, OSError) as rexc:
+                    last = rexc
         raise AmqpError(f"publish failed after {self.max_retries} retries: {last}")
 
     def close(self) -> None:

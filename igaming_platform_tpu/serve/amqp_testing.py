@@ -270,8 +270,11 @@ class _ClientConn:
         frame = (
             struct.pack(">BHI", ftype, channel, len(payload)) + payload + bytes([FRAME_END])
         )
+        # Deliberate: _wlock exists precisely to serialize whole-frame
+        # socket writes — interleaved frames from concurrent deliver
+        # threads would corrupt the AMQP wire.
         with self._wlock:
-            self.sock.sendall(frame)
+            self.sock.sendall(frame)  # noqa: CC02
 
     def _send_method(self, channel: int, cm: tuple[int, int], args: bytes = b"") -> None:
         self._send_frame(FRAME_METHOD, channel, struct.pack(">HH", *cm) + args)

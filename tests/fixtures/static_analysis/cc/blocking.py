@@ -1,0 +1,37 @@
+"""Seeded CC02 violations: blocking calls inside lock regions."""
+
+import queue
+import threading
+import time
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inbox = queue.Queue()
+        self._ready = threading.Event()
+        self.done = 0
+
+    def bad_sleep(self):
+        with self._lock:
+            time.sleep(0.1)  # expect: CC02
+            self.done += 1
+
+    def bad_queue_wait(self):
+        with self._lock:
+            item = self._inbox.get()  # expect: CC02
+        return item
+
+    def bad_event_wait(self):
+        self._lock.acquire()
+        self._ready.wait()  # expect: CC02
+        self._lock.release()
+
+    def good(self):
+        # Sleep and queue waits OUTSIDE the critical section are fine.
+        time.sleep(0.1)
+        item = self._inbox.get()
+        with self._lock:
+            self.done += 1
+        nxt = self._inbox.get(block=False)
+        return item, nxt

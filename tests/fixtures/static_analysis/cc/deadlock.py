@@ -1,0 +1,41 @@
+"""The batcher->metrics / metrics->batcher nesting shape from the real
+serving layer: the batcher records a batch metric while holding its
+queue lock, and the metrics registry reads the batcher's queue depth
+while holding its series lock. Each direction alone is fine; together
+they form a lock-order cycle (CC01) that deadlocks the moment a scrape
+races a batch."""
+
+import threading
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._series = {}
+        self.batcher = None
+
+    def observe(self, name, value):
+        with self._lock:
+            self._series[name] = value
+            # Reaches back into the batcher under the series lock:
+            # MetricsRegistry._lock -> Batcher._lock.
+            depth = self.batcher.queue_depth()
+            self._series["queue_depth"] = depth
+
+
+class Batcher:
+    def __init__(self, metrics):
+        self._lock = threading.Lock()
+        self._pending = []
+        self.metrics = metrics
+
+    def add(self, item):
+        with self._lock:
+            self._pending.append(item)
+            # Records a metric under the queue lock:
+            # Batcher._lock -> MetricsRegistry._lock.
+            self.metrics.observe("batch_rows", len(self._pending))
+
+    def queue_depth(self):
+        with self._lock:
+            return len(self._pending)

@@ -1,0 +1,36 @@
+"""Seeded CC03 violation: an attribute written both under a lock and
+without it, plus the compliant private-helper pattern."""
+
+import threading
+
+
+class RacyCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def inc(self):
+        with self._lock:
+            self.value += 1
+
+    def reset(self):
+        self.value = 0  # expect: CC03
+
+
+class GuardedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def add(self, n):
+        with self._lock:
+            self._bump(n)
+
+    def other_add(self, n):
+        with self._lock:
+            self._bump(2 * n)
+
+    def _bump(self, n):
+        # Every in-class call site holds the lock, so this private
+        # helper inherits the guard — no CC03.
+        self.total += n

@@ -1,0 +1,26 @@
+"""Compliant siblings of jx/hot.py — every pattern the JX rules must
+stay quiet on."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def quiet_step(x):
+    # Pure jnp math: no side effects, no host syncs.
+    return jnp.tanh(x) + jnp.sum(x)
+
+
+@functools.partial(jax.jit, static_argnames=("scales", "n"))
+def good_static(x, n, scales=(1.0, 2.0)):
+    # Tuple static default is hashable; int() on a STATIC argument is a
+    # trace-time Python conversion, not a device sync.
+    return x * scales[0] * int(n)
+
+
+def host_side_report(x):
+    # Not reachable from any jit root: printing here is fine.
+    print("host-side summary", x)
+    return x

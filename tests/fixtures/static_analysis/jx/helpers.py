@@ -1,0 +1,12 @@
+"""Helper reached from a jit root in jx/hot.py — violations here prove
+the reachability walk crosses files, not just decorated shells."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def leaky_norm(v):
+    peak = float(v)  # expect: JX02
+    host = np.asarray(v)  # expect: JX02
+    return jnp.tanh(v) / (peak + host.size)

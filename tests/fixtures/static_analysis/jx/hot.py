@@ -1,0 +1,36 @@
+"""Seeded JX violations: side effects and host syncs in jit-traced code."""
+
+import functools
+import logging
+import time
+
+import jax
+import jax.numpy as jnp
+
+from jx.helpers import leaky_norm
+
+logger = logging.getLogger(__name__)
+
+
+@jax.jit
+def noisy_step(x):
+    print("tracing", x)  # expect: JX01
+    logger.info("scoring batch")  # expect: JX01
+    t = time.perf_counter()  # expect: JX01
+    s = jnp.sum(x).item()  # expect: JX02
+    return leaky_norm(x) * t * s
+
+
+_COUNT = 0
+
+
+@jax.jit
+def counting_step(x):
+    global _COUNT  # expect: JX03
+    _COUNT += 1
+    return x * 2
+
+
+@functools.partial(jax.jit, static_argnames=("scales",))
+def scaled_step(x, scales=[1.0, 2.0]):  # expect: JX04,PY05
+    return x * scales[0]

@@ -1,0 +1,25 @@
+"""Seeded MX violations. The directory is named igaming_platform_tpu on
+purpose: MX03 (orphan metric) only applies to production-package paths.
+``txns``/``rate`` reproduce the pre-v2 false negative — a keyword or
+non-literal metric name used to skip the help-text check entirely."""
+
+import time
+
+from igaming_platform_tpu.obs.metrics import Counter, Registry
+
+SERIES_NAME = "bulk_rate"
+
+registry = Registry()
+
+txns = registry.counter(name="txns_total")  # expect: MX02
+rate = registry.gauge(SERIES_NAME)  # expect: MX02
+lat = registry.histogram("latency_ms", "")  # expect: MX02
+orphan = Counter("orphan_total", "never joins a registry")  # expect: MX03
+
+
+def timed_step(fn, x):
+    t0 = time.perf_counter()
+    y = fn(x)
+    y.block_until_ready()  # expect: MX01
+    t1 = time.perf_counter()
+    return (t1 - t0, y)

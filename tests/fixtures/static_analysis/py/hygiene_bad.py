@@ -1,0 +1,16 @@
+"""Seeded PY hygiene violations."""
+
+import os  # expect: PY01
+import json
+import json  # expect: PY02
+
+
+def parse(data=[]):  # expect: PY05
+    try:
+        return json.loads(data) if data != None else None  # expect: PY04
+    except:  # expect: PY03
+        return None
+
+
+def legacy(raw):
+    return parse(raw) if raw != None else None  # noqa — expect: PY06

@@ -1,0 +1,13 @@
+"""Compliant siblings of hygiene_bad.py, including a correctly SCOPED
+suppression: the unused import below is deliberate (import-for-side-
+effect) and silenced for exactly one rule."""
+
+import json
+import sys  # noqa: PY01 — deliberate side-effect import for the test
+
+
+def parse(data=None):
+    try:
+        return json.loads(data) if data is not None else None
+    except ValueError:
+        return None

@@ -1,0 +1,202 @@
+"""Tier-1 gate for the in-tree static analyzer (tools/analysis).
+
+Three layers:
+
+1. the repo itself must analyze CLEAN (zero non-baselined findings) —
+   this is the gate that keeps jit side effects, lock-order inversions,
+   and measurement traps out of the serving path;
+2. the seeded fixture corpus (tests/fixtures/static_analysis) must
+   produce EXACTLY the findings its ``# expect: RULE`` markers declare —
+   every rule fires where seeded and stays quiet on the compliant
+   siblings;
+3. the suppression and baseline machinery: scoped ``# noqa: <ID>``,
+   legacy flake8 aliases, bare-noqa-as-finding, grandfathering, and the
+   shrink-only stale-baseline contract.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+
+from tools.analysis import baseline as baseline_mod
+from tools.analysis.driver import main as cli_main
+from tools.analysis.driver import run_analysis
+from tools.analysis.engine import RULES
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "fixtures" / "static_analysis"
+
+_EXPECT = re.compile(r"expect:\s*([A-Z0-9, ]+)")
+
+
+# ---------------------------------------------------------------------------
+# Layer 1: the repo gate
+
+
+def test_repo_analyzes_clean_and_fast():
+    report = run_analysis()
+    rendered = "\n".join(f.render() for f in report.new + report.syntax_errors)
+    assert not report.failed, (
+        f"static analysis found non-baselined problems:\n{rendered}\n"
+        f"stale baseline entries: {report.stale}")
+    assert report.files > 150  # the scan actually covered the repo
+    assert report.elapsed_s < 15.0, (
+        f"analysis took {report.elapsed_s:.1f}s — the <15s tier-1 budget")
+
+
+def test_rule_catalog_is_wellformed():
+    assert {"JX01", "JX02", "JX03", "JX04", "CC01", "CC02", "CC03",
+            "MX01", "MX02", "MX03", "PY01", "PY06"} <= set(RULES)
+    for rid, r in RULES.items():
+        assert r.category in ("JX", "CC", "MX", "PY"), rid
+        assert r.rationale and r.name, rid
+        assert r.scope in ("file", "project"), rid
+    # Legacy flake8 spellings keep working through aliases.
+    assert "F401" in RULES["PY01"].aliases
+    assert "E722" in RULES["PY03"].aliases
+
+
+# ---------------------------------------------------------------------------
+# Layer 2: the seeded fixture corpus
+
+
+def _expected_markers() -> set[tuple[str, int, str]]:
+    expected: set[tuple[str, int, str]] = set()
+    for path in sorted(FIXTURES.rglob("*.py")):
+        rel = path.relative_to(FIXTURES).as_posix()
+        for lineno, line in enumerate(path.read_text().splitlines(), start=1):
+            m = _EXPECT.search(line)
+            if m:
+                for rule_id in m.group(1).replace(" ", "").split(","):
+                    if rule_id:
+                        expected.add((rel, lineno, rule_id))
+    return expected
+
+
+def test_fixture_corpus_fires_exactly_where_seeded():
+    report = run_analysis([FIXTURES])
+    actual = {(f.path, f.line, f.rule) for f in report.new
+              if f.rule != "CC01"}  # CC01 asserted separately (one
+    # finding per cycle, anchored at one of its sites)
+    expected = _expected_markers()
+    assert expected, "fixture corpus lost its expect markers"
+    missing = expected - actual
+    unexpected = actual - expected
+    assert not missing, f"rules failed to fire where seeded: {sorted(missing)}"
+    assert not unexpected, (
+        "rules fired on compliant code (false positives): "
+        f"{sorted(unexpected)}")
+    # Every new analyzer rule is exercised by the corpus.
+    covered = {r for _, _, r in expected} | {"CC01"}
+    assert {"JX01", "JX02", "JX03", "JX04", "CC01", "CC02", "CC03",
+            "MX01", "MX02", "MX03"} <= covered
+
+
+def test_lock_cycle_report_names_both_acquisition_sites():
+    """Satellite: the batcher->metrics / metrics->batcher nesting fixture
+    must yield a cycle naming BOTH acquisition sites with file:line."""
+    report = run_analysis([FIXTURES])
+    cycles = [f for f in report.new if f.rule == "CC01"]
+    assert len(cycles) == 1, [f.render() for f in cycles]
+    msg = cycles[0].message
+    src = (FIXTURES / "cc" / "deadlock.py").read_text().splitlines()
+    batcher_site = next(i for i, l in enumerate(src, 1)
+                        if "self.metrics.observe(" in l)
+    metrics_site = next(i for i, l in enumerate(src, 1)
+                        if "self.batcher.queue_depth()" in l)
+    assert f"cc/deadlock.py:{batcher_site}" in msg
+    assert f"cc/deadlock.py:{metrics_site}" in msg
+    assert "Batcher._lock" in msg and "MetricsRegistry._lock" in msg
+
+
+# ---------------------------------------------------------------------------
+# Layer 3: suppression + baseline machinery
+
+
+def _analyze_snippet(tmp_path: Path, source: str):
+    (tmp_path / "snippet.py").write_text(source)
+    return run_analysis([tmp_path])
+
+
+def test_scoped_suppression_silences_only_the_named_rule(tmp_path):
+    # Wrong rule named: the finding survives.
+    r = _analyze_snippet(tmp_path, "x = 1\ny = x == None  # noqa: PY01\n")
+    assert [f.rule for f in r.new] == ["PY04"]
+    # Right rule named: silenced.
+    r = _analyze_snippet(tmp_path, "x = 1\ny = x == None  # noqa: PY04\n")
+    assert r.new == []
+
+
+def test_legacy_flake8_codes_work_as_aliases(tmp_path):
+    r = _analyze_snippet(tmp_path, "import os  # noqa: F401\n")
+    assert r.new == []
+
+
+def test_bare_noqa_suppresses_but_is_itself_a_finding(tmp_path):
+    r = _analyze_snippet(
+        tmp_path,
+        "try:\n    pass\nexcept:  # noqa\n    pass\n")
+    assert [f.rule for f in r.new] == ["PY06"]  # PY03 silenced, PY06 on
+
+
+def test_metric_name_kwarg_no_longer_skips_help_check(tmp_path):
+    """Satellite: the pre-v2 linter required a positional string-literal
+    metric name, so kwarg or variable names dodged the help-text rule."""
+    bad = (
+        "registry = object()\n"
+        "a = registry.counter(name='x_total')\n"
+        "NAME = 'y_total'\n"
+        "b = registry.gauge(NAME)\n")
+    r = _analyze_snippet(tmp_path, bad)
+    assert [f.rule for f in r.new] == ["MX02", "MX02"]
+    ok = "a = registry.counter(name='x_total', help_text='things counted')\n"
+    (tmp_path / "snippet.py").write_text(ok)
+    assert run_analysis([tmp_path]).new == []
+
+
+def test_baseline_grandfathers_then_stale_entry_fails(tmp_path):
+    """Satellite: --update-baseline flow; a baseline entry whose finding
+    was fixed FAILS the run until removed — the baseline only shrinks."""
+    src_dir = tmp_path / "src"
+    src_dir.mkdir()
+    target = src_dir / "mod.py"
+    target.write_text("x = 1\ny = x == None\n")
+    bl = tmp_path / "baseline.json"
+
+    first = run_analysis([src_dir])
+    assert first.failed and [f.rule for f in first.new] == ["PY04"]
+
+    baseline_mod.write(bl, first.new)
+    grandfathered = run_analysis([src_dir], baseline_path=bl)
+    assert not grandfathered.failed
+    assert [f.rule for f in grandfathered.baselined] == ["PY04"]
+
+    target.write_text("x = 1\ny = x is None\n")  # the fix lands
+    stale = run_analysis([src_dir], baseline_path=bl)
+    assert stale.failed and not stale.new
+    assert len(stale.stale) == 1 and stale.stale[0]["rule"] == "PY04"
+
+    # --update-baseline shrinks it back and the run goes green.
+    assert cli_main([str(src_dir), "--baseline", str(bl),
+                     "--update-baseline"]) == 0
+    assert baseline_mod.load(bl) == []
+    assert not run_analysis([src_dir], baseline_path=bl).failed
+
+
+def test_cli_exit_codes_and_json_output(tmp_path, capsys):
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    (clean / "ok.py").write_text("x = 1\n")
+    assert cli_main([str(clean)]) == 0
+    capsys.readouterr()
+
+    assert cli_main([str(FIXTURES), "--format=json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["exit_code"] == 1
+    fired = {f["rule"] for f in payload["findings"]}
+    assert {"JX01", "CC01", "MX02", "PY06"} <= fired
+    assert payload["rules"]["JX02"]["scope"] == "project"
+    for f in payload["findings"]:
+        assert {"rule", "path", "line", "message", "fingerprint"} <= set(f)

@@ -1,0 +1,1 @@
+"""In-tree developer tooling (static analysis, release golden capture)."""

@@ -1,0 +1,12 @@
+"""In-tree static analyzer: a rule engine with JAX hot-path (JX*),
+concurrency (CC*), metrics/measurement (MX*), and hygiene (PY*)
+analyzers. Entry points: ``python -m tools.analysis`` / ``make lint``;
+programmatic: :func:`tools.analysis.driver.run_analysis`.
+
+Rule catalog and suppression/baseline policy: docs/static-analysis.md.
+"""
+
+from tools.analysis.driver import main, run_analysis
+from tools.analysis.engine import RULES, Finding
+
+__all__ = ["main", "run_analysis", "RULES", "Finding"]

@@ -1,0 +1,79 @@
+"""Baseline handling: grandfathered findings that don't fail the run.
+
+The baseline is a checked-in JSON file of finding fingerprints. The
+contract keeps it shrink-only:
+
+- a finding matching a baseline entry is reported as "baselined" and
+  does not fail the run;
+- a NEW finding (no entry) fails the run;
+- a STALE entry (no current finding matches it) ALSO fails the run —
+  the fix landed, so the entry must be deleted (``--update-baseline``),
+  otherwise the grandfather list would silently re-admit regressions.
+
+Matching is by fingerprint with multiplicity: two identical findings
+need two entries.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tools.analysis.engine import Finding
+
+
+@dataclass
+class BaselineMatch:
+    new: list[Finding] = field(default_factory=list)
+    baselined: list[Finding] = field(default_factory=list)
+    stale: list[dict] = field(default_factory=list)
+
+
+def load(path: Path) -> list[dict]:
+    if not path.exists():
+        return []
+    data = json.loads(path.read_text(encoding="utf-8"))
+    entries = data.get("entries", []) if isinstance(data, dict) else data
+    if not isinstance(entries, list):
+        raise ValueError(f"{path}: baseline `entries` must be a list")
+    return entries
+
+
+def match(findings: list[Finding], entries: list[dict]) -> BaselineMatch:
+    budget = Counter(e.get("fingerprint") for e in entries)
+    result = BaselineMatch()
+    for f in findings:
+        if budget.get(f.fingerprint, 0) > 0:
+            budget[f.fingerprint] -= 1
+            result.baselined.append(f)
+        else:
+            result.new.append(f)
+    for e in entries:
+        fp = e.get("fingerprint")
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            result.stale.append(e)
+    return result
+
+
+def write(path: Path, findings: list[Finding]) -> None:
+    entries = [
+        {
+            "rule": f.rule,
+            "path": f.path,
+            "fingerprint": f.fingerprint,
+            "message": f.message,
+        }
+        for f in sorted(findings, key=lambda f: (f.path, f.rule, f.message))
+    ]
+    payload = {
+        "_comment": (
+            "Grandfathered findings. Shrink-only: a stale entry (finding "
+            "fixed) fails the run until removed via --update-baseline. "
+            "See docs/static-analysis.md."),
+        "version": 1,
+        "entries": entries,
+    }
+    path.write_text(json.dumps(payload, indent=2) + "\n", encoding="utf-8")

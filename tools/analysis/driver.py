@@ -1,0 +1,218 @@
+"""Analysis driver: discover files, parse each exactly once, run every
+registered rule, apply the baseline, render text or JSON.
+
+Two modes:
+
+- **repo mode** (no paths given): scans the repo's source roots with the
+  checked-in ``tools/analysis/baseline.json``, the JX rules rooted at
+  the serving hot path (serve/, models/, ops/, parallel/) and the CC
+  rules scoped to serve/ + obs/;
+- **explicit-path mode** (paths given, e.g. the test fixture corpus):
+  scans every ``*.py`` under the given paths with no scoping and no
+  baseline unless ``--baseline`` is passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tools.analysis import baseline as baseline_mod
+from tools.analysis import rules as _rules  # noqa: PY01 — registers rules
+from tools.analysis.engine import (
+    FileContext, Finding, ProjectContext, RULES, parse_suppressions, run_rules,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+ROOTS = ("igaming_platform_tpu", "benchmarks", "tests", "tools")
+TOP_FILES = ("bench.py", "__graft_entry__.py")
+# proto_gen is generated; the fixture corpus under tests/ is a zoo of
+# deliberate violations the driver must not trip over in repo mode.
+EXCLUDED_PARTS = {"proto_gen", "fixtures"}
+
+REPO_CONFIG = {
+    "jx_scope": (
+        "igaming_platform_tpu/serve/", "igaming_platform_tpu/models/",
+        "igaming_platform_tpu/ops/", "igaming_platform_tpu/parallel/",
+    ),
+    "cc_scope": ("igaming_platform_tpu/serve/", "igaming_platform_tpu/obs/"),
+}
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+@dataclass
+class Report:
+    files: int
+    new: list[Finding]
+    baselined: list[Finding]
+    stale: list[dict]
+    syntax_errors: list[Finding]
+    elapsed_s: float = 0.0
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.new or self.stale or self.syntax_errors)
+
+    def all_findings(self) -> list[Finding]:
+        return sorted(self.syntax_errors + self.new + self.baselined,
+                      key=lambda f: (f.path, f.line, f.rule))
+
+
+@dataclass
+class _Discovery:
+    root: Path
+    files: list[Path] = field(default_factory=list)
+
+
+def _discover_repo() -> _Discovery:
+    d = _Discovery(REPO_ROOT)
+    d.files = [REPO_ROOT / f for f in TOP_FILES if (REPO_ROOT / f).exists()]
+    for root in ROOTS:
+        d.files.extend(sorted((REPO_ROOT / root).rglob("*.py")))
+    d.files = [f for f in d.files if not (EXCLUDED_PARTS & set(f.parts))]
+    return d
+
+
+def _discover_paths(paths: list[Path]) -> _Discovery:
+    root = paths[0] if paths[0].is_dir() else paths[0].parent
+    d = _Discovery(root.resolve())
+    for p in paths:
+        p = p.resolve()
+        if p.is_dir():
+            d.files.extend(sorted(p.rglob("*.py")))
+        else:
+            d.files.append(p)
+    return d
+
+
+def _module_name(relpath: str) -> str:
+    parts = relpath[:-3].split("/")  # strip .py
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def build_project(discovery: _Discovery,
+                  config: dict | None = None) -> tuple[ProjectContext, list[Finding]]:
+    """Parse every file once. Returns the project plus PY00 findings for
+    files that don't parse (those are excluded from the project)."""
+    contexts: list[FileContext] = []
+    syntax_errors: list[Finding] = []
+    for path in discovery.files:
+        try:
+            relpath = path.relative_to(discovery.root).as_posix()
+        except ValueError:
+            relpath = path.name
+        src = path.read_text(encoding="utf-8")
+        try:
+            tree = ast.parse(src, filename=str(path))
+        except SyntaxError as exc:
+            syntax_errors.append(Finding(
+                "PY00", relpath, exc.lineno or 0, f"syntax error: {exc.msg}"))
+            continue
+        suppressions, bare = parse_suppressions(src)
+        contexts.append(FileContext(
+            path=path, relpath=relpath, module=_module_name(relpath),
+            src=src, tree=tree, suppressions=suppressions,
+            bare_noqa_lines=bare))
+    project = ProjectContext(root=discovery.root, files=contexts)
+    project.caches["config"] = dict(config or {})
+    return project, syntax_errors
+
+
+def run_analysis(paths: list[Path] | None = None,
+                 baseline_path: Path | None = None,
+                 config: dict | None = None,
+                 no_baseline: bool = False) -> Report:
+    t0 = time.perf_counter()
+    if paths:
+        discovery = _discover_paths(paths)
+        cfg = config if config is not None else {}
+        entries = baseline_mod.load(baseline_path) if baseline_path else []
+    else:
+        discovery = _discover_repo()
+        cfg = config if config is not None else REPO_CONFIG
+        entries = baseline_mod.load(baseline_path or DEFAULT_BASELINE)
+    if no_baseline:
+        entries = []
+    project, syntax_errors = build_project(discovery, cfg)
+    findings = run_rules(project)
+    matched = baseline_mod.match(findings, entries)
+    return Report(
+        files=len(discovery.files), new=matched.new,
+        baselined=matched.baselined, stale=matched.stale,
+        syntax_errors=syntax_errors,
+        elapsed_s=time.perf_counter() - t0)
+
+
+def _render_text(report: Report) -> str:
+    lines = [f.render() for f in report.syntax_errors + report.new]
+    for e in report.stale:
+        lines.append(
+            f"{e.get('path')}: stale baseline entry {e.get('fingerprint')} "
+            f"({e.get('rule')}: {e.get('message', '')[:60]}...) — the "
+            "finding is gone; remove it via --update-baseline")
+    summary = (
+        f"analysis: {report.files} files, "
+        f"{len(report.new) + len(report.syntax_errors)} problems")
+    if report.baselined:
+        summary += f", {len(report.baselined)} baselined"
+    if report.stale:
+        summary += f", {len(report.stale)} stale baseline entries"
+    summary += f" ({report.elapsed_s:.2f}s)"
+    lines.append(summary)
+    return "\n".join(lines)
+
+
+def _render_json(report: Report) -> str:
+    return json.dumps({
+        "files": report.files,
+        "elapsed_s": round(report.elapsed_s, 3),
+        "findings": [f.to_json() for f in report.syntax_errors + report.new],
+        "baselined": [f.to_json() for f in report.baselined],
+        "stale_baseline": report.stale,
+        "rules": {
+            r.id: {"name": r.name, "scope": r.scope,
+                   "aliases": sorted(r.aliases)}
+            for r in RULES.values()
+        },
+        "exit_code": 1 if report.failed else 0,
+    }, indent=2)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="In-tree static analyzer (rule catalog: "
+                    "docs/static-analysis.md)")
+    parser.add_argument("paths", nargs="*", type=Path,
+                        help="files/dirs to scan (default: the repo roots)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help="baseline JSON (default: tools/analysis/"
+                             "baseline.json in repo mode, none otherwise)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore any baseline")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline to the current findings "
+                             "and exit 0")
+    args = parser.parse_args(argv)
+
+    report = run_analysis(args.paths or None, baseline_path=args.baseline,
+                          no_baseline=args.no_baseline)
+
+    if args.update_baseline:
+        target = args.baseline or DEFAULT_BASELINE
+        baseline_mod.write(target, report.new + report.baselined)
+        print(f"baseline: wrote {len(report.new) + len(report.baselined)} "
+              f"entries to {target}")
+        return 0
+
+    print(_render_text(report) if args.format == "text"
+          else _render_json(report))
+    return 1 if report.failed else 0

@@ -1,0 +1,299 @@
+"""Call-reachability graph rooted at jit/pjit/shard_map entry points.
+
+Shared infrastructure for the JX* rules: finds every function that jax
+will trace — ``@jax.jit``-style decorators (including the
+``functools.partial(jax.jit, ...)`` idiom), ``jax.jit(f)`` /
+``shard_map(f, ...)`` wrap calls on named functions and lambdas — and
+walks the Python call graph from those roots so violations are reported
+in helpers too, not just the decorated shell.
+
+Resolution is deliberately conservative: a call edge is followed only
+when the callee resolves unambiguously to a function defined in the
+scanned project — plain names bound in the same file (defs and
+``name = lambda`` assignments), ``from mod import f`` names, and
+``mod.f`` attribute calls through an imported in-project module. Method
+calls through objects (``self.fn(...)``) are not followed; a missed edge
+costs a finding, a wrong edge invents one.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.analysis.engine import FileContext, ProjectContext, dotted_name
+
+_JIT_WRAPPERS = {"jit", "pjit"}
+_SHARD_MAP = {"shard_map"}
+
+
+@dataclass
+class FuncInfo:
+    ctx: FileContext
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    qualname: str
+    params: tuple[str, ...] = ()
+    # Filled for jit roots: params jit treats as static (hashable Python
+    # values, not tracers) — host conversions on them are legitimate.
+    static_params: frozenset[str] = frozenset()
+    root_reason: str = ""
+
+
+@dataclass
+class _FileIndex:
+    defs: dict[str, list[FuncInfo]] = field(default_factory=dict)
+    lambdas: dict[str, FuncInfo] = field(default_factory=dict)
+    # name -> source module (from X import name / import X.Y as name)
+    from_imports: dict[str, tuple[str, str]] = field(default_factory=dict)
+    module_aliases: dict[str, str] = field(default_factory=dict)
+
+
+def _param_names(node: ast.AST) -> tuple[str, ...]:
+    args = node.args
+    names = [a.arg for a in getattr(args, "posonlyargs", [])]
+    names += [a.arg for a in args.args]
+    names += [a.arg for a in args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return tuple(names)
+
+
+def _positional_param(node: ast.AST, idx: int) -> str | None:
+    args = node.args
+    pos = [a.arg for a in getattr(args, "posonlyargs", [])] + [a.arg for a in args.args]
+    if 0 <= idx < len(pos):
+        return pos[idx]
+    return None
+
+
+def _is_jit_callee(expr: ast.AST) -> bool:
+    """Is ``expr`` a reference to jit/pjit (``jit``, ``jax.jit``, ...)?"""
+    name = dotted_name(expr)
+    if name is None:
+        return False
+    return name.split(".")[-1] in _JIT_WRAPPERS
+
+
+def _is_shard_map_callee(expr: ast.AST) -> bool:
+    name = dotted_name(expr)
+    return name is not None and name.split(".")[-1] in _SHARD_MAP
+
+
+def _static_names_from_call(call: ast.Call, fn_node: ast.AST | None) -> set[str]:
+    """static_argnames/static_argnums keywords of a jit(...) call,
+    resolved against ``fn_node``'s positional parameters."""
+    names: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for el in _constant_elements(kw.value):
+                if isinstance(el, str):
+                    names.add(el)
+        elif kw.arg == "static_argnums" and fn_node is not None:
+            for el in _constant_elements(kw.value):
+                if isinstance(el, int):
+                    p = _positional_param(fn_node, el)
+                    if p:
+                        names.add(p)
+    return names
+
+
+def _constant_elements(node: ast.AST) -> list:
+    if isinstance(node, ast.Constant):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [e.value for e in node.elts if isinstance(e, ast.Constant)]
+    return []
+
+
+class JaxGraph:
+    """Jit roots + the set of project functions reachable from them."""
+
+    def __init__(self, project: ProjectContext):
+        self.project = project
+        self._index: dict[str, _FileIndex] = {}
+        self.roots: list[FuncInfo] = []
+        # id(ast node) -> FuncInfo, for everything reachable from a root.
+        self.reachable: dict[int, FuncInfo] = {}
+        for ctx in project.files:
+            self._index[ctx.relpath] = self._index_file(ctx)
+        # Root discovery can be scoped (the serving hot path); the
+        # reachability walk still crosses into any scanned file.
+        config = project.caches.get("config", {})
+        prefixes = config.get("jx_scope")
+        for ctx in project.files:
+            if prefixes and not any(ctx.relpath.startswith(p) for p in prefixes):
+                continue
+            self._find_roots(ctx)
+        self._walk_reachability()
+
+    # -- indexing ------------------------------------------------------------
+
+    def _index_file(self, ctx: FileContext) -> _FileIndex:
+        idx = _FileIndex()
+        parents: list[str] = []
+
+        def visit(node: ast.AST, qual: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    q = f"{qual}.{child.name}" if qual else child.name
+                    info = FuncInfo(ctx, child, q, _param_names(child))
+                    idx.defs.setdefault(child.name, []).append(info)
+                    visit(child, q)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{qual}.{child.name}" if qual else child.name)
+                else:
+                    if (isinstance(child, ast.Assign)
+                            and isinstance(child.value, ast.Lambda)):
+                        for t in child.targets:
+                            if isinstance(t, ast.Name):
+                                info = FuncInfo(
+                                    ctx, child.value, f"{qual}.{t.id}<lambda>"
+                                    if qual else f"{t.id}<lambda>",
+                                    _param_names(child.value))
+                                idx.lambdas[t.id] = info
+                    visit(child, qual)
+
+        visit(ctx.tree, "")
+        del parents
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for alias in node.names:
+                    if alias.name != "*":
+                        idx.from_imports[alias.asname or alias.name] = (
+                            node.module, alias.name)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    idx.module_aliases[
+                        alias.asname or alias.name.split(".")[0]] = alias.name
+        return idx
+
+    # -- root discovery ------------------------------------------------------
+
+    def _add_root(self, info: FuncInfo, reason: str, static: set[str]) -> None:
+        info.root_reason = reason
+        info.static_params = frozenset(info.static_params | static)
+        self.roots.append(info)
+
+    def _find_roots(self, ctx: FileContext) -> None:
+        idx = self._index[ctx.relpath]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    static: set[str] = set()
+                    hit = False
+                    if _is_jit_callee(dec) or _is_shard_map_callee(dec):
+                        hit = True
+                    elif isinstance(dec, ast.Call):
+                        if _is_jit_callee(dec.func) or _is_shard_map_callee(dec.func):
+                            hit = True
+                            static = _static_names_from_call(dec, node)
+                        elif (dotted_name(dec.func) or "").split(".")[-1] == "partial":
+                            # functools.partial(jax.jit, static_argnames=...)
+                            if any(_is_jit_callee(a) for a in dec.args):
+                                hit = True
+                                static = _static_names_from_call(dec, node)
+                    if hit:
+                        info = self._info_for_def(ctx, node)
+                        self._add_root(
+                            info, f"decorated at {ctx.relpath}:{dec.lineno}",
+                            static)
+                        break
+            elif isinstance(node, ast.Call) and (
+                    _is_jit_callee(node.func) or _is_shard_map_callee(node.func)):
+                if not node.args:
+                    continue
+                target = node.args[0]
+                if isinstance(target, ast.Lambda):
+                    info = FuncInfo(ctx, target, f"<lambda@{target.lineno}>",
+                                    _param_names(target))
+                    self._add_root(
+                        info, f"wrapped at {ctx.relpath}:{node.lineno}",
+                        _static_names_from_call(node, target))
+                elif isinstance(target, ast.Name):
+                    for info in self._resolve_name(ctx, target.id):
+                        self._add_root(
+                            info, f"wrapped at {ctx.relpath}:{node.lineno}",
+                            _static_names_from_call(node, info.node))
+
+    def _info_for_def(self, ctx: FileContext, node: ast.AST) -> FuncInfo:
+        for infos in self._index[ctx.relpath].defs.values():
+            for info in infos:
+                if info.node is node:
+                    return info
+        # Unreached in practice; defensive for exotic nesting.
+        return FuncInfo(ctx, node, getattr(node, "name", "<fn>"),
+                        _param_names(node))
+
+    # -- call resolution -----------------------------------------------------
+
+    def _resolve_name(self, ctx: FileContext, name: str) -> list[FuncInfo]:
+        idx = self._index[ctx.relpath]
+        if name in idx.defs:
+            return idx.defs[name]
+        if name in idx.lambdas:
+            return [idx.lambdas[name]]
+        if name in idx.from_imports:
+            module, orig = idx.from_imports[name]
+            target = self.project.resolve_module(module)
+            if target is not None:
+                tidx = self._index[target.relpath]
+                if orig in tidx.defs:
+                    return tidx.defs[orig]
+                if orig in tidx.lambdas:
+                    return [tidx.lambdas[orig]]
+        return []
+
+    def _resolve_call(self, ctx: FileContext, call: ast.Call) -> list[FuncInfo]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            return self._resolve_name(ctx, fn.id)
+        dotted = dotted_name(fn)
+        if dotted and "." in dotted:
+            base, attr = dotted.rsplit(".", 1)
+            idx = self._index[ctx.relpath]
+            module: str | None = None
+            if base in idx.module_aliases:
+                module = idx.module_aliases[base]
+            elif base in idx.from_imports:
+                mod, orig = idx.from_imports[base]
+                module = f"{mod}.{orig}"
+            if module is not None:
+                target = self.project.resolve_module(module)
+                if target is not None:
+                    tidx = self._index[target.relpath]
+                    if attr in tidx.defs:
+                        return tidx.defs[attr]
+                    if attr in tidx.lambdas:
+                        return [tidx.lambdas[attr]]
+        return []
+
+    # -- reachability --------------------------------------------------------
+
+    def _walk_reachability(self) -> None:
+        work = list(self.roots)
+        for info in work:
+            self.reachable.setdefault(id(info.node), info)
+        while work:
+            info = work.pop()
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                for callee in self._resolve_call(info.ctx, node):
+                    if id(callee.node) in self.reachable:
+                        continue
+                    # Inherit the root attribution for the report.
+                    callee.root_reason = (
+                        f"reachable via {info.qualname} "
+                        f"({info.root_reason or 'jit root'})")
+                    self.reachable[id(callee.node)] = callee
+                    work.append(callee)
+
+
+def jax_graph(project: ProjectContext) -> JaxGraph:
+    graph = project.caches.get("jaxgraph")
+    if graph is None:
+        graph = JaxGraph(project)
+        project.caches["jaxgraph"] = graph
+    return graph

@@ -1,0 +1,563 @@
+"""Lock-region extraction and the cross-file lock-acquisition-order graph.
+
+Shared infrastructure for the CC* rules. Locks are identified
+statically, per class (not per instance): ``self._lock =
+threading.Lock()`` in ``serve.device_cache.DeviceFeatureCache`` is the
+lock ``device_cache:DeviceFeatureCache._lock`` wherever an instance
+acquires it. Module-level ``_build_lock = threading.Lock()`` works the
+same way. Regions are ``with <lock>:`` bodies plus
+``lock.acquire()``/``release()`` spans inside one statement block (and
+``if lock.acquire(...):`` bodies).
+
+Call edges propagate acquisitions interprocedurally:
+
+- exact resolution for ``self.method()`` (same class) and plain-name /
+  ``from mod import f`` calls;
+- *name-based* resolution for other attribute calls (``x.inc()``
+  resolves to every scanned class whose method ``inc`` acquires a
+  lock). That is how ``metrics_sink.observe(...)`` under the batcher
+  lock becomes a batcher-lock -> Histogram._lock edge without type
+  inference. Name-based edges feed only the order graph (cycles need a
+  matching reverse edge to fire, so a stray candidate is harmless);
+  blocking-call propagation (CC02) uses exact resolution only.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.analysis.engine import FileContext, ProjectContext, dotted_name
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"}
+_EVENT_CTORS = {"Event"}
+
+# Direct blocking calls flagged while a lock is held. Deliberately tight:
+# every entry stalls the calling thread on an external event for an
+# unbounded/configured time while other threads pile up on the lock.
+_SLEEP_DOTTED = {"time.sleep"}
+_SOCKET_METHODS = {"recv", "recv_into", "accept", "connect", "sendall",
+                   "makefile"}
+_FUTURE_METHODS = {"result"}
+_QUEUE_METHODS = {"get", "put"}
+_EVENT_METHODS = {"wait"}
+
+
+@dataclass(frozen=True)
+class LockDef:
+    id: str  # "relpath:Class.attr" or "relpath:name"
+    label: str  # "Class._lock" / "_build_lock"
+    relpath: str
+    line: int
+
+
+@dataclass
+class EdgeSite:
+    ctx: FileContext
+    line: int
+    func: str  # qualname of the function holding the outer lock
+    via: str  # human-readable evidence ("with" nesting / call chain)
+
+
+@dataclass
+class BlockingSite:
+    ctx: FileContext
+    line: int
+    lock: LockDef
+    desc: str
+
+
+@dataclass
+class WriteSite:
+    ctx: FileContext
+    line: int
+    func: str
+    held: frozenset[str]  # lock ids of the same class held at the write
+    inherited: bool  # held set inferred from call sites, not lexical
+
+
+@dataclass
+class _FuncRecord:
+    key: tuple[str, str]  # (relpath, qualname)
+    ctx: FileContext
+    node: ast.AST
+    cls: "_ClassRecord | None"
+    direct_acquires: list[tuple[LockDef, int]] = field(default_factory=list)
+    nested_edges: list[tuple[LockDef, LockDef, EdgeSite]] = field(default_factory=list)
+    calls: list[tuple[str, str, int, frozenset[str]]] = field(default_factory=list)
+    # (kind: self|name|attr, name, line, held lock ids)
+    blocking: list[tuple[int, str, frozenset[str]]] = field(default_factory=list)
+    writes: list[tuple[str, int, frozenset[str]]] = field(default_factory=list)
+
+
+@dataclass
+class _ClassRecord:
+    name: str
+    ctx: FileContext
+    node: ast.ClassDef
+    locks: dict[str, LockDef] = field(default_factory=dict)
+    queues: set[str] = field(default_factory=set)
+    events: set[str] = field(default_factory=set)
+    methods: dict[str, _FuncRecord] = field(default_factory=dict)
+
+
+class LockGraph:
+    def __init__(self, project: ProjectContext, files: list[FileContext]):
+        self.project = project
+        self.locks: dict[str, LockDef] = {}
+        self.module_locks: dict[str, dict[str, LockDef]] = {}  # relpath -> name -> lock
+        self.classes: list[_ClassRecord] = []
+        self.funcs: dict[tuple[str, str], _FuncRecord] = {}
+        self.edges: dict[tuple[str, str], list[EdgeSite]] = {}
+        self.acquires: dict[tuple[str, str], set[str]] = {}
+        self.blocks: dict[tuple[str, str], list[tuple[int, str]]] = {}
+        self._methods_by_name: dict[str, list[_FuncRecord]] = {}
+        self._from_imports: dict[str, dict[str, tuple[str, str]]] = {}
+        for ctx in files:
+            self._inventory(ctx)
+        for ctx in files:
+            self._analyze_file(ctx)
+        self._fixpoint()
+        self._materialize_call_edges()
+
+    # -- inventory -----------------------------------------------------------
+
+    @staticmethod
+    def _ctor_kind(value: ast.AST) -> str | None:
+        if not isinstance(value, ast.Call):
+            return None
+        name = dotted_name(value.func)
+        if name is None:
+            return None
+        last = name.split(".")[-1]
+        if last in _LOCK_CTORS:
+            return "lock"
+        if last in _QUEUE_CTORS and (name == last or name.split(".")[0] in
+                                     ("queue", "multiprocessing")):
+            return "queue"
+        if last in _EVENT_CTORS:
+            return "event"
+        return None
+
+    def _inventory(self, ctx: FileContext) -> None:
+        mod_locks: dict[str, LockDef] = {}
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) and self._ctor_kind(node.value) == "lock":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        lock = LockDef(f"{ctx.relpath}:{t.id}", t.id,
+                                       ctx.relpath, node.lineno)
+                        mod_locks[t.id] = lock
+                        self.locks[lock.id] = lock
+        self.module_locks[ctx.relpath] = mod_locks
+        imports: dict[str, tuple[str, str]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    if alias.name != "*":
+                        imports[alias.asname or alias.name] = (
+                            node.module, alias.name)
+        self._from_imports[ctx.relpath] = imports
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            rec = _ClassRecord(node.name, ctx, node)
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign):
+                    kind = self._ctor_kind(sub.value)
+                    if kind is None:
+                        continue
+                    for t in sub.targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            if kind == "lock":
+                                lock = LockDef(
+                                    f"{ctx.relpath}:{rec.name}.{t.attr}",
+                                    f"{rec.name}.{t.attr}", ctx.relpath,
+                                    sub.lineno)
+                                rec.locks[t.attr] = lock
+                                self.locks[lock.id] = lock
+                            elif kind == "queue":
+                                rec.queues.add(t.attr)
+                            else:
+                                rec.events.add(t.attr)
+            self.classes.append(rec)
+
+    # -- per-function region analysis ---------------------------------------
+
+    def _analyze_file(self, ctx: FileContext) -> None:
+        mod_locks = self.module_locks.get(ctx.relpath, {})
+
+        def handle_function(fn_node, qual: str, cls: _ClassRecord | None):
+            rec = _FuncRecord((ctx.relpath, qual), ctx, fn_node, cls)
+            self.funcs[rec.key] = rec
+            if cls is not None:
+                cls.methods.setdefault(fn_node.name, rec)
+            self._walk_block(fn_node.body, rec, held=[], cls=cls,
+                             mod_locks=mod_locks)
+
+        def visit(node: ast.AST, qual: str, cls: _ClassRecord | None):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    q = f"{qual}.{child.name}" if qual else child.name
+                    handle_function(child, q, cls)
+                    # Nested defs get their own records; don't double-walk.
+                elif isinstance(child, ast.ClassDef):
+                    crec = next((c for c in self.classes
+                                 if c.node is child), None)
+                    visit(child, f"{qual}.{child.name}" if qual else child.name,
+                          crec)
+                else:
+                    visit(child, qual, cls)
+
+        visit(ctx.tree, "", None)
+
+    def _resolve_lock(self, expr: ast.AST, cls: _ClassRecord | None,
+                      mod_locks: dict[str, LockDef]) -> LockDef | None:
+        if (isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and cls is not None):
+            return cls.locks.get(expr.attr)
+        if isinstance(expr, ast.Name):
+            return mod_locks.get(expr.id)
+        return None
+
+    def _walk_block(self, stmts: list[ast.stmt], rec: _FuncRecord,
+                    held: list[LockDef], cls: _ClassRecord | None,
+                    mod_locks: dict[str, LockDef]) -> None:
+        i = 0
+        acquired_here: list[LockDef] = []
+        while i < len(stmts):
+            stmt = stmts[i]
+            lock = self._acquire_stmt(stmt, cls, mod_locks)
+            if lock is not None and isinstance(stmt, ast.Expr):
+                # lock.acquire() as a bare statement: held until a
+                # release() in this block, else to block end.
+                self._note_acquisition(rec, lock, held, stmt.lineno, "acquire()")
+                held = held + [lock]
+                acquired_here.append(lock)
+                i += 1
+                continue
+            if self._release_stmt(stmt, cls, mod_locks, acquired_here):
+                released = acquired_here.pop()
+                held = [lk for lk in held if lk is not released]
+                i += 1
+                continue
+            self._walk_stmt(stmt, rec, held, cls, mod_locks)
+            i += 1
+
+    def _acquire_stmt(self, stmt: ast.stmt, cls, mod_locks) -> LockDef | None:
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            if (isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "acquire"):
+                return self._resolve_lock(call.func.value, cls, mod_locks)
+        return None
+
+    def _release_stmt(self, stmt: ast.stmt, cls, mod_locks,
+                      acquired_here: list[LockDef]) -> bool:
+        if not acquired_here:
+            return False
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            if (isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "release"):
+                lock = self._resolve_lock(call.func.value, cls, mod_locks)
+                return lock is acquired_here[-1]
+        return False
+
+    def _note_acquisition(self, rec: _FuncRecord, lock: LockDef,
+                          held: list[LockDef], line: int, via: str) -> None:
+        rec.direct_acquires.append((lock, line))
+        for outer in held:
+            if outer.id != lock.id:
+                rec.nested_edges.append((outer, lock, EdgeSite(
+                    rec.ctx, line, rec.key[1], via)))
+
+    def _walk_stmt(self, stmt: ast.stmt, rec: _FuncRecord,
+                   held: list[LockDef], cls, mod_locks) -> None:
+        if isinstance(stmt, ast.With):
+            inner = list(held)
+            for item in stmt.items:
+                lock = self._resolve_lock(item.context_expr, cls, mod_locks)
+                if lock is not None:
+                    self._note_acquisition(rec, lock, inner,
+                                           item.context_expr.lineno, "with")
+                    inner = inner + [lock]
+                else:
+                    self._scan_expr(item.context_expr, rec, held, cls, mod_locks)
+            self._walk_block(stmt.body, rec, inner, cls, mod_locks)
+            return
+        if isinstance(stmt, ast.If):
+            # `if lock.acquire(timeout=...):` guards the body.
+            lock = None
+            if (isinstance(stmt.test, ast.Call)
+                    and isinstance(stmt.test.func, ast.Attribute)
+                    and stmt.test.func.attr == "acquire"):
+                lock = self._resolve_lock(stmt.test.func.value, cls, mod_locks)
+            if lock is not None:
+                self._note_acquisition(rec, lock, held, stmt.test.lineno,
+                                       "acquire()")
+                self._walk_block(stmt.body, rec, held + [lock], cls, mod_locks)
+            else:
+                self._scan_expr(stmt.test, rec, held, cls, mod_locks)
+                self._walk_block(stmt.body, rec, held, cls, mod_locks)
+            self._walk_block(stmt.orelse, rec, held, cls, mod_locks)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # own record / own scope
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._scan_expr(stmt.iter, rec, held, cls, mod_locks)
+            self._walk_block(stmt.body, rec, held, cls, mod_locks)
+            self._walk_block(stmt.orelse, rec, held, cls, mod_locks)
+            return
+        if isinstance(stmt, ast.While):
+            self._scan_expr(stmt.test, rec, held, cls, mod_locks)
+            self._walk_block(stmt.body, rec, held, cls, mod_locks)
+            self._walk_block(stmt.orelse, rec, held, cls, mod_locks)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk_block(stmt.body, rec, held, cls, mod_locks)
+            for h in stmt.handlers:
+                self._walk_block(h.body, rec, held, cls, mod_locks)
+            self._walk_block(stmt.orelse, rec, held, cls, mod_locks)
+            self._walk_block(stmt.finalbody, rec, held, cls, mod_locks)
+            return
+        # Attribute writes (CC03 input).
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            for t in targets:
+                if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                        and t.value.id == "self" and cls is not None):
+                    own = frozenset(lk.id for lk in held
+                                    if lk.id in {l.id for l in cls.locks.values()})
+                    rec.writes.append((t.attr, stmt.lineno, own))
+            value = getattr(stmt, "value", None)
+            if value is not None:
+                self._scan_expr(value, rec, held, cls, mod_locks)
+            return
+        # Everything else: scan contained expressions for calls.
+        for child in ast.walk(stmt):
+            if isinstance(child, ast.Call):
+                self._record_call(child, rec, held, cls, mod_locks)
+
+    def _scan_expr(self, expr: ast.AST, rec: _FuncRecord,
+                   held: list[LockDef], cls, mod_locks) -> None:
+        for child in ast.walk(expr):
+            if isinstance(child, ast.Call):
+                self._record_call(child, rec, held, cls, mod_locks)
+
+    def _record_call(self, call: ast.Call, rec: _FuncRecord,
+                     held: list[LockDef], cls, mod_locks) -> None:
+        held_ids = frozenset(lk.id for lk in held)
+        fn = call.func
+        dotted = dotted_name(fn)
+        # Blocking-call detection (only meaningful when a lock is held,
+        # but recorded unconditionally; the rule filters).
+        desc = self._blocking_desc(call, rec, held, cls, mod_locks)
+        if desc is not None and held:
+            rec.blocking.append((call.lineno, desc, held_ids))
+        if isinstance(fn, ast.Attribute):
+            if isinstance(fn.value, ast.Name) and fn.value.id == "self":
+                rec.calls.append(("self", fn.attr, call.lineno, held_ids))
+            else:
+                rec.calls.append(("attr", fn.attr, call.lineno, held_ids))
+        elif isinstance(fn, ast.Name):
+            rec.calls.append(("name", fn.id, call.lineno, held_ids))
+        del dotted
+
+    def _blocking_desc(self, call: ast.Call, rec: _FuncRecord,
+                       held: list[LockDef], cls, mod_locks) -> str | None:
+        fn = call.func
+        dotted = dotted_name(fn)
+        if dotted in _SLEEP_DOTTED:
+            return "time.sleep()"
+        if not isinstance(fn, ast.Attribute):
+            return None
+        attr = fn.attr
+        if attr == "block_until_ready":
+            return "block_until_ready() (full device readback)"
+        if attr in _FUTURE_METHODS and not call.args:
+            # `.result()` with no positional args — Future-style wait.
+            # (dict.get etc. never spell `.result()`.)
+            return ".result() (future wait)"
+        recv = fn.value
+        recv_attr = (recv.attr if isinstance(recv, ast.Attribute)
+                     and isinstance(recv.value, ast.Name)
+                     and recv.value.id == "self" else None)
+        if cls is not None and recv_attr is not None:
+            if attr in _QUEUE_METHODS and recv_attr in cls.queues:
+                nowait = any(kw.arg == "block" and isinstance(kw.value, ast.Constant)
+                             and kw.value.value is False for kw in call.keywords)
+                if not nowait:
+                    return f"queue .{attr}() on self.{recv_attr}"
+            if attr in _EVENT_METHODS and recv_attr in cls.events:
+                return f"Event.wait() on self.{recv_attr}"
+        if attr in _SOCKET_METHODS:
+            base = dotted_name(recv) or ""
+            if any(p in base for p in ("sock", "conn", "channel", "stub")):
+                return f"socket/channel .{attr}()"
+        if attr == "wait":
+            # Condition.wait on the HELD condition releases it — exempt.
+            lock = self._resolve_lock(recv, cls, mod_locks)
+            if lock is not None and all(h.id != lock.id for h in held):
+                return f"wait() on {lock.label}"
+        return None
+
+    # -- interprocedural propagation ----------------------------------------
+
+    def _resolve_exact(self, rec: _FuncRecord, kind: str,
+                       name: str) -> list[_FuncRecord]:
+        if kind == "self" and rec.cls is not None:
+            m = rec.cls.methods.get(name)
+            return [m] if m is not None else []
+        if kind == "name":
+            target = self.funcs.get((rec.key[0], name))
+            if target is not None:
+                return [target]
+            imported = self._from_imports.get(rec.key[0], {}).get(name)
+            if imported is not None:
+                module, orig = imported
+                target_ctx = self.project.resolve_module(module)
+                if target_ctx is not None:
+                    t = self.funcs.get((target_ctx.relpath, orig))
+                    if t is not None:
+                        return [t]
+        return []
+
+    def _methods_named(self, name: str) -> list[_FuncRecord]:
+        if not self._methods_by_name:
+            for c in self.classes:
+                for mname, m in c.methods.items():
+                    self._methods_by_name.setdefault(mname, []).append(m)
+        return self._methods_by_name.get(name, [])
+
+    def _fixpoint(self) -> None:
+        for key, rec in self.funcs.items():
+            self.acquires[key] = {lk.id for lk, _ in rec.direct_acquires}
+            self.blocks[key] = [(line, desc) for line, desc, held in rec.blocking]
+            # Lexical blocking inside a region is attributed directly.
+        changed = True
+        while changed:
+            changed = False
+            for key, rec in self.funcs.items():
+                acc = self.acquires[key]
+                for kind, name, _line, _held in rec.calls:
+                    callees = self._resolve_exact(rec, kind, name)
+                    if not callees and kind == "attr":
+                        callees = [m for m in self._methods_named(name)
+                                   if self.acquires.get(m.key)]
+                    for callee in callees:
+                        extra = self.acquires.get(callee.key, set()) - acc
+                        if extra:
+                            acc |= extra
+                            changed = True
+
+    def _materialize_call_edges(self) -> None:
+        # Direct `with` nesting edges.
+        for rec in self.funcs.values():
+            for a, b, site in rec.nested_edges:
+                self.edges.setdefault((a.id, b.id), []).append(site)
+        # Call-mediated edges: holding A, call something that acquires B.
+        for rec in self.funcs.values():
+            for kind, name, line, held in rec.calls:
+                if not held:
+                    continue
+                callees = self._resolve_exact(rec, kind, name)
+                exact = bool(callees)
+                if not callees and kind == "attr":
+                    callees = [m for m in self._methods_named(name)
+                               if self.acquires.get(m.key)]
+                for callee in callees:
+                    for b_id in self.acquires.get(callee.key, set()):
+                        for a_id in held:
+                            if a_id == b_id:
+                                continue
+                            via = (f"calls {'self.' if kind == 'self' else ''}"
+                                   f"{name}() -> "
+                                   f"{callee.key[1]} acquires "
+                                   f"{self.locks[b_id].label}"
+                                   + ("" if exact else " [name-based match]"))
+                            self.edges.setdefault((a_id, b_id), []).append(
+                                EdgeSite(rec.ctx, line, rec.key[1], via))
+
+    # -- queries -------------------------------------------------------------
+
+    def cycles(self) -> list[list[str]]:
+        """Elementary cycles over the lock-order graph (Tarjan SCCs; each
+        SCC with an internal edge is reported as one cycle walk)."""
+        graph: dict[str, set[str]] = {}
+        for (a, b) in self.edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        index: dict[str, int] = {}
+        low: dict[str, int] = {}
+        stack: list[str] = []
+        on_stack: set[str] = set()
+        sccs: list[list[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            for w in graph.get(v, ()):
+                if w not in index:
+                    strongconnect(w)
+                    low[v] = min(low[v], low[w])
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                sccs.append(comp)
+
+        for v in sorted(graph):
+            if v not in index:
+                strongconnect(v)
+        cycles = []
+        for comp in sccs:
+            if len(comp) > 1:
+                cycles.append(sorted(comp))
+            elif comp and comp[0] in graph.get(comp[0], ()):
+                cycles.append(comp)  # self-loop: re-acquire of a non-R lock
+        return cycles
+
+    def blocking_findings(self):
+        """(ctx, line, lock_label, desc) for blocking calls inside lock
+        regions — lexical sites plus exact-callee propagation one level
+        (`self.m()` under a lock where m's body blocks)."""
+        out = []
+        for rec in self.funcs.values():
+            for line, desc, held in rec.blocking:
+                for lock_id in sorted(held):
+                    out.append((rec.ctx, line, self.locks[lock_id].label, desc))
+                    break  # attribute to the innermost-listed lock once
+            for kind, name, line, held in rec.calls:
+                if not held:
+                    continue
+                for callee in self._resolve_exact(rec, kind, name):
+                    for bline, desc in self.blocks.get(callee.key, []):
+                        lock_id = sorted(held)[0]
+                        out.append((
+                            rec.ctx, line, self.locks[lock_id].label,
+                            f"{desc} inside {callee.key[1]}() "
+                            f"({callee.ctx.relpath}:{bline})"))
+        return out
+
+
+def lock_graph(project: ProjectContext, files: list[FileContext]) -> LockGraph:
+    key = "lockgraph"
+    graph = project.caches.get(key)
+    if graph is None:
+        graph = LockGraph(project, files)
+        project.caches[key] = graph
+    return graph

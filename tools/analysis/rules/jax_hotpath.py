@@ -1,0 +1,216 @@
+"""JX* — JAX hot-path rules: side effects and host syncs in traced code.
+
+All four rules share one :mod:`tools.analysis.jaxgraph` reachability
+walk: anything flagged here sits in a function jax traces (directly
+decorated, wrapped by ``jax.jit``/``pjit``/``shard_map``, or called from
+one). At trace time these constructs either run once and silently bake a
+stale value into the compiled graph (clocks, globals), force a
+host-device sync every step (``.item()``, ``float()`` on a tracer,
+``np.asarray``), or throw only on the first cache-miss retrace
+(unhashable static args) — exactly the bug classes "Scaling TensorFlow
+to 300M predictions/sec" blames for serving regressions.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.engine import ProjectContext, dotted_name, rule
+from tools.analysis.jaxgraph import FuncInfo, jax_graph
+
+_LOG_RECEIVERS = {"logging", "logger", "log", "_log", "_logger", "LOG", "LOGGER"}
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "log"}
+_CLOCK_DOTTED = {
+    "time.time", "time.time_ns", "time.perf_counter", "time.perf_counter_ns",
+    "time.monotonic", "time.monotonic_ns", "datetime.now", "datetime.utcnow",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+}
+_CLOCK_BARE = {"perf_counter", "perf_counter_ns", "monotonic", "monotonic_ns",
+               "time_ns"}
+_NUMPY_ALIASES = {"np", "numpy", "onp"}
+_NUMPY_HOST_FNS = {"asarray", "array", "copy"}
+_CASTS = {"float", "int", "bool"}
+
+
+def _walk_scope(info: FuncInfo):
+    """Walk the function's whole subtree. Nested defs/lambdas stay in:
+    they are trace-time constructs too (lax.scan/cond bodies)."""
+    body = info.node.body
+    if isinstance(body, list):
+        for stmt in body:
+            yield from ast.walk(stmt)
+    else:  # Lambda body is a single expression
+        yield from ast.walk(body)
+
+
+def _from_time_imports(ctx) -> set[str]:
+    names: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name in _CLOCK_BARE | {"time"}:
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+def _where(info: FuncInfo) -> str:
+    return f"in jit-traced `{info.qualname}` ({info.root_reason})"
+
+
+def _each_reachable(project: ProjectContext):
+    """Reachable functions, outermost first, deduped: when both a parent
+    and a nested def are reachable, only the parent is walked (its
+    subtree already covers the child)."""
+    graph = jax_graph(project)
+    infos = list(graph.reachable.values())
+    nested: set[int] = set()
+    for info in infos:
+        for node in ast.walk(info.node):
+            if node is not info.node and id(node) in graph.reachable:
+                nested.add(id(node))
+    for info in infos:
+        if id(info.node) not in nested:
+            yield info
+
+
+@rule("JX01", "jit-side-effect",
+      "print/logging/clock calls inside jit-traced code run once at trace "
+      "time, then never again — the log line or timestamp silently "
+      "freezes into the compiled graph. Hoist them to the host caller or "
+      "use jax.debug.print / io_callback.",
+      scope="project")
+def jit_side_effect(project: ProjectContext):
+    seen: set[tuple[str, int, str]] = set()
+    for info in _each_reachable(project):
+        time_names = _from_time_imports(info.ctx)
+        for node in _walk_scope(info):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = None
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id == "print":
+                msg = ("print() traces once and is dead in the compiled "
+                       "graph — use jax.debug.print")
+            elif isinstance(fn, ast.Attribute):
+                base = dotted_name(fn.value)
+                if base in _LOG_RECEIVERS and fn.attr in _LOG_METHODS:
+                    msg = (f"{base}.{fn.attr}() traces once and is dead in "
+                           "the compiled graph — log from the host caller")
+                elif dotted_name(fn) in _CLOCK_DOTTED:
+                    msg = (f"clock read {dotted_name(fn)}() freezes its "
+                           "trace-time value into the compiled graph")
+            elif isinstance(fn, ast.Name) and fn.id in time_names:
+                msg = (f"clock read {fn.id}() freezes its trace-time value "
+                       "into the compiled graph")
+            if msg is not None:
+                key = (info.ctx.relpath, node.lineno, msg)
+                if key not in seen:
+                    seen.add(key)
+                    yield info.ctx, node.lineno, f"{msg} — {_where(info)}"
+
+
+@rule("JX02", "jit-host-materialization",
+      ".item(), float()/int()/bool() on a traced argument, and "
+      "np.asarray/np.array on traced values block until the device value "
+      "is readable — a host sync on every step of the hot path. Keep the "
+      "computation in jnp, or hoist the conversion outside the jitted "
+      "function.",
+      scope="project")
+def jit_host_materialization(project: ProjectContext):
+    seen: set[tuple[str, int, str]] = set()
+    for info in _each_reachable(project):
+        traced = set(info.params) - set(info.static_params)
+        for node in _walk_scope(info):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = None
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "item" and not node.args:
+                msg = (".item() forces a device->host sync and blocks the "
+                       "dispatch pipeline")
+            elif (isinstance(fn, ast.Name) and fn.id in _CASTS
+                    and len(node.args) == 1
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in traced):
+                msg = (f"{fn.id}({node.args[0].id}) materializes a traced "
+                       "argument on host (sync per step); use jnp ops or "
+                       "mark the argument static")
+            elif isinstance(fn, ast.Attribute):
+                base = dotted_name(fn.value)
+                if (base in _NUMPY_ALIASES and fn.attr in _NUMPY_HOST_FNS
+                        and node.args and isinstance(node.args[0], ast.Name)
+                        and node.args[0].id in traced):
+                    msg = (f"{base}.{fn.attr}({node.args[0].id}) pulls a "
+                           "traced value to host numpy — use jnp.asarray "
+                           "(stays on device) or hoist to the caller")
+            if msg is not None:
+                key = (info.ctx.relpath, node.lineno, msg)
+                if key not in seen:
+                    seen.add(key)
+                    yield info.ctx, node.lineno, f"{msg} — {_where(info)}"
+
+
+@rule("JX03", "jit-global-mutation",
+      "Rebinding a global/nonlocal inside jit-traced code happens at "
+      "trace time only: the mutation silently stops occurring once the "
+      "function is compiled, and its trace-time value is baked in. "
+      "Return the value instead, or carry it as explicit state.",
+      scope="project")
+def jit_global_mutation(project: ProjectContext):
+    seen: set[tuple[str, int]] = set()
+    for info in _each_reachable(project):
+        for node in _walk_scope(info):
+            if not isinstance(node, (ast.Global, ast.Nonlocal)):
+                continue
+            # Only flag declarations that are actually written to
+            # somewhere in the same subtree.
+            written: set[str] = set()
+            for n in _walk_scope(info):
+                if isinstance(n, ast.Assign):
+                    written.update(t.id for t in n.targets
+                                   if isinstance(t, ast.Name))
+                elif isinstance(n, (ast.AugAssign, ast.AnnAssign)) and isinstance(
+                        n.target, ast.Name):
+                    written.add(n.target.id)
+            hot = [n for n in node.names if n in written]
+            if hot and (info.ctx.relpath, node.lineno) not in seen:
+                seen.add((info.ctx.relpath, node.lineno))
+                kind = "global" if isinstance(node, ast.Global) else "nonlocal"
+                yield (info.ctx, node.lineno,
+                       f"{kind} {', '.join(hot)} mutated inside jit-traced "
+                       f"code — the write happens at trace time only; "
+                       f"{_where(info)}")
+
+
+@rule("JX04", "jit-unhashable-static",
+      "static_argnums/static_argnames arguments are hashed into the "
+      "compilation cache key; a list/dict/set default (or passing one at "
+      "a call site) raises TypeError on the first cache lookup — but "
+      "only on the retrace path, so it ships. Use tuples / frozen "
+      "structures for static arguments.",
+      scope="project")
+def jit_unhashable_static(project: ProjectContext):
+    graph = jax_graph(project)
+    seen: set[tuple[str, int]] = set()
+    for info in graph.roots:
+        if not info.static_params:
+            continue
+        node = info.node
+        args = node.args
+        pos = list(getattr(args, "posonlyargs", [])) + list(args.args)
+        pairs = list(zip(reversed(pos), reversed(args.defaults)))
+        pairs += [(a, d) for a, d in zip(args.kwonlyargs, args.kw_defaults)
+                  if d is not None]
+        for arg, default in pairs:
+            if arg.arg in info.static_params and isinstance(
+                    default, (ast.List, ast.Dict, ast.Set, ast.DictComp,
+                              ast.ListComp, ast.SetComp)):
+                key = (info.ctx.relpath, default.lineno)
+                if key not in seen:
+                    seen.add(key)
+                    yield (info.ctx, default.lineno,
+                           f"static argument `{arg.arg}` of jit-compiled "
+                           f"`{info.qualname}` defaults to an unhashable "
+                           "container — the compilation-cache hash raises "
+                           "TypeError at call time; use a tuple")

@@ -1,0 +1,125 @@
+"""CC* — concurrency rules over the cross-file lock-order graph.
+
+Scope: the serving/observability layer (``serve/`` + ``obs/`` when
+scanning this repo; everything when scanning an explicit path, e.g. the
+test fixture corpus). The platform/ wallet code has its own RLock-based
+transactional discipline and is deliberately out of scope here.
+"""
+
+from __future__ import annotations
+
+from tools.analysis.engine import FileContext, ProjectContext, rule
+from tools.analysis.lockgraph import lock_graph
+
+
+def _scoped_files(project: ProjectContext) -> list[FileContext]:
+    config = project.caches.get("config", {})
+    prefixes = config.get("cc_scope")
+    if not prefixes:
+        return list(project.files)
+    return [f for f in project.files
+            if any(f.relpath.startswith(p) for p in prefixes)]
+
+
+def _graph(project: ProjectContext):
+    return lock_graph(project, _scoped_files(project))
+
+
+@rule("CC01", "lock-order-cycle",
+      "Two locks acquired in opposite orders on different code paths "
+      "deadlock the moment both paths run concurrently. The graph counts "
+      "an acquisition made anywhere downstream of a call while the first "
+      "lock is held — the batcher->metrics->batcher shape.",
+      scope="project")
+def lock_order_cycle(project: ProjectContext):
+    graph = _graph(project)
+    for cycle in graph.cycles():
+        # Walk the cycle edge by edge, quoting one acquisition site each.
+        legs = []
+        anchor: tuple[FileContext, int] | None = None
+        n = len(cycle)
+        for i in range(n):
+            a, b = cycle[i], cycle[(i + 1) % n] if n > 1 else (cycle[i], cycle[i])[1]
+            sites = graph.edges.get((a, b), [])
+            if not sites:
+                continue
+            s = sites[0]
+            if anchor is None:
+                anchor = (s.ctx, s.line)
+            legs.append(
+                f"{graph.locks[a].label} -> {graph.locks[b].label} at "
+                f"{s.ctx.relpath}:{s.line} ({s.via})")
+        if anchor is None:
+            continue
+        names = " -> ".join(graph.locks[lid].label for lid in cycle)
+        yield anchor[0], anchor[1], (
+            f"lock-order cycle {names} -> {graph.locks[cycle[0]].label} "
+            "(potential deadlock): " + "; ".join(legs))
+
+
+@rule("CC02", "blocking-call-under-lock",
+      "A sleep, queue/event wait, future .result(), socket read, or "
+      "block_until_ready made while holding a lock turns every other "
+      "thread that touches the lock into a convoy behind an unbounded "
+      "wait. Move the wait outside the critical section.",
+      scope="project")
+def blocking_call_under_lock(project: ProjectContext):
+    graph = _graph(project)
+    seen: set[tuple[str, int, str]] = set()
+    for ctx, line, lock_label, desc in graph.blocking_findings():
+        key = (ctx.relpath, line, desc)
+        if key in seen:
+            continue
+        seen.add(key)
+        yield ctx, line, (
+            f"blocking call {desc} while holding {lock_label} — threads "
+            "contending on the lock convoy behind this wait")
+
+
+@rule("CC03", "mixed-guard-attribute",
+      "An attribute written both under a lock and without it isn't "
+      "protected by that lock at all — the unguarded write races every "
+      "guarded reader. Writes in __init__ (pre-publication) are exempt; "
+      "a private helper whose every in-class call site holds the lock "
+      "inherits that guard.",
+      scope="project")
+def mixed_guard_attribute(project: ProjectContext):
+    graph = _graph(project)
+    for cls in graph.classes:
+        if not cls.locks:
+            continue
+        own_lock_ids = {lk.id for lk in cls.locks.values()}
+        # Inherited guard: private method whose in-class call sites ALL
+        # hold a common subset of this class's locks.
+        inherited: dict[str, frozenset[str]] = {}
+        call_contexts: dict[str, list[frozenset[str]]] = {}
+        for m in cls.methods.values():
+            for kind, name, _line, held in m.calls:
+                if kind == "self" and name in cls.methods:
+                    call_contexts.setdefault(name, []).append(
+                        frozenset(held & own_lock_ids))
+        for name, contexts in call_contexts.items():
+            if name.startswith("_") and not name.startswith("__") and contexts:
+                common = frozenset.intersection(*contexts)
+                if common:
+                    inherited[name] = common
+        writes: dict[str, dict[str, list[tuple[str, int]]]] = {}
+        for mname, m in cls.methods.items():
+            if mname == "__init__":
+                continue
+            extra = inherited.get(mname, frozenset())
+            for attr, line, held in m.writes:
+                bucket = "locked" if (held | extra) else "unlocked"
+                writes.setdefault(attr, {}).setdefault(bucket, []).append(
+                    (f"{m.ctx.relpath}:{line}", line))
+        for attr, buckets in sorted(writes.items()):
+            if "locked" in buckets and "unlocked" in buckets:
+                locked_site, _ = buckets["locked"][0]
+                unlocked_site, unlocked_line = buckets["unlocked"][0]
+                lock_labels = "/".join(sorted(
+                    lk.label for lk in cls.locks.values()))
+                yield cls.ctx, unlocked_line, (
+                    f"attribute `{attr}` of {cls.name} written both under "
+                    f"a lock ({locked_site}) and without one "
+                    f"({unlocked_site}) — the unguarded write races every "
+                    f"reader that trusts {lock_labels}")

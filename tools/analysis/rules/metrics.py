@@ -1,0 +1,136 @@
+"""MX* — metrics and measurement-integrity rules.
+
+Ports of the round-5/PR-2 checks from tools/lint.py, behavior-preserving
+except for one deliberate fix (ISSUE 3 satellite): the help-text check
+used to require the metric *name* to be a positional string literal, so
+``registry.counter(name="x", help_text="")`` — or any non-literal name,
+like the f-strings ServiceMetrics uses — skipped the check entirely.
+The rule now keys on the factory method alone and resolves the help
+argument from either position or keyword.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.engine import FileContext, call_name, rule
+
+_CLOCK_CALLS = {"perf_counter", "monotonic", "perf_counter_ns", "monotonic_ns"}
+
+_METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+
+
+def _scope_calls(body: list[ast.stmt]):
+    """Yield Call nodes in ``body`` WITHOUT descending into nested
+    function definitions (each function is its own timing scope)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@rule("MX01", "timed-block-until-ready",
+      "block_until_ready() bracketed by clock reads silently measures "
+      "dispatch-ACK on tunneled backends (~30x inflated step throughput); "
+      "every step timing must go through obs/perfmodel.device_step_time's "
+      "two-point readback fence. Only obs/perfmodel.py may time that way.")
+def timed_block_until_ready(ctx: FileContext):
+    if ctx.path.name == "perfmodel.py" and ctx.path.parent.name == "obs":
+        return
+    scopes: list[list[ast.stmt]] = [ctx.tree.body]
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scopes.append(node.body)
+    for body in scopes:
+        clock_lines: list[int] = []
+        bur_lines: list[int] = []
+        for call in _scope_calls(body):
+            name = call_name(call)
+            if name in _CLOCK_CALLS:
+                clock_lines.append(call.lineno)
+            elif name == "block_until_ready":
+                bur_lines.append(call.lineno)
+        if not clock_lines or not bur_lines:
+            continue
+        lo, hi = min(clock_lines), max(clock_lines)
+        for line in bur_lines:
+            if lo < line < hi:
+                yield line, (
+                    "block_until_ready() inside a timed region — it can "
+                    "return at dispatch-ACK on tunneled backends; use "
+                    "obs/perfmodel.device_step_time")
+
+
+def _help_argument(node: ast.Call) -> ast.AST | None:
+    """The help-text argument of a registry factory call, wherever it
+    sits: second positional (after a positional name), first positional
+    (when the name went by keyword), or the ``help_text`` keyword."""
+    for kw in node.keywords:
+        if kw.arg == "help_text":
+            return kw.value
+    has_name_kwarg = any(kw.arg == "name" for kw in node.keywords)
+    positional_help_idx = 0 if has_name_kwarg else 1
+    if len(node.args) > positional_help_idx:
+        return node.args[positional_help_idx]
+    return None
+
+
+@rule("MX02", "metric-help-text",
+      "Every registry.counter/gauge/histogram call must pass non-empty "
+      "help text — a series without HELP is unreadable on a dashboard "
+      "six months later. Applies however the name is spelled (positional, "
+      "keyword, f-string, variable).")
+def metric_help_text(ctx: FileContext):
+    if ctx.path.name == "metrics.py" and ctx.path.parent.name == "obs":
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr in _METRIC_FACTORIES):
+            continue
+        # Only treat it as a registry factory when it plausibly passes a
+        # metric name (any first arg / name kwarg); `x.counter()` with no
+        # args is something else entirely.
+        if not node.args and not any(kw.arg == "name" for kw in node.keywords):
+            continue
+        help_arg = _help_argument(node)
+        empty = help_arg is None or (
+            isinstance(help_arg, ast.Constant) and not help_arg.value)
+        if empty:
+            yield node.lineno, (
+                "metric registered without help text — pass a non-empty "
+                "description so the series is readable on /metrics")
+
+
+@rule("MX03", "orphan-metric",
+      "Production code must construct metrics via "
+      "Registry.counter/gauge/histogram: a bare Counter()/Gauge()/"
+      "Histogram() never joins a Registry, so it silently never renders "
+      "on /metrics. Tests may (unit-testing the classes is their job).")
+def orphan_metric(ctx: FileContext):
+    if ctx.path.name == "metrics.py" and ctx.path.parent.name == "obs":
+        return
+    if "igaming_platform_tpu" not in ctx.path.parts:
+        return
+    metric_imports: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.ImportFrom) and node.module
+                and node.module.endswith("obs.metrics")):
+            for alias in node.names:
+                if alias.name in _METRIC_CLASSES:
+                    metric_imports.add(alias.asname or alias.name)
+    if not metric_imports:
+        return
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id in metric_imports):
+            yield node.lineno, (
+                "orphan metric: construct via Registry.counter/gauge/"
+                f"histogram (a bare {node.func.id}() never renders "
+                "on /metrics)")

@@ -1,255 +1,20 @@
-"""Minimal in-tree linter (`make lint`) — no linter ships in this image.
+"""Back-compat shim: the linter grew into the ``tools/analysis`` package.
 
-Checks the classes of slip that have actually bitten this codebase:
-syntax errors (compile), unused imports, duplicate imports, bare
-`except:`, `== None`/`!= None`, mutable default arguments, and
-`block_until_ready()` inside a timed region outside obs/perfmodel.py
-(the round-5 measurement-integrity rule: on the tunneled backend
-block_until_ready can return at dispatch-ACK and inflate step
-throughput ~30x — every step timing must go through
-obs/perfmodel.device_step_time's two-point readback fence), and metric
-hygiene (registry-factory calls must carry help text; production code
-must not construct orphan Counter/Gauge/Histogram instances that never
-render on /metrics). AST-only, stdlib-only, zero configuration; not a
-style tool.
-
-Deliberate side-effect imports (descriptor-pool registration, plugin
-hooks) are sanctioned by aliasing to an underscore name —
-``import x.y_pb2 as _y_pb2`` — which the unused-import rule exempts;
-a trailing ``# noqa`` on the import line is also honored.
+``python tools/lint.py`` keeps working (CI muscle memory, PR-1 era
+docs), but the real entry point is ``python -m tools.analysis`` — rule
+engine, scoped ``# noqa: <RULE-ID>`` suppression, JAX hot-path (JX*),
+lock-discipline (CC*), metrics (MX*), and hygiene (PY*) analyzers, and
+the shrink-only baseline. Catalog: docs/static-analysis.md.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
-ROOTS = ("igaming_platform_tpu", "benchmarks", "tests", "tools")
-TOP_FILES = ("bench.py", "__graft_entry__.py")
-
-
-def _imported_names(node: ast.AST):
-    """Yields (bound name, dedupe key, lineno). For `import a.b` the
-    bound name is `a` but the dedupe key is the full dotted path —
-    `import urllib.parse` + `import urllib.request` is not a duplicate."""
-    if isinstance(node, ast.Import):
-        for alias in node.names:
-            bound = alias.asname or alias.name.split(".")[0]
-            yield bound, (alias.asname or alias.name), node.lineno
-    elif isinstance(node, ast.ImportFrom):
-        for alias in node.names:
-            if alias.name != "*":
-                name = alias.asname or alias.name
-                yield name, name, node.lineno
-
-
-_CLOCK_CALLS = {"perf_counter", "monotonic", "perf_counter_ns", "monotonic_ns"}
-
-
-def _call_name(node: ast.Call) -> str | None:
-    fn = node.func
-    if isinstance(fn, ast.Attribute):
-        return fn.attr
-    if isinstance(fn, ast.Name):
-        return fn.id
-    return None
-
-
-def _scope_calls(body: list[ast.stmt]):
-    """Yield Call nodes in ``body`` WITHOUT descending into nested
-    function definitions (each function is its own timing scope)."""
-    stack = list(body)
-    while stack:
-        node = stack.pop()
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if isinstance(node, ast.Call):
-            yield node
-        stack.extend(ast.iter_child_nodes(node))
-
-
-def check_timed_block_until_ready(path: Path, tree: ast.AST,
-                                  noqa_lines: set[int]) -> list[str]:
-    """Flag `block_until_ready` calls bracketed by clock reads in the
-    same scope — i.e. sitting inside a timed region. Only
-    obs/perfmodel.py (the two-point readback fence) may time that way;
-    everywhere else the pattern silently measures dispatch-ACK on
-    tunneled backends."""
-    if path.name == "perfmodel.py" and path.parent.name == "obs":
-        return []
-    problems: list[str] = []
-    scopes: list[list[ast.stmt]] = [tree.body]
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            scopes.append(node.body)
-    for body in scopes:
-        clock_lines: list[int] = []
-        bur_lines: list[int] = []
-        for call in _scope_calls(body):
-            name = _call_name(call)
-            if name in _CLOCK_CALLS:
-                clock_lines.append(call.lineno)
-            elif name == "block_until_ready":
-                bur_lines.append(call.lineno)
-        if not clock_lines or not bur_lines:
-            continue
-        lo, hi = min(clock_lines), max(clock_lines)
-        for line in bur_lines:
-            if lo < line < hi and line not in noqa_lines:
-                problems.append(
-                    f"{path}:{line}: block_until_ready() inside a timed "
-                    "region — it can return at dispatch-ACK on tunneled "
-                    "backends; use obs/perfmodel.device_step_time")
-    return problems
-
-
-_METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
-_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
-
-
-def _is_stringish(node: ast.AST | None) -> bool:
-    return isinstance(node, ast.JoinedStr) or (
-        isinstance(node, ast.Constant) and isinstance(node.value, str))
-
-
-def check_metric_hygiene(path: Path, tree: ast.AST,
-                         noqa_lines: set[int]) -> list[str]:
-    """Metric-construction discipline (ISSUE 2 satellite):
-
-    - every ``registry.counter/gauge/histogram(name, help)`` call must
-      pass non-empty help text — a series without HELP is unreadable on a
-      dashboard six months later;
-    - production code (igaming_platform_tpu/) must not construct
-      Counter/Gauge/Histogram directly: an orphan metric never joins a
-      Registry, so it silently never renders on /metrics. Tests may
-      (unit-testing the classes themselves is their job).
-    """
-    if path.name == "metrics.py" and path.parent.name == "obs":
-        return []
-    problems: list[str] = []
-    metric_imports: set[str] = set()
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.ImportFrom) and node.module
-                and node.module.endswith("obs.metrics")):
-            for alias in node.names:
-                if alias.name in _METRIC_CLASSES:
-                    metric_imports.add(alias.asname or alias.name)
-    in_prod = "igaming_platform_tpu" in path.parts
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        if node.lineno in noqa_lines:
-            continue
-        fn = node.func
-        # Registry factory calls: require help text.
-        if (isinstance(fn, ast.Attribute) and fn.attr in _METRIC_FACTORIES
-                and node.args and _is_stringish(node.args[0])):
-            help_arg = node.args[1] if len(node.args) > 1 else next(
-                (kw.value for kw in node.keywords if kw.arg == "help_text"),
-                None)
-            empty = help_arg is None or (
-                isinstance(help_arg, ast.Constant) and not help_arg.value)
-            if empty:
-                problems.append(
-                    f"{path}:{node.lineno}: metric registered without help "
-                    "text — pass a non-empty description so the series is "
-                    "readable on /metrics")
-        # Orphan constructions in production code.
-        if (in_prod and isinstance(fn, ast.Name)
-                and fn.id in metric_imports):
-            problems.append(
-                f"{path}:{node.lineno}: orphan metric: construct via "
-                "Registry.counter/gauge/histogram (a bare "
-                f"{fn.id}() never renders on /metrics)")
-    return problems
-
-
-def lint_file(path: Path) -> list[str]:
-    src = path.read_text(encoding="utf-8")
-    try:
-        tree = ast.parse(src, filename=str(path))
-    except SyntaxError as exc:
-        return [f"{path}:{exc.lineno}: syntax error: {exc.msg}"]
-    noqa_lines = {
-        i for i, line in enumerate(src.splitlines(), start=1)
-        if "# noqa" in line
-    }
-
-    problems: list[str] = list(check_timed_block_until_ready(path, tree, noqa_lines))
-    problems.extend(check_metric_hygiene(path, tree, noqa_lines))
-    used: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            base = node
-            while isinstance(base, ast.Attribute):
-                base = base.value
-            if isinstance(base, ast.Name):
-                used.add(base.id)
-
-    # `__all__` re-exports and docstring-only modules keep their imports.
-    exports = set()
-    for node in tree.body:
-        if (isinstance(node, ast.Assign)
-                and any(isinstance(t, ast.Name) and t.id == "__all__"
-                        for t in node.targets)
-                and isinstance(node.value, (ast.List, ast.Tuple))):
-            exports = {e.value for e in node.value.elts
-                       if isinstance(e, ast.Constant)}
-
-    # Import hygiene is checked at MODULE level only: function-scope
-    # re-imports are a deliberate idiom here (lazy imports for optional
-    # deps and jax-initialization ordering).
-    seen: dict[str, int] = {}
-    is_init = path.name == "__init__.py"
-    for node in tree.body:
-        for name, key, lineno in _imported_names(node):
-            if lineno in noqa_lines:
-                continue
-            if key in seen and seen[key] != lineno:
-                problems.append(
-                    f"{path}:{lineno}: duplicate module-level import of "
-                    f"{key!r} (first at line {seen[key]})")
-            seen.setdefault(key, lineno)
-            if (not is_init and name != "annotations" and name not in used
-                    and name not in exports and not name.startswith("_")):
-                problems.append(f"{path}:{lineno}: unused import {name!r}")
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            problems.append(f"{path}:{node.lineno}: bare `except:`")
-        if isinstance(node, ast.Compare):
-            for op, comp in zip(node.ops, node.comparators):
-                if (isinstance(op, (ast.Eq, ast.NotEq))
-                        and isinstance(comp, ast.Constant)
-                        and comp.value is None):
-                    problems.append(
-                        f"{path}:{node.lineno}: use `is None` / `is not None`")
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for default in list(node.args.defaults) + [
-                    d for d in node.args.kw_defaults if d is not None]:
-                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
-                    problems.append(
-                        f"{path}:{default.lineno}: mutable default argument "
-                        f"in {node.name}()")
-    return problems
-
-
-def main() -> int:
-    repo = Path(__file__).resolve().parent.parent
-    files: list[Path] = [repo / f for f in TOP_FILES]
-    for root in ROOTS:
-        files.extend(sorted((repo / root).rglob("*.py")))
-    files = [f for f in files if "proto_gen" not in f.parts and f.exists()]
-    problems: list[str] = []
-    for f in files:
-        problems.extend(lint_file(f))
-    for p in problems:
-        print(p)
-    print(f"lint: {len(files)} files, {len(problems)} problems")
-    return 1 if problems else 0
-
-
 if __name__ == "__main__":
+    # Invoked as a script: repo root is not on sys.path yet.
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from tools.analysis.driver import main
+
     sys.exit(main())
