@@ -54,7 +54,14 @@ def device_pipeline_numbers() -> dict:
     pipeline_depth = int(os.environ.get("BENCH_PIPELINE_DEPTH", 4))
 
     cfg = ScoringConfig()
-    fn = jax.jit(make_score_fn(cfg, ml_backend="multitask"), donate_argnums=(1,))
+    # Donate the batch buffer AND echo it back: a donated input is only
+    # usable when an output matches its shape/dtype, and the score dict
+    # never matches [B, 30] — donating without the echo is what printed
+    # "Some donated buffers were not usable: float32[16384,30]" at every
+    # warmup (serve/scorer._pack_outputs has the serving-side fix).
+    score_fn = make_score_fn(cfg, ml_backend="multitask")
+    fn = jax.jit(
+        lambda p, x, bl, t: (score_fn(p, x, bl, t), x), donate_argnums=(1,))
     params = {"multitask": init_multitask(jax.random.key(0))}
     thresholds = np.array([cfg.block_threshold, cfg.review_threshold], dtype=np.int32)
 
@@ -63,7 +70,7 @@ def device_pipeline_numbers() -> dict:
     blacklisted = np.zeros((batch_size,), dtype=bool)
 
     for i in range(warmup_iters):
-        out = fn(params, pool[i % len(pool)].copy(), blacklisted, thresholds)
+        out, _ = fn(params, pool[i % len(pool)].copy(), blacklisted, thresholds)
     jax.block_until_ready(out)
 
     # The stream is fenced by a REAL readback of each batch's packed
@@ -76,7 +83,7 @@ def device_pipeline_numbers() -> dict:
     start = time.perf_counter()
     for i in range(iters):
         t0 = time.perf_counter()
-        out = fn(params, pool[i % len(pool)].copy(), blacklisted, thresholds)
+        out, _ = fn(params, pool[i % len(pool)].copy(), blacklisted, thresholds)
         inflight.append((t0, out))
         if len(inflight) > pipeline_depth:
             t0_old, old = inflight.pop(0)
@@ -135,7 +142,7 @@ def e2e_numbers() -> dict:
 
     from igaming_platform_tpu.obs.flight import DEFAULT_RECORDER, stage_breakdown
 
-    addr, shutdown = start_inprocess_server(
+    addr, shutdown, engine = start_inprocess_server(
         batch_size=int(os.environ.get("BENCH_E2E_BATCH", 8192)),
     )
     try:
@@ -152,7 +159,7 @@ def e2e_numbers() -> dict:
         # share of the RPC span the stages account for.
         breakdown = stage_breakdown(DEFAULT_RECORDER.snapshot(), method="ScoreBatch")
         probe = run_single_txn_probe(addr, n=120)
-        return {
+        result = {
             "e2e_stage_breakdown": breakdown,
             "e2e_stage_coverage_p50": breakdown.get("stage_coverage_p50"),
             "e2e_txns_per_sec": load["value"],
@@ -161,6 +168,9 @@ def e2e_numbers() -> dict:
             "e2e_rows_per_rpc": load["rows_per_rpc"],
             "e2e_concurrency": load["concurrency"],
             "e2e_rpc_errors": load["errors"],
+            # Failures by gRPC status code: shed-vs-failure (and which
+            # failure) readable at a glance in the artifact.
+            "e2e_rpc_errors_by_code": load["errors_by_code"],
             # Admission-gate sheds are loud backpressure, NOT failures —
             # reported separately so a healthy gate never reads as a
             # sick server (VERDICT r05 Weak #2).
@@ -168,6 +178,18 @@ def e2e_numbers() -> dict:
             "e2e_single_txn_p50_ms": probe["p50_ms"],
             "e2e_single_txn_p99_ms": probe["value"],
         }
+        # Pipelined host engine health (serve/pipeline_engine.py): the
+        # configured in-flight window, the depth actually reached, and
+        # how much of the host-stage work ran concurrently.
+        pipeline = getattr(engine, "pipeline", None)
+        if pipeline is not None:
+            stats = pipeline.stats()
+            result["pipeline_inflight_depth"] = stats["depth"]
+            result["pipeline_max_inflight"] = stats["max_inflight"]
+            result["host_stage_overlap_ratio"] = stats["overlap_ratio"]
+            result["e2e_stage_overlap_ratio_p50"] = breakdown.get(
+                "stage_overlap_ratio_p50")
+        return result
     finally:
         shutdown()
 

@@ -412,13 +412,19 @@ def config0_grpc_e2e(wire_mode: str = "row") -> dict:
 
     from igaming_platform_tpu.obs.flight import DEFAULT_RECORDER, stage_breakdown
 
-    addr, shutdown = start_inprocess_server(batch_size=8192)
+    addr, shutdown, engine = start_inprocess_server(batch_size=8192)
     try:
         DEFAULT_RECORDER.clear()  # warm-up RPCs out of the breakdown window
         load = run_grpc_load(addr, duration_s=6.0, rows_per_rpc=8192,
                              concurrency=6, wire_mode=wire_mode)
         load["stage_breakdown"] = stage_breakdown(
             DEFAULT_RECORDER.snapshot(), method="ScoreBatch")
+        pipeline = getattr(engine, "pipeline", None)
+        if pipeline is not None:
+            stats = pipeline.stats()
+            load["pipeline_inflight_depth"] = stats["depth"]
+            load["pipeline_max_inflight"] = stats["max_inflight"]
+            load["host_stage_overlap_ratio"] = stats["overlap_ratio"]
         probe = run_single_txn_probe(addr, n=120)
         load["single_txn_p99_ms"] = probe["value"]
         load["single_txn_p50_ms"] = probe["p50_ms"]

@@ -129,6 +129,21 @@ def run_grpc_load(
     results: list[list[tuple[float, float]]] = [[] for _ in range(concurrency)]
     errors = [0]
     shed = [0]
+    # Failures broken down by gRPC status code: a single opaque counter
+    # (1236 in BENCH_r05) cannot tell DEADLINE_EXCEEDED backpressure from
+    # UNAVAILABLE crashes at a glance. Guarded by errors_lock — worker
+    # threads share the dict.
+    errors_by_code: dict[str, int] = {}
+    errors_lock = threading.Lock()
+
+    def _count_error(exc: grpc.RpcError) -> None:
+        try:
+            code = exc.code().name
+        except Exception:  # noqa: BLE001 — a dead channel may not carry a code
+            code = "UNKNOWN"
+        with errors_lock:
+            errors[0] += 1
+            errors_by_code[code] = errors_by_code.get(code, 0) + 1
 
     def worker(k: int) -> None:
         # Own channel per worker: one HTTP/2 connection each, so the test
@@ -142,8 +157,8 @@ def run_grpc_load(
         try:
             for i in range(warmup_rpcs):
                 call(payloads[i % len(payloads)], timeout=60)
-        except grpc.RpcError:
-            errors[0] += 1
+        except grpc.RpcError as exc:
+            _count_error(exc)
         finally:
             # Worker 0 starts the clock even if its warmup failed —
             # otherwise the other workers spin on stop_at forever.
@@ -175,7 +190,7 @@ def run_grpc_load(
                     # Failed RPCs scored nothing — they must not count
                     # toward throughput or latency, or a failing server
                     # inflates the headline exactly when it shouldn't.
-                    errors[0] += 1
+                    _count_error(exc)
             else:
                 t1 = time.perf_counter()
                 results[k].append((t1, (t1 - t0) * 1000.0))
@@ -207,6 +222,7 @@ def run_grpc_load(
         "duration_s": duration_s,
         "rpcs": n_rpcs,
         "errors": errors[0],
+        "errors_by_code": dict(sorted(errors_by_code.items())),
         "bulk_shed": shed[0],
         "rpc_p50_ms": round(float(np.percentile(lat, 50)), 3) if n_rpcs else None,
         "rpc_p99_ms": round(float(np.percentile(lat, 99)), 3) if n_rpcs else None,
@@ -246,7 +262,9 @@ def start_inprocess_server(
     *, batch_size: int = 4096, ml_backend: str = "multitask", seed_accounts: int = 512
 ):
     """Production wiring on a free port: native feature store, multitask
-    backend, native wire codec. Returns (addr, shutdown_fn)."""
+    backend, native wire codec. Returns (addr, shutdown_fn, engine) —
+    the engine so harnesses can read server-side pipeline stats
+    (inflight depth, host-stage overlap) into their artifacts."""
     import jax
 
     from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
@@ -273,7 +291,7 @@ def start_inprocess_server(
         server.stop(0)
         engine.close()
 
-    return f"localhost:{port}", shutdown
+    return f"localhost:{port}", shutdown, engine
 
 
 def main() -> None:
@@ -289,8 +307,9 @@ def main() -> None:
     if wire_mode not in ("row", "index"):
         raise SystemExit(f"unknown wire mode {wire_mode!r} (row|index)")
     shutdown = None
+    engine = None
     if addr is None:
-        addr, shutdown = start_inprocess_server(
+        addr, shutdown, engine = start_inprocess_server(
             batch_size=int(os.environ.get("LOAD_BATCH", 4096)),
         )
     try:
@@ -301,6 +320,12 @@ def main() -> None:
             concurrency=int(os.environ.get("LOAD_CONCURRENCY", 4)),
             wire_mode=wire_mode,
         )
+        pipeline = getattr(engine, "pipeline", None)
+        if pipeline is not None:
+            stats = pipeline.stats()
+            load["pipeline_inflight_depth"] = stats["depth"]
+            load["pipeline_max_inflight"] = stats["max_inflight"]
+            load["host_stage_overlap_ratio"] = stats["overlap_ratio"]
         print(json.dumps(load), flush=True)
         probe = run_single_txn_probe(addr)
         print(json.dumps(probe), flush=True)

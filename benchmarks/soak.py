@@ -112,7 +112,7 @@ def main_wire() -> None:
     # and read the percentiles directly.
     target_rate = float(os.environ.get("SOAK_TARGET_RATE", 0) or 0)
 
-    addr, shutdown = start_inprocess_server(batch_size=batch)
+    addr, shutdown, _engine = start_inprocess_server(batch_size=batch)
     payloads = _build_request_payloads(rows_per_rpc)
     # One warm RPC before anchoring the schedule: the engine AOT-warms
     # its shapes at boot, but channel setup + first readback would

@@ -104,6 +104,12 @@ class BatcherConfig:
     # Max device batches with results still in flight (launch/readback
     # overlap); 1 = fully synchronous.
     pipeline_depth: int = 4
+    # Staged host pipeline for the wire batch paths (serve/
+    # pipeline_engine.py): dedicated stage workers overlap gather/pad,
+    # device dispatch and readback/encode across RPCs, with arena-pooled
+    # staging buffers. False (or HOST_PIPELINE=0) keeps the lockstep
+    # per-RPC flow.
+    host_pipeline: bool = True
     # Transient device failures (preemption, link hiccups): replay the
     # in-flight batch this many times before failing its requests — the
     # requeue semantics SURVEY.md §5 requires of a preempted slice.

@@ -37,9 +37,18 @@ class FlightRecorder:
 
     def record_root_span(self, span) -> None:
         """Root-span sink: only rpc.* roots are requests; batch-level
-        roots (batcher-thread stage spans) stay out of the ring."""
+        roots (batcher-thread stage spans) stay out of the ring.
+
+        With the pipelined host engine, one request's stages run
+        concurrently on stage-worker threads, so the busy-time sum
+        (``stage_busy_ms``) can exceed the request wall time; the
+        interval-union wall (``stage_wall_ms``) is the time actually
+        attributed to stages, and ``stage_overlap_ratio`` = 1 − wall/busy
+        is how much host-stage work ran concurrently."""
         if not span.name.startswith("rpc."):
             return
+        busy_ms = sum((span.stage_totals or {}).values())
+        wall_ms = tracing.union_duration_ms(span.stage_windows)
         self.record({
             "method": span.name[4:],
             "trace_id": span.trace_id,
@@ -50,6 +59,11 @@ class FlightRecorder:
             "stages_ms": {
                 k: round(v, 3) for k, v in (span.stage_totals or {}).items()
             },
+            "stage_busy_ms": round(busy_ms, 3),
+            "stage_wall_ms": round(wall_ms, 3),
+            "stage_overlap_ratio": (
+                round(max(0.0, 1.0 - wall_ms / busy_ms), 4) if busy_ms > 0 else 0.0
+            ),
             **{k: v for k, v in span.attributes.items()},
         })
 
@@ -97,13 +111,22 @@ def stage_breakdown(entries: list[dict], method: str | None = None) -> dict:
     durs = sorted(e["duration_ms"] for e in entries)
     stage_vals: dict[str, list[float]] = {}
     coverage: list[float] = []
+    overlap: list[float] = []
     for e in entries:
         stages = e.get("stages_ms") or {}
         for name, ms in stages.items():
             stage_vals.setdefault(name, []).append(ms)
         if e["duration_ms"] > 0:
-            coverage.append(
-                min(1.0, sum(stages.values()) / e["duration_ms"]))
+            # Coverage counts wall time attributed to stages. Under the
+            # pipelined engine stages overlap, so the per-stage SUM
+            # over-counts; prefer the recorded interval-union wall and
+            # fall back to the sum for pre-overlap entries.
+            attributed = e.get("stage_wall_ms")
+            if not attributed:
+                attributed = sum(stages.values())
+            coverage.append(min(1.0, attributed / e["duration_ms"]))
+        if e.get("stage_overlap_ratio") is not None:
+            overlap.append(e["stage_overlap_ratio"])
     return {
         "requests": len(entries),
         "rpc_p50_ms": round(_percentile(durs, 0.50), 3),
@@ -118,5 +141,7 @@ def stage_breakdown(entries: list[dict], method: str | None = None) -> dict:
         },
         "stage_coverage_p50": (
             round(_percentile(sorted(coverage), 0.50), 4) if coverage else None),
+        "stage_overlap_ratio_p50": (
+            round(_percentile(sorted(overlap), 0.50), 4) if overlap else None),
         "sample_trace_id": entries[-1].get("trace_id", ""),
     }

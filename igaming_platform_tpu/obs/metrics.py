@@ -326,6 +326,22 @@ class ServiceMetrics:
             "assembly — the batching-window share of single-txn latency",
             buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250),
         )
+        # Pipelined host engine (serve/pipeline_engine.py): stage-worker
+        # health for the wire batch paths.
+        self.pipeline_inflight = self.registry.gauge(
+            f"{service}_pipeline_inflight",
+            "Device batches currently in flight in the staged host "
+            "pipeline (dispatched, readback pending); bounded by the "
+            "configured pipeline depth plus the batch each stage worker "
+            "holds in hand",
+        )
+        self.pipeline_overlap_ratio = self.registry.gauge(
+            f"{service}_pipeline_overlap_ratio",
+            "Host-stage overlap ratio of the pipelined wire path "
+            "(1 - active wall / summed stage busy time): 0 = stages run "
+            "back-to-back, higher = gather/dispatch/readback/encode "
+            "genuinely concurrent",
+        )
         self.spans_dropped_total = self.registry.counter(
             f"{service}_spans_dropped_total",
             "Host spans evicted from the bounded span ring before export "

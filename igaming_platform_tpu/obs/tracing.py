@@ -47,6 +47,13 @@ class Span:
     # Root spans only: summed child-stage durations (ms) by span name —
     # the per-request decomposition the flight recorder snapshots.
     stage_totals: dict | None = field(default=None, repr=False, compare=False)
+    # Root spans only: (start, end) of each completed descendant stage.
+    # With pipelined serving, stages of one request run CONCURRENTLY on
+    # different worker threads, so the busy-time sum (stage_totals) can
+    # exceed the request's wall time; the interval union of these
+    # windows is the honest "time attributed to stages" figure, and
+    # 1 - union/sum is the request's host-stage overlap ratio.
+    stage_windows: list | None = field(default=None, repr=False, compare=False)
     root: "Span | None" = field(default=None, repr=False, compare=False)
 
     @property
@@ -83,6 +90,33 @@ def format_traceparent(trace_id: str, span_id: str) -> str:
 # batcher's launcher/collector threads each carry their own chain).
 _CURRENT: contextvars.ContextVar[Span | None] = contextvars.ContextVar(
     "igaming_current_span", default=None)
+
+# Roots accumulate stage_totals/stage_windows from EVERY thread their
+# stages run on (pipeline workers included) — one cheap module lock
+# serializes those two updates.
+_STAGE_LOCK = threading.Lock()
+# Bound per-root window accounting: a pathological request with more
+# stages than this keeps its totals but stops collecting windows.
+_MAX_STAGE_WINDOWS = 4096
+
+
+def union_duration_ms(windows: list | None) -> float:
+    """Total length (ms) of the UNION of (start, end) second intervals —
+    wall time covered by at least one stage, immune to double-counting
+    when stages overlap."""
+    if not windows:
+        return 0.0
+    total = 0.0
+    cur_start = cur_end = None
+    for start, end in sorted(windows):
+        if cur_end is None or start > cur_end:
+            if cur_end is not None:
+                total += cur_end - cur_start
+            cur_start, cur_end = start, end
+        elif end > cur_end:
+            cur_end = end
+    total += cur_end - cur_start
+    return total * 1000.0
 
 # Completion hooks. _SPAN_SINK fires for EVERY completed span (the metrics
 # layer feeds per-stage latency histograms from it); _ROOT_SINK fires for
@@ -177,16 +211,24 @@ DEFAULT_COLLECTOR = SpanCollector()
 
 @contextlib.contextmanager
 def span(name: str, collector: SpanCollector | None = None, *,
-         traceparent: str | None = None, **attributes):
+         traceparent: str | None = None, parent: Span | None = None,
+         **attributes):
     """Host-side span around a serving stage.
 
     Nested use on one thread links parent/child automatically; a root
     span may instead adopt a remote parent from a ``traceparent`` header
-    (client->front->follower propagation). Roots accumulate child-stage
-    durations into ``stage_totals`` and fire the flight-recorder sink.
+    (client->front->follower propagation). An explicit ``parent``
+    attaches a stage running on ANOTHER thread (a pipeline stage worker)
+    to its request's span — same trace id, and its duration still lands
+    in that root's stage accounting. Roots accumulate child-stage
+    durations into ``stage_totals`` (plus their (start, end) windows for
+    overlap accounting) and fire the flight-recorder sink.
     """
     collector = collector or DEFAULT_COLLECTOR
-    parent = _CURRENT.get()
+    ctx_parent = _CURRENT.get()
+    if ctx_parent is None and parent is not None:
+        ctx_parent = parent
+    parent = ctx_parent
     trace_id = parent_id = ""
     if parent is not None:
         trace_id, parent_id = parent.trace_id, parent.span_id
@@ -201,6 +243,7 @@ def span(name: str, collector: SpanCollector | None = None, *,
              attributes=attributes)
     if parent is None:
         s.stage_totals = {}
+        s.stage_windows = []
         s.root = s
     else:
         s.root = parent.root if parent.root is not None else parent
@@ -213,8 +256,12 @@ def span(name: str, collector: SpanCollector | None = None, *,
         collector.add(s)
         root = s.root
         if root is not None and root is not s and root.stage_totals is not None:
-            root.stage_totals[s.name] = (
-                root.stage_totals.get(s.name, 0.0) + s.duration_ms)
+            with _STAGE_LOCK:
+                root.stage_totals[s.name] = (
+                    root.stage_totals.get(s.name, 0.0) + s.duration_ms)
+                if (root.stage_windows is not None
+                        and len(root.stage_windows) < _MAX_STAGE_WINDOWS):
+                    root.stage_windows.append((s.start, s.end))
         if _SPAN_SINK is not None:
             try:
                 _SPAN_SINK(s)

@@ -330,13 +330,29 @@ def _now() -> float:
     return time.monotonic()
 
 
-def pad_batch(x: np.ndarray, batch_size: int) -> tuple[np.ndarray, int]:
-    """Pad rows up to the compiled batch size; returns (padded, n_valid)."""
+def pad_batch(
+    x: np.ndarray, batch_size: int, out: np.ndarray | None = None
+) -> tuple[np.ndarray, int]:
+    """Pad rows up to the compiled batch size; returns (padded, n_valid).
+
+    ``out`` is an optional preallocated destination (an arena buffer,
+    serve/arena.py): the pad writes into it — zeroing only the tail —
+    instead of allocating a fresh array per batch. A full batch is
+    returned as-is in either case (no copy to make)."""
     n = x.shape[0]
     if n == batch_size:
         return x, n
     if n > batch_size:
         raise ValueError(f"batch {n} exceeds compiled size {batch_size}")
-    padded = np.zeros((batch_size, *x.shape[1:]), dtype=x.dtype)
+    if out is not None:
+        if out.shape != (batch_size, *x.shape[1:]) or out.dtype != x.dtype:
+            raise ValueError(
+                f"pad buffer {out.shape}/{out.dtype} does not match "
+                f"({batch_size}, *{x.shape[1:]})/{x.dtype}")
+        out[:n] = x
+        out[n:] = 0
+        return out, n
+    # Cold-path fallback: hot loops pass `out=` from an arena pool.
+    padded = np.zeros((batch_size, *x.shape[1:]), dtype=x.dtype)  # noqa: MX04
     padded[:n] = x
     return padded, n

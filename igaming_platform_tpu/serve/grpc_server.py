@@ -358,6 +358,10 @@ class RiskGrpcService:
             # service's registry (obs/metrics.py) whether the cache is
             # already built or materializes on the first index-mode RPC.
             engine.bind_cache_metrics(self.metrics)
+        if hasattr(engine, "bind_pipeline_metrics"):
+            # Host-pipeline gauges (inflight depth, overlap ratio) —
+            # bound now or at the pipeline's lazy build, same pattern.
+            engine.bind_pipeline_metrics(self.metrics)
         # Request-lifecycle observability: every completed stage span feeds
         # risk_stage_latency_ms (with trace-id exemplars), span-ring
         # evictions count in risk_spans_dropped_total, and the continuous

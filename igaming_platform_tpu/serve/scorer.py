@@ -79,14 +79,23 @@ def _row_divisor(mesh, ml_backend: str) -> int:
     return max(1, d)
 
 
-def _pack_outputs(fn):
+def _pack_outputs(fn, echo_batch: bool = False):
     """Wrap a dict-output score fn into one int32 [5, B] output (one D2H
     transfer). Row order: score, action, reason_mask, rule_score,
-    ml_score as IEEE-754 bits."""
+    ml_score as IEEE-754 bits.
+
+    ``echo_batch=True`` additionally returns the input batch unchanged.
+    That echo is what makes donating the batch buffer CORRECT: a donated
+    input is only reusable when some output matches its shape/dtype/
+    layout, and the packed [5, B] int32 result never matches the
+    [B, 30] feature matrix — donating without the echo is what produced
+    the warmup-visible "Some donated buffers were not usable:
+    float32[...]" warning. With the echo, XLA aliases the output onto
+    the donated buffer and the staging slot is recycled in place."""
 
     def packed(params, x, blacklisted, thresholds):
         out = fn(params, x, blacklisted, thresholds)
-        return jnp.stack([
+        stacked = jnp.stack([
             out["score"].astype(jnp.int32),
             out["action"].astype(jnp.int32),
             out["reason_mask"].astype(jnp.int32),
@@ -95,6 +104,7 @@ def _pack_outputs(fn):
                 out["ml_score"].astype(jnp.float32), jnp.int32
             ),
         ])
+        return (stacked, x) if echo_batch else stacked
 
     return packed
 
@@ -199,16 +209,23 @@ class TPUScoringEngine:
         # instead of a five-array dict: on a host link where readback cost
         # is per-transfer, one D2H copy replaces five (the ml_score float
         # rides as its IEEE bits via bitcast, recovered with .view on the
-        # host — lossless).
-        packed_fn = _pack_outputs(fn)
+        # host — lossless). The batch echo makes input donation usable
+        # (see _pack_outputs): the staging buffer of every step is
+        # recycled in place instead of freed + reallocated per batch.
+        packed_fn = _pack_outputs(fn, echo_batch=True)
         # The host tier has no device link to compress, so it always
         # serves raw float32 — it must compile the UNWRAPPED graph (the
         # int8-wrapped one would dequantize raw f32 features to inf).
-        packed_fn_host = _pack_outputs(fn_f32)
+        # Echoed too (uniform call shape), but NOT donated: host-tier
+        # inputs may be caller-owned arrays, and on the CPU backend jax
+        # can alias host memory zero-copy.
+        packed_fn_host = _pack_outputs(fn_f32, echo_batch=True)
         # Kept unjitted for the device-cache path (ensure_cache): the
         # cached step gathers f32 rows already resident in HBM, so it
-        # always wraps the raw-f32 graph regardless of WIRE_DTYPE.
-        self._packed_fn_f32 = packed_fn_host
+        # always wraps the raw-f32 graph regardless of WIRE_DTYPE — and
+        # WITHOUT the batch echo (the cached step composes its x on
+        # device; there is no host staging buffer to donate).
+        self._packed_fn_f32 = _pack_outputs(fn_f32)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -236,14 +253,17 @@ class TPUScoringEngine:
             self._fn = jax.jit(
                 fn, in_shardings=(None, row, vec, repl), out_shardings=vec
             )
+            # Donated batch + row-sharded echo: the echo's sharding
+            # matches the input's, so the donated shards alias cleanly.
             self._packed_fn = jax.jit(
                 packed_fn,
                 in_shardings=(None, row, vec, repl),
-                out_shardings=NamedSharding(mesh, P(None, AXIS_DATA)),
+                out_shardings=(NamedSharding(mesh, P(None, AXIS_DATA)), row),
+                donate_argnums=(1,),
             )
         else:
             self._fn = jax.jit(fn)
-            self._packed_fn = jax.jit(packed_fn)
+            self._packed_fn = jax.jit(packed_fn, donate_argnums=(1,))
 
         # Host latency tier: the SAME score graph compiled for the host
         # CPU, used for near-empty flushes (n <= host_tier_rows). The
@@ -310,6 +330,20 @@ class TPUScoringEngine:
             raise ValueError(
                 f"WIRE_MODE={self.wire_mode!r} not supported (use 'row' or 'index')")
 
+        # Pipelined host engine (serve/pipeline_engine.py): stage workers
+        # overlap gather/pad, device dispatch and readback/encode across
+        # wire batches, with arena-pooled staging buffers. Default ON for
+        # the wire paths; HOST_PIPELINE=0 (or host_pipeline=False) keeps
+        # the lockstep _score_rows_encode flow — also the parity
+        # reference the pipeline is pinned bit-exact against.
+        env_pipe = os.environ.get("HOST_PIPELINE", "")
+        self._pipeline_enabled = (
+            bcfg.host_pipeline if env_pipe == "" else env_pipe not in ("0", "false")
+        )
+        self._host_pipeline = None
+        self._host_pipeline_lock = threading.Lock()
+        self._pipeline_metrics_sink = None
+
         self._batcher = ContinuousBatcher(
             cfg=batcher_config,
             dispatch=self._dispatch_requests,
@@ -344,6 +378,55 @@ class TPUScoringEngine:
 
     def close(self) -> None:
         self._batcher.stop()
+        if self._host_pipeline is not None:
+            self._host_pipeline.close()
+
+    # -- pipelined host engine (serve/pipeline_engine.py) --------------------
+
+    @property
+    def pipeline(self):
+        """The host pipeline, if built (None until the first pipelined
+        wire batch, or when disabled)."""
+        return self._host_pipeline
+
+    def bind_pipeline_metrics(self, metrics) -> None:
+        """Route pipeline gauges (inflight depth, overlap ratio) into a
+        ServiceMetrics registry — applied now if the pipeline is built,
+        at first build otherwise."""
+        self._pipeline_metrics_sink = metrics
+        if self._host_pipeline is not None:
+            self._host_pipeline.bind_metrics(metrics)
+
+    def _ensure_pipeline(self):
+        """Build (once) the staged host pipeline; None when disabled."""
+        if not self._pipeline_enabled:
+            return None
+        if self._host_pipeline is None:
+            with self._host_pipeline_lock:
+                if self._host_pipeline is None:
+                    from igaming_platform_tpu.serve.pipeline_engine import HostPipeline
+
+                    pipe = HostPipeline(self, depth=self._pipeline_depth)
+                    if self._pipeline_metrics_sink is not None:
+                        pipe.bind_metrics(self._pipeline_metrics_sink)
+                    self._host_pipeline = pipe
+        return self._host_pipeline
+
+    def _launch_padded(self, xp: np.ndarray, blp: np.ndarray, use_host: bool):
+        """Dispatch one already-padded staging batch (pipeline dispatch
+        worker). The caller owns the staging buffers and must keep them
+        alive until readback — jax may alias host memory zero-copy on
+        the CPU backend."""
+        with self._params_lock:
+            params = self._params_host if use_host else self._params
+            thresholds = self._thresholds_host if use_host else self._thresholds
+        if use_host:
+            out, _ = self._fn_host(params, xp, blp, thresholds)
+            return out
+        out, _ = self._packed_fn(params, xp, blp, thresholds)
+        if hasattr(out, "copy_to_host_async"):
+            out.copy_to_host_async()
+        return out
 
     # -- params / thresholds -------------------------------------------------
 
@@ -652,8 +735,13 @@ class TPUScoringEngine:
             params = self._params_host if use_host else self._params
             thresholds = self._thresholds_host if use_host else self._thresholds
         if use_host:
-            return self._fn_host(params, xp, blp, thresholds), n
-        out = self._packed_fn(params, xp, blp, thresholds)
+            out, _ = self._fn_host(params, xp, blp, thresholds)
+            return out, n
+        # The echo (the donated staging slot, recycled in place) is
+        # dropped here: this lockstep path pads into fresh arrays. The
+        # pipelined path (serve/pipeline_engine.py) holds its arena
+        # buffers until readback instead.
+        out, _ = self._packed_fn(params, xp, blp, thresholds)
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
         return out, n
@@ -760,7 +848,7 @@ class TPUScoringEngine:
                     for i in range(total)
                 ]
                 x, bl = self.features.gather_batch(rows)
-        return self._score_rows_encode(x, bl, include_features, start)
+        return self._score_rows_to_wire(x, bl, include_features, start)
 
     def score_batch_wire_bytes(
         self, payload: bytes, *, include_features: bool = True
@@ -780,7 +868,20 @@ class TPUScoringEngine:
             raise RuntimeError("feature store has no native wire decoder")
         with span("score.decode"):
             x, bl = self.features.decode_gather(payload)
-        return self._score_rows_encode(x, bl, include_features, start), x.shape[0]
+        return self._score_rows_to_wire(x, bl, include_features, start), x.shape[0]
+
+    def _score_rows_to_wire(
+        self, x: np.ndarray, bl: np.ndarray, include_features: bool, start: float
+    ) -> bytes:
+        """Route a gathered [N, 30] batch to response wire bytes: through
+        the staged host pipeline when enabled (stage workers overlap this
+        RPC's chunks with other in-flight RPCs), else the lockstep
+        chunked flow. Device outputs are bit-exact either way
+        (tests/test_host_pipeline.py)."""
+        pipe = self._ensure_pipeline()
+        if pipe is not None:
+            return pipe.score_rows_to_wire(x, bl, include_features, start)
+        return self._score_rows_encode(x, bl, include_features, start)
 
     def _score_rows_encode(
         self, x: np.ndarray, bl: np.ndarray, include_features: bool, start: float

@@ -1,0 +1,22 @@
+"""Seeded MX04 violations: per-batch numpy allocations inside hot-loop
+functions — one registered by marker at module level, one as a method
+(qualname-style, the registry shape) — plus the scoped-noqa escape
+hatch staying quiet on a deliberate cold path."""
+
+import numpy as np
+
+
+def dispatch_chunk(x, batch_size):  # analysis: hot-loop
+    padded = np.zeros((batch_size, x.shape[1]), dtype=x.dtype)  # expect: MX04
+    scratch = np.empty((batch_size,), dtype=np.int64)  # expect: MX04
+    padded[: x.shape[0]] = x
+    scratch.fill(0)
+    return padded, scratch
+
+
+class Pipeline:
+    # analysis: hot-loop
+    def readback(self, out, n):
+        rows = np.ascontiguousarray(out, dtype=np.float32)  # expect: MX04
+        cold = np.zeros((n,), dtype=np.bool_)  # noqa: MX04 — startup-only path
+        return rows, cold

@@ -1,0 +1,17 @@
+"""Compliant siblings of hotloop_bad.py: arena-sourced staging inside a
+hot loop, and unrestricted allocation in UNREGISTERED functions (MX04
+applies only to registered/marked hot loops)."""
+
+import numpy as np
+
+
+def dispatch_chunk_pooled(arena, x, batch_size):  # analysis: hot-loop
+    padded = arena.acquire((batch_size, x.shape[1]), x.dtype)
+    padded[: x.shape[0]] = x
+    padded[x.shape[0]:] = 0
+    return padded
+
+
+def warmup(batch_size, n_features):
+    # Not a hot loop — startup code allocates freely.
+    return np.zeros((batch_size, n_features), dtype=np.float32)

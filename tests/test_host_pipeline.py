@@ -1,0 +1,332 @@
+"""Pipelined host engine (serve/pipeline_engine.py) + staging arenas.
+
+The pipeline changes WHEN host work happens (stage workers, overlapped),
+never WHAT comes out: device outputs are pinned bit-exact against the
+lockstep ``_score_rows_encode`` path, the donated/echoed packed step is
+pinned warning-free at warmup, and the arena lifecycle (release only
+after readback) is exercised under concurrent submitters.
+"""
+
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
+from igaming_platform_tpu.obs import tracing
+from igaming_platform_tpu.serve import wire
+from igaming_platform_tpu.serve.arena import ArenaPool
+from igaming_platform_tpu.serve.scorer import ScoreRequest, TPUScoringEngine
+
+needs_native = pytest.mark.skipif(
+    not wire.native_wire_available(), reason="native toolchain unavailable")
+
+
+def _engine(batch_size=64, **kw):
+    return TPUScoringEngine(
+        ScoringConfig(),
+        batcher_config=BatcherConfig(batch_size=batch_size, max_wait_ms=1.0, **kw),
+    )
+
+
+def _gather(engine, n, seed=3):
+    rng = np.random.default_rng(seed)
+    reqs = [
+        ScoreRequest(f"acct-{i % 17}", amount=int(rng.integers(100, 90_000)),
+                     tx_type=("deposit", "bet", "withdraw")[i % 3])
+        for i in range(n)
+    ]
+    return engine.features.gather_batch(reqs)
+
+
+def _decode_fields(payload):
+    from igaming_platform_tpu.proto_gen.risk.v1 import risk_pb2
+
+    msg = risk_pb2.ScoreBatchResponse.FromString(payload)
+    return [
+        (r.score, r.action, r.rule_score, r.ml_score, tuple(r.reason_codes),
+         r.features.SerializeToString())
+        for r in msg.results
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Donation correctness (the ISSUE-4 warmup warning)
+
+
+def test_warmup_emits_no_donation_warnings():
+    """The donated packed step must alias cleanly: 'Some donated buffers
+    were not usable' at warmup means the donation is decorative and the
+    steady state reallocates every batch."""
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        engine = _engine()
+        try:
+            engine.warmup()  # once more, explicitly, post-construction
+        finally:
+            engine.close()
+    donation = [str(w.message) for w in caught
+                if "donated" in str(w.message).lower()]
+    assert donation == [], f"warmup raised donation warnings: {donation}"
+
+
+def test_donated_step_matches_undonated_graph():
+    """The echo-donated packed executable must score identically to the
+    plain dict-output graph (same inputs, bit-exact)."""
+    engine = _engine(batch_size=32)
+    try:
+        x, bl = _gather(engine, 32)
+        out, n = engine._launch_device(x.copy(), bl.copy())
+        from igaming_platform_tpu.serve.scorer import _unpack_host
+        import jax
+
+        packed = _unpack_host(jax.device_get(out))
+        plain = {k: np.asarray(v) for k, v in engine.score_arrays(x, bl).items()}
+        assert n == 32
+        for key in ("score", "action", "reason_mask", "rule_score"):
+            np.testing.assert_array_equal(packed[key], plain[key])
+        np.testing.assert_array_equal(
+            packed["ml_score"].view(np.int32),
+            plain["ml_score"].astype(np.float32).view(np.int32))
+    finally:
+        engine.close()
+
+
+# ---------------------------------------------------------------------------
+# Pipeline parity + behavior
+
+
+@needs_native
+@pytest.mark.parametrize("n", [1, 64, 150, 257])
+def test_pipeline_bit_exact_vs_lockstep(n):
+    """Same chunk boundaries, same executables, same zero padding —
+    the pipelined path must produce identical scoring fields for every
+    row, including the feature echo, at sizes that exercise partial
+    final chunks."""
+    engine = _engine(batch_size=64)
+    try:
+        x, bl = _gather(engine, n)
+        lockstep = engine._score_rows_encode(x, bl, True, time.monotonic())
+        pipe = engine._ensure_pipeline()
+        assert pipe is not None
+        pipelined = pipe.score_rows_to_wire(x, bl, True, time.monotonic())
+        lock_rows = _decode_fields(lockstep)
+        pipe_rows = _decode_fields(pipelined)
+        assert len(pipe_rows) == n
+        assert pipe_rows == lock_rows
+    finally:
+        engine.close()
+
+
+def x_dim():
+    from igaming_platform_tpu.core.features import NUM_FEATURES
+
+    return NUM_FEATURES
+
+
+def test_pipeline_empty_batch_returns_empty_bytes():
+    engine = _engine(batch_size=32)
+    try:
+        pipe = engine._ensure_pipeline()
+        empty = np.zeros((0, x_dim()), dtype=np.float32)
+        assert pipe.score_rows_to_wire(
+            empty, np.zeros((0,), bool), True, time.monotonic()) == b""
+    finally:
+        engine.close()
+
+
+@needs_native
+def test_pipeline_concurrent_submitters_get_their_own_results():
+    """Chunks of concurrent jobs interleave through the shared stage
+    workers; every caller must get exactly its own rows back."""
+    engine = _engine(batch_size=32)
+    try:
+        pipe = engine._ensure_pipeline()
+        inputs = [_gather(engine, 30 + 17 * k, seed=k) for k in range(6)]
+        expected = [
+            _decode_fields(engine._score_rows_encode(x, bl, False, time.monotonic()))
+            for x, bl in inputs
+        ]
+        got: list = [None] * len(inputs)
+
+        def worker(k):
+            x, bl = inputs[k]
+            got[k] = _decode_fields(
+                pipe.score_rows_to_wire(x, bl, False, time.monotonic()))
+
+        threads = [threading.Thread(target=worker, args=(k,))
+                   for k in range(len(inputs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert got == expected
+        stats = pipe.stats()
+        assert stats["jobs"] >= len(inputs)
+        assert 0.0 <= stats["overlap_ratio"] <= 1.0
+        assert stats["arena"]["reused"] > 0  # staging buffers recycled
+    finally:
+        engine.close()
+
+
+@needs_native
+def test_pipeline_inflight_gauge_and_stats():
+    engine = _engine(batch_size=32)
+    try:
+        seen = []
+        pipe = engine._ensure_pipeline()
+        pipe.on_inflight = seen.append
+        x, bl = _gather(engine, 200)
+        pipe.score_rows_to_wire(x, bl, False, time.monotonic())
+        assert seen, "inflight hook never fired"
+        assert seen[-1] == 0  # drained
+        assert max(seen) >= 1
+        stats = pipe.stats()
+        assert stats["depth"] >= 2  # >= 2 in-flight device batches by design
+        assert stats["batches"] == 7  # ceil(200/32)
+        assert set(stats["stage_busy_ms"]) == {"dispatch", "readback", "encode"}
+    finally:
+        engine.close()
+
+
+@needs_native
+def test_pipeline_routes_wire_path_and_disable_falls_back():
+    """score_batch_wire uses the pipeline by default; HOST_PIPELINE=0 /
+    host_pipeline=False keeps the lockstep path, byte-for-byte the same
+    scoring fields."""
+    engine = _engine(batch_size=32)
+    engine_off = None
+    try:
+        ids = [f"acct-{i % 9}" for i in range(70)]
+        amounts = [1000 + 13 * i for i in range(70)]
+        types = ["deposit"] * 70
+        on = engine.score_batch_wire(ids, amounts, types)
+        assert engine.pipeline is not None  # built lazily on first use
+
+        engine_off = _engine(batch_size=32, host_pipeline=False)
+        off = engine_off.score_batch_wire(ids, amounts, types)
+        assert engine_off.pipeline is None
+        assert _decode_fields(on) == _decode_fields(off)
+    finally:
+        engine.close()
+        if engine_off is not None:
+            engine_off.close()
+
+
+def test_pipeline_close_idempotent_and_reaps_threads():
+    engine = _engine(batch_size=32)
+    pipe = engine._ensure_pipeline()
+    if pipe is None:
+        engine.close()
+        pytest.skip("pipeline disabled")
+    before = threading.active_count()
+    engine.close()
+    engine.close()
+    pipe.close()
+    time.sleep(0.1)
+    assert not any(t.is_alive() for t in pipe._stage_threads)
+    assert not pipe._readback_worker.is_alive()
+    assert threading.active_count() <= before
+
+
+def test_pipeline_submit_after_close_raises():
+    engine = _engine(batch_size=32)
+    pipe = engine._ensure_pipeline()
+    engine.close()
+    if pipe is None:
+        pytest.skip("pipeline disabled")
+    with pytest.raises(RuntimeError, match="closed"):
+        pipe.score_rows_to_wire(
+            np.zeros((4, x_dim()), np.float32), np.zeros((4,), bool),
+            True, time.monotonic())
+
+
+# ---------------------------------------------------------------------------
+# Cross-thread stage spans + overlap accounting
+
+
+@needs_native
+def test_stage_spans_attach_to_rpc_root_across_threads():
+    engine = _engine(batch_size=32)
+    try:
+        pipe = engine._ensure_pipeline()
+        x, bl = _gather(engine, 100)
+        with tracing.span("rpc.PipelineTest") as root:
+            pipe.score_rows_to_wire(x, bl, False, time.monotonic())
+        totals = root.stage_totals
+        assert {"score.dispatch", "score.readback", "score.encode"} <= set(totals)
+        # 4 chunks -> 4 dispatch + 4 readback + 1 encode windows.
+        assert len(root.stage_windows) >= 9
+        # The union wall can never exceed the per-stage busy sum.
+        assert tracing.union_duration_ms(root.stage_windows) <= sum(totals.values()) + 1e-6
+    finally:
+        engine.close()
+
+
+def test_union_duration_merges_overlapping_windows():
+    assert tracing.union_duration_ms([]) == 0.0
+    assert tracing.union_duration_ms([(0.0, 0.010)]) == pytest.approx(10.0)
+    # Two fully-overlapped 10 ms stages cover 10 ms of wall, not 20.
+    assert tracing.union_duration_ms(
+        [(0.0, 0.010), (0.0, 0.010)]) == pytest.approx(10.0)
+    assert tracing.union_duration_ms(
+        [(0.0, 0.010), (0.005, 0.020), (0.030, 0.040)]) == pytest.approx(30.0)
+
+
+def test_flight_entry_carries_overlap_fields():
+    from igaming_platform_tpu.obs.flight import FlightRecorder, stage_breakdown
+
+    rec = FlightRecorder(capacity=8)
+    s = tracing.Span(name="rpc.X", start=0.0, end=0.010, trace_id="t", span_id="s")
+    s.stage_totals = {"score.dispatch": 8.0, "score.readback": 8.0}
+    s.stage_windows = [(0.0, 0.008), (0.0, 0.008)]  # fully concurrent
+    rec.record_root_span(s)
+    [entry] = rec.snapshot()
+    assert entry["stage_busy_ms"] == pytest.approx(16.0)
+    assert entry["stage_wall_ms"] == pytest.approx(8.0)
+    assert entry["stage_overlap_ratio"] == pytest.approx(0.5)
+    # Coverage uses the interval-union wall, not the (over-counting) sum.
+    breakdown = stage_breakdown(rec.snapshot(), method="X")
+    assert breakdown["stage_coverage_p50"] == pytest.approx(0.8)
+    assert breakdown["stage_overlap_ratio_p50"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# ArenaPool
+
+
+def test_arena_reuses_exact_shape_and_dtype():
+    pool = ArenaPool()
+    a = pool.acquire((8, 3), np.float32)
+    pool.release(a)
+    assert pool.acquire((8, 3), np.float32) is a
+    b = pool.acquire((8, 3), np.float64)  # different dtype -> different slot
+    assert b is not a
+    assert pool.stats()["allocated"] == 2
+    assert pool.stats()["reused"] == 1
+
+
+def test_arena_zero_flag_clears_recycled_buffer():
+    pool = ArenaPool()
+    a = pool.acquire((4,), np.int32)
+    a[:] = 7
+    pool.release(a)
+    dirty = pool.acquire((4,), np.int32)
+    assert (dirty == 7).all()  # recycled as-is by default
+    pool.release(dirty)
+    clean = pool.acquire((4,), np.int32, zero=True)
+    assert (clean == 0).all()
+
+
+def test_arena_bounds_idle_buffers_and_drops_foreign_views():
+    pool = ArenaPool(max_per_key=2)
+    bufs = [pool.acquire((4,), np.int8) for _ in range(5)]
+    for b in bufs:
+        pool.release(b)
+    assert pool.stats()["idle"] == 2  # the rest went back to the allocator
+    pool.release(None)  # tolerated
+    base = np.zeros((8, 2), np.float32)
+    pool.release(base[::2])  # non-contiguous view: dropped, not pooled
+    assert pool.stats()["idle"] == 2

@@ -1,0 +1,164 @@
+"""Wire-path edge sizes: round-trips at n=0 / n=1 / non-multiple-of-pad
+shapes, pad_batch dtype preservation (including the arena ``out=``
+seam), and the index-mode frame at the same edges.
+
+The serving loop pads every batch to a compiled shape and the codecs
+run on whatever a client sends — the edges (empty batch, one row, a
+count that divides into a partial final chunk) are exactly where a
+stride/offset bug would hide while the happy-path soak stays green.
+"""
+
+import numpy as np
+import pytest
+
+from igaming_platform_tpu.core.enums import REASON_BIT_ORDER
+from igaming_platform_tpu.core.features import NUM_FEATURES
+from igaming_platform_tpu.serve import wire
+from igaming_platform_tpu.serve.arena import ArenaPool
+from igaming_platform_tpu.serve.batcher import pad_batch
+
+
+# ---------------------------------------------------------------------------
+# pad_batch dtype preservation + the out= arena seam
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.int8, np.int32, np.int64, np.bool_])
+def test_pad_batch_preserves_dtype(dtype):
+    x = np.ones((5, 3), dtype=dtype)
+    padded, n = pad_batch(x, 16)
+    assert n == 5
+    assert padded.dtype == x.dtype
+    assert padded.shape == (16, 3)
+    assert (np.asarray(padded[5:]) == 0).all()
+
+
+def test_pad_batch_full_batch_is_identity():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    padded, n = pad_batch(x, 4)
+    assert padded is x and n == 4
+
+
+def test_pad_batch_oversize_raises():
+    with pytest.raises(ValueError):
+        pad_batch(np.zeros((5, 3), np.float32), 4)
+
+
+def test_pad_batch_into_out_buffer_zeroes_tail():
+    x = np.full((3, 2), 7.0, dtype=np.float32)
+    out = np.full((8, 2), 9.0, dtype=np.float32)  # dirty recycled buffer
+    padded, n = pad_batch(x, 8, out=out)
+    assert padded is out and n == 3
+    assert (out[:3] == 7.0).all() and (out[3:] == 0.0).all()
+
+
+def test_pad_batch_out_mismatch_raises():
+    x = np.zeros((3, 2), np.float32)
+    with pytest.raises(ValueError):
+        pad_batch(x, 8, out=np.zeros((8, 2), np.float64))
+    with pytest.raises(ValueError):
+        pad_batch(x, 8, out=np.zeros((8, 3), np.float32))
+
+
+def test_pad_batch_1d_bool_with_arena_buffer():
+    pool = ArenaPool()
+    bl = np.array([True, False, True])
+    buf = pool.acquire((8,), np.bool_)
+    padded, n = pad_batch(bl, 8, out=buf)
+    assert padded.dtype == np.bool_ and n == 3
+    assert padded[:3].tolist() == [True, False, True]
+    assert not padded[3:].any()
+    pool.release(buf)
+    assert pool.acquire((8,), np.bool_) is buf  # recycled, not reallocated
+
+
+# ---------------------------------------------------------------------------
+# index-mode frame round-trips at the edges
+
+
+def _roundtrip(ids, amounts, types, **cols):
+    frame = wire.encode_index_batch(ids, amounts, types, **cols)
+    return wire.decode_index_batch(frame)
+
+
+def test_index_frame_roundtrip_empty():
+    ids, amounts, codes, ips, devices, fps = _roundtrip([], [], [])
+    assert ids == [] and amounts.size == 0 and codes.size == 0
+    assert ips is None and devices is None and fps is None
+
+
+def test_index_frame_roundtrip_single_row():
+    ids, amounts, codes, ips, devices, fps = _roundtrip(
+        ["acct-1"], [12345], ["withdraw"], ips=["10.0.0.1"])
+    assert ids == [b"acct-1"]
+    assert amounts.tolist() == [12345]
+    assert codes.tolist() == [wire.TX_TYPE_CODES["withdraw"]]
+    assert ips == [b"10.0.0.1"] and devices is None and fps is None
+
+
+def test_index_frame_roundtrip_non_multiple_of_pad_shape():
+    # 37 rows: chunks against any power-of-two compiled shape leave a
+    # partial tail; every column must keep row alignment.
+    n = 37
+    ids = [f"acct-{i}" for i in range(n)]
+    amounts = [100 + 7 * i for i in range(n)]
+    types = [("deposit", "bet", "win", "withdraw", "other")[i % 5] for i in range(n)]
+    devices = [f"dev-{i % 3}" if i % 2 else "" for i in range(n)]
+    got_ids, got_amounts, got_codes, got_ips, got_devices, _ = _roundtrip(
+        ids, amounts, types, devices=devices)
+    assert got_ids == [s.encode() for s in ids]
+    assert got_amounts.tolist() == amounts
+    assert got_codes.tolist() == [wire.TX_TYPE_CODES.get(t, 4) for t in types]
+    assert got_ips is None
+    assert got_devices == [s.encode() for s in devices]
+
+
+def test_index_frame_truncation_and_bad_magic_raise():
+    frame = wire.encode_index_batch(["a", "b"], [1, 2], ["deposit", "bet"])
+    with pytest.raises(ValueError):
+        wire.decode_index_batch(frame[:-3])
+    with pytest.raises(ValueError):
+        wire.decode_index_batch(b"NOPE" + frame[4:])
+    with pytest.raises(ValueError):
+        wire.decode_index_batch(b"")
+
+
+# ---------------------------------------------------------------------------
+# native response encode at the edges (skip when the toolchain is absent)
+
+_native = pytest.mark.skipif(
+    not wire.native_wire_available(), reason="native toolchain unavailable")
+
+
+def _result_arrays(n, seed=1):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.integers(0, 101, n).astype(np.int32),
+        rng.integers(1, 4, n).astype(np.int32),
+        rng.integers(0, 1 << len(REASON_BIT_ORDER), n).astype(np.int32),
+        rng.integers(0, 101, n).astype(np.int32),
+        rng.random(n).astype(np.float32),
+        rng.integers(0, 500, n).astype(np.int64),
+    )
+
+
+@_native
+def test_encode_score_batch_empty():
+    assert wire.encode_score_batch(*_result_arrays(0), None) == b""
+
+
+@_native
+@pytest.mark.parametrize("n", [1, 37])
+def test_encode_score_batch_edge_sizes_parse_back(n):
+    from igaming_platform_tpu.proto_gen.risk.v1 import risk_pb2
+
+    score, action, mask, rule, ml, rtms = _result_arrays(n)
+    feats = np.random.default_rng(2).random((n, NUM_FEATURES)).astype(np.float32)
+    payload = wire.encode_score_batch(score, action, mask, rule, ml, rtms, feats)
+    msg = risk_pb2.ScoreBatchResponse.FromString(payload)
+    assert len(msg.results) == n
+    for i in (0, n - 1):
+        assert msg.results[i].score == int(score[i])
+        assert msg.results[i].action == int(action[i])
+        assert msg.results[i].rule_score == int(rule[i])
+        assert msg.results[i].response_time_ms == int(rtms[i])
+        np.testing.assert_allclose(msg.results[i].ml_score, ml[i], rtol=1e-6)

@@ -20,6 +20,28 @@ _CLOCK_CALLS = {"perf_counter", "monotonic", "perf_counter_ns", "monotonic_ns"}
 _METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
 _METRIC_FACTORIES = {"counter", "gauge", "histogram"}
 
+# MX04: the registered hot-loop functions — the per-batch serving loop
+# whose host allocations the arena pools (serve/arena.py) exist to
+# remove. Keyed by repo-relative path suffix -> qualnames. New hot loops
+# register here, or mark the def line with `# analysis: hot-loop`.
+_HOT_LOOP_REGISTRY: dict[str, frozenset[str]] = {
+    "igaming_platform_tpu/serve/scorer.py": frozenset({
+        "TPUScoringEngine._launch_device",
+        "TPUScoringEngine._launch_padded",
+        "TPUScoringEngine._launch_cached",
+    }),
+    "igaming_platform_tpu/serve/pipeline_engine.py": frozenset({
+        "HostPipeline._dispatch_chunk",
+        "HostPipeline._stage_loop",
+        "HostPipeline._readback_loop",
+    }),
+    "igaming_platform_tpu/serve/batcher.py": frozenset({"pad_batch"}),
+}
+_HOT_LOOP_MARKER = "analysis: hot-loop"
+_NP_ALIASES = {"np", "numpy", "onp"}
+_NP_ALLOCATORS = {"zeros", "empty", "ones", "full", "zeros_like",
+                  "empty_like", "ones_like", "ascontiguousarray"}
+
 
 def _scope_calls(body: list[ast.stmt]):
     """Yield Call nodes in ``body`` WITHOUT descending into nested
@@ -106,6 +128,64 @@ def metric_help_text(ctx: FileContext):
             yield node.lineno, (
                 "metric registered without help text — pass a non-empty "
                 "description so the series is readable on /metrics")
+
+
+def _function_qualnames(tree: ast.Module):
+    """Yield (qualname, FunctionDef) for every function, with class
+    nesting reflected dotted (`Cls.method`, `Cls.method.inner`)."""
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                yield qual, child
+                yield from walk(child, f"{qual}.")
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.")
+            else:
+                yield from walk(child, prefix)
+
+    yield from walk(tree, "")
+
+
+def _has_hot_loop_marker(ctx: FileContext, node: ast.AST) -> bool:
+    lines = ctx.src.splitlines()
+    for lineno in (node.lineno, node.lineno - 1):
+        if 1 <= lineno <= len(lines) and _HOT_LOOP_MARKER in lines[lineno - 1]:
+            return True
+    return False
+
+
+@rule("MX04", "hot-loop-alloc",
+      "Per-batch numpy allocations (np.zeros/np.empty/np.full/"
+      "np.ascontiguousarray/...) inside a registered hot-loop function "
+      "put the allocator back on the serving loop the staging arenas "
+      "removed. Acquire buffers from an arena pool (serve/arena.py) or "
+      "pad via pad_batch(out=...); a deliberate cold path carries a "
+      "scoped `# noqa: MX04`. Functions register in _HOT_LOOP_REGISTRY "
+      "or with an `# analysis: hot-loop` marker on the def line.")
+def hot_loop_alloc(ctx: FileContext):
+    registered = frozenset()
+    for suffix, quals in _HOT_LOOP_REGISTRY.items():
+        if ctx.relpath.endswith(suffix):
+            registered = quals
+            break
+    for qual, node in _function_qualnames(ctx.tree):
+        if qual not in registered and not _has_hot_loop_marker(ctx, node):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            if not (isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in _NP_ALIASES
+                    and fn.attr in _NP_ALLOCATORS):
+                continue
+            yield sub.lineno, (
+                f"per-batch {fn.value.id}.{fn.attr}() allocation in "
+                f"hot-loop `{qual}` — source the buffer from an arena "
+                "pool (serve/arena.py) or pass pad_batch(out=...)")
 
 
 @rule("MX03", "orphan-metric",
