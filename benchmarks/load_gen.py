@@ -81,6 +81,87 @@ def _build_index_payloads(
     return payloads
 
 
+def availability_block(events, t_start: float, t_end: float,
+                       window_s: float = 1.0) -> dict:
+    """Availability accounting over per-request completion samples —
+    the comparable artifact chaos soaks need (satellite of the
+    supervisor PR): ``events`` is an iterable of ``(t, ok)`` with ``t``
+    a monotonic completion time.
+
+    Returns per-``window_s`` success rates (so a fault window shows as a
+    dented rate, not an averaged-away blip), the worst consecutive-
+    failure run (count AND wall-clock span), and per-outage
+    time-to-recovery — measured from the first failure of a failure run
+    to the FIRST success completing after its last failure (the
+    "first post-fault success" mark)."""
+    evs = sorted((float(t), bool(ok)) for t, ok in events)
+    n_windows = max(1, int((t_end - t_start) // window_s))
+    totals = [0] * n_windows
+    fails = [0] * n_windows
+    for t, ok in evs:
+        wi = int((t - t_start) // window_s)
+        if 0 <= wi < n_windows:
+            totals[wi] += 1
+            if not ok:
+                fails[wi] += 1
+    rates = [
+        round(1.0 - f / tot, 4) if tot else None
+        for tot, f in zip(totals, fails)
+    ]
+
+    max_run = 0
+    max_run_span_s = 0.0
+    run = 0
+    run_start = None
+    outages: list[dict] = []
+    pending: tuple[float, float, int] | None = None  # (first_fail, last_fail, count)
+    for t, ok in evs:
+        if ok:
+            if pending is not None:
+                first_fail, last_fail, count = pending
+                outages.append({
+                    "start_offset_s": round(first_fail - t_start, 3),
+                    "failures": count,
+                    "span_s": round(last_fail - first_fail, 3),
+                    "time_to_recovery_s": round(t - first_fail, 3),
+                })
+                pending = None
+            run = 0
+            run_start = None
+        else:
+            if run == 0:
+                run_start = t
+            run += 1
+            if run > max_run:
+                max_run = run
+                max_run_span_s = t - run_start
+            if pending is None:
+                pending = (t, t, 1)
+            else:
+                pending = (pending[0], t, pending[2] + 1)
+    if pending is not None:  # outage never recovered inside the window
+        first_fail, last_fail, count = pending
+        outages.append({
+            "start_offset_s": round(first_fail - t_start, 3),
+            "failures": count,
+            "span_s": round(last_fail - first_fail, 3),
+            "time_to_recovery_s": None,
+        })
+
+    recoveries = [o["time_to_recovery_s"] for o in outages
+                  if o["time_to_recovery_s"] is not None]
+    return {
+        "window_s": window_s,
+        "success_rate_per_window": rates,
+        "requests": len(evs),
+        "failures": sum(fails),
+        "max_consecutive_failures": max_run,
+        "max_failure_window_s": round(max_run_span_s, 3),
+        "outages": outages,
+        "time_to_recovery_s": max(recoveries) if recoveries else None,
+    }
+
+
 def _client_traceparent() -> tuple[str, tuple]:
     """Fresh W3C trace context per RPC, sent as gRPC metadata — the
     client end of the client -> front (-> follower) trace the server's
@@ -135,6 +216,7 @@ def run_grpc_load(
     # threads share the dict.
     errors_by_code: dict[str, int] = {}
     errors_lock = threading.Lock()
+    fail_times: list[float] = []  # guarded by errors_lock
 
     def _count_error(exc: grpc.RpcError) -> None:
         try:
@@ -144,6 +226,7 @@ def run_grpc_load(
         with errors_lock:
             errors[0] += 1
             errors_by_code[code] = errors_by_code.get(code, 0) + 1
+            fail_times.append(time.perf_counter())
 
     def worker(k: int) -> None:
         # Own channel per worker: one HTTP/2 connection each, so the test
@@ -212,6 +295,14 @@ def run_grpc_load(
     lat = np.array([ms for r in results for (t_end, ms) in r if t_end <= window_end])
     n_rpcs = int(lat.size)
     txns = n_rpcs * rows_per_rpc
+    # Availability block (chaos-soak artifact contract): every completion
+    # — success or failure — as a 1s-windowed success-rate series plus
+    # consecutive-failure and time-to-recovery accounting.
+    events = [(t_end, True) for r in results for (t_end, _ms) in r]
+    events.extend((t, False) for t in fail_times)
+    availability = availability_block(
+        events, window_end - duration_s if window_end else t_start,
+        window_end or time.perf_counter())
     return {
         "metric": "e2e_grpc_fraud_score_txns_per_sec",
         "value": round(txns / duration_s, 1),
@@ -227,6 +318,7 @@ def run_grpc_load(
         "rpc_p50_ms": round(float(np.percentile(lat, 50)), 3) if n_rpcs else None,
         "rpc_p99_ms": round(float(np.percentile(lat, 99)), 3) if n_rpcs else None,
         "wall_s": round(wall, 3),
+        "availability": availability,
     }
 
 

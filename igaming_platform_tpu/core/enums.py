@@ -26,6 +26,10 @@ class ReasonCode(str, enum.Enum):
     ML_HIGH_RISK = "ML_HIGH_RISK"
     MULTI_ACCOUNT = "MULTI_ACCOUNT"
     DEVICE_FINGERPRINT_MISMATCH = "DEVICE_FINGERPRINT_MISMATCH"
+    # Not part of the in-graph reason bitmask (REASON_BIT_ORDER): appended
+    # host-side by the supervisor's CPU heuristic tier so degraded-mode
+    # responses are wire-compatible yet visibly flagged.
+    DEGRADED_CPU_HEURISTIC = "DEGRADED_CPU_HEURISTIC"
 
 
 # Bit positions used for the in-graph reason bitmask. Order matches the

@@ -342,6 +342,48 @@ class ServiceMetrics:
             "back-to-back, higher = gather/dispatch/readback/encode "
             "genuinely concurrent",
         )
+        # Self-healing supervisor (serve/supervisor.py): the serving state
+        # machine, per-dependency circuit breakers, and the degraded
+        # scoring tier — the availability dashboard for chaos soaks.
+        self.serving_state = self.registry.gauge(
+            f"{service}_serving_state",
+            "Serving state machine: 0=SERVING (all dependencies healthy), "
+            "1=DEGRADED (a dependency circuit is open; answers flow via "
+            "the heuristic tier / single-host mesh, flagged not errored), "
+            "2=BROWNOUT (degraded tier failing too; scoring sheds "
+            "UNAVAILABLE and health reports NOT_SERVING)",
+        )
+        self.breaker_state = self.registry.gauge(
+            f"{service}_breaker_state",
+            "Per-dependency circuit breaker state by {dep}: 0=closed, "
+            "1=half_open (probing), 2=open (calls short-circuited)",
+        )
+        self.degraded_responses_total = self.registry.counter(
+            f"{service}_degraded_responses_total",
+            "Scoring responses served by a degraded tier by {tier} "
+            "(heuristic = CPU conservative scorer while the device "
+            "circuit is open; single_host = multihost front stepping "
+            "locally while a follower resurrects) — flagged responses, "
+            "never errors",
+        )
+        self.watchdog_trips_total = self.registry.counter(
+            f"{service}_watchdog_trips_total",
+            "Device-step watchdog expirations (dispatch->readback over "
+            "DEVICE_STEP_DEADLINE_S): each fails its in-flight window "
+            "with UNAVAILABLE + retry-pushback and triggers an engine "
+            "rebuild with warmup replay",
+        )
+        self.engine_rebuilds_total = self.registry.counter(
+            f"{service}_engine_rebuilds_total",
+            "Scoring-engine tear-down+rebuild cycles completed after a "
+            "watchdog trip (the wedged-tunnel recovery path)",
+        )
+        self.follower_resurrections_total = self.registry.counter(
+            f"{service}_follower_resurrections_total",
+            "Multihost followers that rejoined through the supervised "
+            "reconnect loop (hello/fingerprint + param re-sync) after "
+            "dying or wedging",
+        )
         self.spans_dropped_total = self.registry.counter(
             f"{service}_spans_dropped_total",
             "Host spans evicted from the bounded span ring before export "

@@ -326,7 +326,7 @@ class AmqpConnection:
         if sock is not None:
             try:
                 sock.close()
-            except OSError:
+            except OSError:  # noqa: CC04 — teardown of a failed connect; nothing to record
                 pass
 
     @property
@@ -500,13 +500,18 @@ class AmqpPublisher:
         self._lock = threading.Lock()
         self.published = 0
         self.reconnects = 0
+        # Supervisor feed (serve/supervisor.py): called with (ok, exc)
+        # after every publish_raw outcome so the `amqp` breaker tracks
+        # broker health without the publisher knowing about breakers.
+        self.on_publish_result = None
         try:
             self._connect()
         except (AmqpError, OSError) as exc:
             # Broker not up yet (normal container start ordering): stay
             # disconnected — publish_raw() reconnects with backoff, and
             # the outbox relay retries rows until delivery succeeds.
-            logger.warning("AMQP broker unavailable at startup (%s); will retry", exc)
+            logger.warning("AMQP broker unavailable at startup (%s); will retry",
+                           exc, exc_info=True)
 
     def _connect(self) -> None:
         self._conn.close()
@@ -538,6 +543,8 @@ class AmqpPublisher:
     def publish_raw(self, exchange: str, routing_key: str, payload: str) -> None:
         """Raw-payload publish with confirm + reconnect — the surface the
         transactional-outbox relay targets (outbox.py OutboxRelay)."""
+        from igaming_platform_tpu.serve import chaos
+
         body = payload.encode()
         last: Exception | None = None
         # The lock serializes channel use per ATTEMPT, not across the
@@ -546,6 +553,7 @@ class AmqpPublisher:
         # (flagged by CC02 — blocking call under lock).
         for attempt in range(1 + self.max_retries):
             try:
+                chaos.fire("amqp.publish")
                 with self._lock:
                     if not self._conn.connected:
                         raise AmqpConnectionClosed("not connected")
@@ -553,8 +561,10 @@ class AmqpPublisher:
                     if not self._conn.wait_confirm():
                         raise AmqpError("broker nacked publish")
                     self.published += 1
-                    return
-            except (AmqpConnectionClosed, AmqpError, OSError) as exc:
+                self._note_result(True, None)
+                return
+            except (AmqpConnectionClosed, AmqpError, OSError,  # noqa: CC04 — retry loop; exhausted retries raise AmqpError below
+                    chaos.ChaosError) as exc:
                 last = exc
                 if attempt == self.max_retries:
                     break
@@ -564,9 +574,19 @@ class AmqpPublisher:
                     with self._lock:
                         self._connect()
                         self.reconnects += 1
-                except (AmqpError, OSError) as rexc:
+                except (AmqpError, OSError) as rexc:  # noqa: CC04 — reconnect attempt inside the retry loop; final failure raises
                     last = rexc
+        self._note_result(False, last)
         raise AmqpError(f"publish failed after {self.max_retries} retries: {last}")
+
+    def _note_result(self, ok: bool, exc: Exception | None) -> None:
+        hook = self.on_publish_result
+        if hook is None:
+            return
+        try:
+            hook(ok, exc)
+        except Exception:  # noqa: BLE001 — breaker feed must not fail publishing
+            pass
 
     def close(self) -> None:
         self._conn.close()
@@ -650,7 +670,7 @@ class AmqpConsumer:
                     continue
                 tag, redelivered, routing_key, body = delivery
                 self._process(conn, tag, body, handler)
-            except (AmqpConnectionClosed, OSError):
+            except (AmqpConnectionClosed, OSError):  # noqa: CC04 — consumer reconnect loop; redial below is the handling
                 if self._stop.is_set():
                     return
                 if conn is not None:

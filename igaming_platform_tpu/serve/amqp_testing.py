@@ -120,11 +120,11 @@ class FakeAmqpServer:
             # holds a kernel reference to the listening socket, so close()
             # alone leaves the port listening until the accept returns.
             self._listener.shutdown(socket.SHUT_RDWR)
-        except OSError:
+        except OSError:  # noqa: CC04 — test-broker teardown is best-effort
             pass
         try:
             self._listener.close()
-        except OSError:
+        except OSError:  # noqa: CC04 — test-broker teardown is best-effort
             pass
         self._accept_thread.join(timeout=2)
         with self._lock:
@@ -156,7 +156,7 @@ class FakeAmqpServer:
         while not self._stop.is_set():
             try:
                 sock, _ = self._listener.accept()
-            except OSError:
+            except OSError:  # noqa: CC04 — listener closed: accept loop exits
                 return
             if self._stop.is_set():
                 sock.close()
@@ -201,7 +201,7 @@ class FakeAmqpServer:
                     c.unacked[tag] = msg
                     try:
                         c.conn.send_deliver(c.tag, tag, msg)
-                    except OSError:
+                    except OSError:  # noqa: CC04 — dead client conn; its reader thread reaps it
                         break
 
     def _ack(self, conn: "_ClientConn", tag: int) -> None:
@@ -242,7 +242,7 @@ class _ClientConn:
     def close(self) -> None:
         try:
             self.sock.close()
-        except OSError:
+        except OSError:  # noqa: CC04 — test-conn teardown is best-effort
             pass
 
     def next_delivery_tag(self) -> int:
@@ -297,7 +297,7 @@ class _ClientConn:
         try:
             self._handshake()
             self._method_loop()
-        except (ConnectionError, OSError, struct.error, AssertionError):
+        except (ConnectionError, OSError, struct.error, AssertionError):  # noqa: CC04 — test-broker client session ends on any wire error
             pass
         finally:
             self.close()

@@ -297,6 +297,9 @@ class InMemoryFeatureStore:
         ``requests`` yields objects with account_id, amount, tx_type,
         device_id, fingerprint, ip attributes.
         """
+        from igaming_platform_tpu.serve import chaos
+
+        chaos.fire("feature_store.gather")
         now = now or time.time()
         reqs = list(requests)
         x = np.zeros((len(reqs), NUM_FEATURES), dtype=np.float32)

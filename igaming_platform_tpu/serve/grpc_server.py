@@ -27,11 +27,17 @@ from typing import Callable
 
 import grpc
 
+from igaming_platform_tpu.core.enums import ReasonCode
 from igaming_platform_tpu.obs import flight as _flight
 from igaming_platform_tpu.obs import tracing
 from igaming_platform_tpu.obs.metrics import ServiceMetrics
 from igaming_platform_tpu.obs.tracing import span
 from igaming_platform_tpu.serve.reflection import reflection_handler
+from igaming_platform_tpu.serve.supervisor import (
+    RETRY_PUSHBACK_MS,
+    DeviceWedgedError,
+    ServingUnavailable,
+)
 
 # Always-on flight recorder: every completed rpc.* root span lands in the
 # bounded ring served at /debug/flightz (obs/flight.py).
@@ -65,12 +71,19 @@ class RpcAbort(Exception):
 
     grpcio's context.abort raises an opaque Exception that the recovery
     wrapper cannot distinguish from a crash, so handlers raise this
-    instead."""
+    instead. ``trailing`` metadata (e.g. the standard
+    ``grpc-retry-pushback-ms`` hint on supervisor sheds) is attached
+    before the abort."""
 
-    def __init__(self, code, details: str):
+    def __init__(self, code, details: str, trailing: tuple = ()):
         super().__init__(details)
         self.code = code
         self.details = details
+        self.trailing = tuple(trailing)
+
+
+def _pushback_trailing() -> tuple:
+    return (("grpc-retry-pushback-ms", str(RETRY_PUSHBACK_MS)),)
 
 _PROTO_GEN = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "proto_gen")
 
@@ -253,7 +266,19 @@ def _rpc(metrics: ServiceMetrics, method: str, fn: Callable):
             except RpcAbort as abort:
                 metrics.observe_rpc(method, start, code=abort.code.name)
                 s.attributes["code"] = abort.code.name
+                if abort.trailing and context is not None:
+                    context.set_trailing_metadata(abort.trailing)
                 context.abort(abort.code, abort.details)
+            except (DeviceWedgedError, ServingUnavailable) as exc:
+                # Supervisor sheds (wedged device window, BROWNOUT): LOUD
+                # UNAVAILABLE with the standard retry-pushback hint so
+                # clients back off exactly one breaker window — never a
+                # silent hang on a dead collective, never INTERNAL.
+                metrics.observe_rpc(method, start, code="UNAVAILABLE")
+                s.attributes["code"] = "UNAVAILABLE"
+                if context is not None:
+                    context.set_trailing_metadata(_pushback_trailing())
+                context.abort(grpc.StatusCode.UNAVAILABLE, str(exc))
             except grpc.RpcError:
                 metrics.observe_rpc(method, start, code="ERROR")
                 s.attributes["code"] = "ERROR"
@@ -362,6 +387,10 @@ class RiskGrpcService:
             # Host-pipeline gauges (inflight depth, overlap ratio) —
             # bound now or at the pipeline's lazy build, same pattern.
             engine.bind_pipeline_metrics(self.metrics)
+        if hasattr(engine, "bind_supervisor_metrics"):
+            # Self-healing supervisor (serve/supervisor.py): serving
+            # state, breaker states, degraded/watchdog/rebuild counters.
+            engine.bind_supervisor_metrics(self.metrics)
         # Request-lifecycle observability: every completed stage span feeds
         # risk_stage_latency_ms (with trace-id exemplars), span-ring
         # evictions count in risk_spans_dropped_total, and the continuous
@@ -452,6 +481,15 @@ class RiskGrpcService:
         # p99-feedback for the bulk admission gate: the single-txn fast
         # lane's latency is the SLO the gate protects.
         self._bulk_gate.observe_single_ms(resp.response_time_ms)
+        if ReasonCode.DEGRADED_CPU_HEURISTIC in resp.reason_codes:
+            # Degraded-tier answer: wire-compatible, but the caller can
+            # SEE it — model-version suffix in trailing metadata plus the
+            # reason code already on the response (never an error).
+            if context is not None:
+                context.set_trailing_metadata((
+                    ("risk-model-version",
+                     getattr(self.engine, "model_version", "degraded-heuristic")),
+                ))
         return self._score_to_proto(resp)
 
     def ScoreBatch(self, request, context):
@@ -1031,10 +1069,23 @@ def serve_wallet(service: WalletGrpcService, port: int, max_workers: int = 32):
     return server, health, bound
 
 
-def graceful_stop(server, health: HealthServicer, grace: float = 30.0) -> None:
-    """NOT_SERVING before drain (risk/cmd/main.go:249)."""
+def graceful_stop(server, health: HealthServicer, grace: float = 30.0,
+                  engine=None) -> None:
+    """NOT_SERVING before drain (risk/cmd/main.go:249), then the engine.
+
+    Order matters for zero-loss shutdown: flip health first (load
+    balancers stop routing), stop the server with ``grace`` (new RPCs
+    rejected, ADMITTED handlers run to completion against the still-live
+    engine), and only then close the engine — which drains the continuous
+    batcher and flushes the host pipeline's in-flight window
+    (HostPipeline.close completes pending jobs). Closing the engine
+    before the gRPC drain would strand admitted requests on a dead
+    batcher; SIGTERM under load must lose zero admitted requests
+    (tests/test_supervisor_chaos.py pins it)."""
     health.set_all_not_serving()
     server.stop(grace).wait()
+    if engine is not None:
+        engine.close()
 
 
 def _make_stub(channel, service_name: str, methods: dict):

@@ -174,6 +174,46 @@ def _recv_frame(reader: "_Reader"):
     return magic, arrays
 
 
+def _dial_follower(port: int, dial_timeout_s: float,
+                   io_timeout_s: float) -> socket.socket:
+    deadline = _monotonic() + dial_timeout_s
+    while True:
+        # The follower may still be building its mesh/params when the
+        # front dials — retry refused connections until the deadline
+        # instead of dying on boot-order jitter.
+        try:
+            s = socket.create_connection(("127.0.0.1", port), timeout=5)
+            break
+        except OSError:
+            if _monotonic() > deadline:
+                raise
+            _sleep(0.2)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    s.settimeout(io_timeout_s)
+    return s
+
+
+class _FollowerLink:
+    """One follower's socket + ACK accounting. Every socket operation —
+    including the non-blocking/blocking mode transitions in the ACK reap
+    — happens under the link's own lock, so a resurrection thread
+    swapping the socket in can never race a broadcast caller mid-
+    transition (the `_reap_acks` mode-restore race)."""
+
+    __slots__ = ("index", "port", "sock", "reader", "outstanding", "lock",
+                 "dead", "resurrecting")
+
+    def __init__(self, index: int, port: int, sock: socket.socket):
+        self.index = index
+        self.port = port
+        self.sock = sock
+        self.reader = _Reader(sock)
+        self.outstanding = 0
+        self.lock = threading.Lock()
+        self.dead: str | None = None
+        self.resurrecting = False
+
+
 class WorkChannel:
     """Front side: fan each padded batch out to the follower(s).
 
@@ -184,56 +224,176 @@ class WorkChannel:
     dies (EOF on the ACK drain) or wedges (ACK/send timeout) is detected
     BEFORE the front enters the next lockstep collective, so the serving
     front degrades to loud per-RPC errors instead of wedging on a dead
-    collective; once dead, every later call fails fast."""
+    collective.
+
+    Resurrection (``reconnect=True``): a dead link no longer poisons the
+    channel forever — a supervised reconnect loop redials the follower
+    with exponential backoff + jitter, replays the hello/fingerprint
+    handshake, re-syncs params through ``set_params_provider``'s leaves
+    (the ``broadcast_params`` path), and only then marks the link alive.
+    While a link is down, ``broadcast`` keeps raising the typed error so
+    the engine serves in single-host degraded mode; ``on_follower_state``
+    tells the supervisor when to open/close the multihost breaker.
+    Without ``reconnect`` the old discipline holds: once dead, every
+    later call fails fast until the mesh is rebuilt."""
 
     def __init__(self, ports: list[int], dial_timeout_s: float = 60.0,
-                 io_timeout_s: float | None = None, ack_window: int = 8):
+                 io_timeout_s: float | None = None, ack_window: int = 8,
+                 reconnect: bool = False,
+                 reconnect_backoff_s: tuple[float, float] = (0.2, 5.0)):
         if io_timeout_s is None:
             io_timeout_s = float(_os.environ.get("MULTIHOST_IO_TIMEOUT_S", "20"))
         self._io_timeout_s = io_timeout_s
         self._ack_window = max(1, ack_window)
-        self._socks = []
-        self._readers = []
-        self._outstanding: list[int] = []
-        self._dead: str | None = None
-        for port in ports:
-            deadline = _monotonic() + dial_timeout_s
-            while True:
-                # The follower may still be building its mesh/params when
-                # the front dials — retry refused connections until the
-                # deadline instead of dying on boot-order jitter.
-                try:
-                    s = socket.create_connection(("127.0.0.1", port), timeout=5)
-                    break
-                except OSError:
-                    if _monotonic() > deadline:
-                        raise
-                    _sleep(0.2)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            s.settimeout(io_timeout_s)
-            self._socks.append(s)
-            self._readers.append(_Reader(s))
-            self._outstanding.append(0)
+        self._dial_timeout_s = dial_timeout_s
+        self._reconnect = reconnect
+        self._backoff = reconnect_backoff_s
+        self._closed = threading.Event()
+        self._fingerprint: np.ndarray | None = None
+        self._params_provider = None  # () -> list[np.ndarray] | None
+        self.on_follower_state = None  # callable(index, "dead"|"alive", why)
+        self.resurrections = 0
+        self._links = [
+            _FollowerLink(i, port, _dial_follower(port, dial_timeout_s,
+                                                  io_timeout_s))
+            for i, port in enumerate(ports)
+        ]
         self._lock = threading.Lock()
 
-    def _mark_dead(self, i: int, why: str) -> MultihostChannelError:
-        self._dead = f"multihost follower {i}: {why}"
+    # -- state ----------------------------------------------------------------
+
+    @property
+    def alive(self) -> bool:
+        return all(link.dead is None for link in self._links)
+
+    def dead_reason(self) -> str | None:
+        for link in self._links:
+            if link.dead is not None:
+                return link.dead
+        return None
+
+    def set_params_provider(self, provider) -> None:
+        """``provider() -> list[np.ndarray]`` returning the CURRENT host
+        param leaves — replayed to a resurrected follower before it
+        rejoins, so a param hot-swap during its outage is never lost."""
+        self._params_provider = provider
+
+    def _notify(self, link: _FollowerLink, state: str, why: str = "") -> None:
+        cb = self.on_follower_state
+        if cb is None:
+            return
+        try:
+            cb(link.index, state, why)
+        except Exception:  # noqa: BLE001 — supervisor hooks must not fail the channel
+            pass
+
+    def _mark_dead(self, link: _FollowerLink, why: str) -> MultihostChannelError:
+        link.dead = f"multihost follower {link.index}: {why}"
+        self._notify(link, "dead", why)
+        if self._reconnect:
+            self._start_resurrection(link)
+            return MultihostChannelError(
+                f"{link.dead} — front serves single-host degraded mode "
+                "while the follower is resurrected")
         return MultihostChannelError(
-            f"{self._dead} — front degrades loudly; scoring RPCs fail "
+            f"{link.dead} — front degrades loudly; scoring RPCs fail "
             "until the mesh is rebuilt")
 
     def _ensure_alive(self) -> None:
-        if self._dead is not None:
-            raise MultihostChannelError(self._dead)
+        for link in self._links:
+            if link.dead is not None:
+                raise MultihostChannelError(link.dead)
 
-    def _reap_acks(self, i: int, need_room: bool) -> None:
-        """Drain ACK bytes from follower ``i``; non-blocking normally,
+    # -- resurrection ----------------------------------------------------------
+
+    def _start_resurrection(self, link: _FollowerLink) -> None:
+        # Caller (every _mark_dead site) already holds link.lock.
+        if link.resurrecting or self._closed.is_set():
+            return
+        link.resurrecting = True
+        try:
+            link.sock.close()
+        except OSError:  # noqa: CC04 — socket already dead; nothing to record
+            pass
+        threading.Thread(
+            target=self._resurrect_loop, args=(link,),
+            name=f"follower-resurrect-{link.index}", daemon=True).start()
+
+    def _resurrect_loop(self, link: _FollowerLink) -> None:
+        base, cap = self._backoff
+        rng = __import__("random").Random(f"resurrect-{link.index}")
+        attempt = 0
+        while not self._closed.is_set():
+            # Exponential backoff with full jitter: the restarted
+            # follower needs boot time, and N fronts re-dialing a shared
+            # host must not synchronize their retries.
+            delay = min(cap, base * (2 ** min(attempt, 10))) * (
+                0.5 + rng.random() / 2)
+            if self._closed.wait(delay):
+                return
+            attempt += 1
+            try:
+                sock = socket.create_connection(
+                    ("127.0.0.1", link.port), timeout=2)
+            except OSError:  # noqa: CC04 — resurrection dial retry; backoff loop is the handling
+                continue  # follower not back yet; next backoff step
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock.settimeout(self._io_timeout_s)
+                reader = _Reader(sock)
+                # Replay the boot handshake: the resurrected follower must
+                # prove the SAME model fingerprint before any work frame.
+                if self._fingerprint is not None:
+                    _send_frame(sock, MAGIC_HELLO, self._fingerprint)
+                    magic, arrays = _recv_frame(reader)
+                    if magic == MAGIC_NACK:
+                        msg = (bytes(np.asarray(arrays[0])).decode(errors="replace")
+                               if arrays else "handshake NACK")
+                        # A model mismatch will not heal by retrying —
+                        # stop resurrecting and stay loudly degraded.
+                        link.dead = (f"multihost follower {link.index}: "
+                                     f"resurrection NACK: {msg}")
+                        self._notify(link, "dead", link.dead)
+                        with link.lock:
+                            link.resurrecting = False
+                        sock.close()
+                        return
+                    if magic != MAGIC_HELLO:
+                        raise ConnectionError(f"bad handshake reply {magic!r}")
+                # Param re-sync: the follower rejoins with the CURRENT
+                # params (hot-swaps during its outage included).
+                provider = self._params_provider
+                if provider is not None:
+                    leaves = provider()
+                    if leaves:
+                        _send_frame(sock, MAGIC_PARAMS, *leaves)
+            except (OSError, ConnectionError):  # noqa: CC04 — resurrection handshake retry; backoff loop is the handling
+                try:
+                    sock.close()
+                except OSError:  # noqa: CC04 — already failing; retry covers it
+                    pass
+                continue
+            with link.lock:
+                link.sock = sock
+                link.reader = reader
+                link.outstanding = 0
+                link.dead = None
+                link.resurrecting = False
+            self.resurrections += 1
+            self._notify(link, "alive", f"resurrected after {attempt} attempts")
+            return
+
+    # -- ACK reaping -----------------------------------------------------------
+
+    def _reap_acks(self, link: _FollowerLink, need_room: bool) -> None:
+        """Drain ACK bytes from a follower; non-blocking normally,
         blocking (with the io timeout) when the un-ACKed window is full.
         EOF here is the earliest dead-follower signal — the kernel closes
-        the socket the instant the process dies."""
-        s = self._socks[i]
+        the socket the instant the process dies. Caller holds
+        ``link.lock`` (socket mode transitions are atomic per-socket)."""
+        s = link.sock
         while True:
-            blocking = need_room and self._outstanding[i] >= self._ack_window
+            blocking = need_room and link.outstanding >= self._ack_window
             try:
                 if blocking:
                     data = s.recv(4096)  # io_timeout_s applies
@@ -247,14 +407,14 @@ class WorkChannel:
                 return
             except socket.timeout as exc:
                 raise self._mark_dead(
-                    i, f"no step ACK within {self._io_timeout_s}s "
+                    link, f"no step ACK within {self._io_timeout_s}s "
                     "(wedged or overloaded)") from exc
             except OSError as exc:
-                raise self._mark_dead(i, f"work channel error: {exc}") from exc
+                raise self._mark_dead(link, f"work channel error: {exc}") from exc
             if data == b"":
-                raise self._mark_dead(i, "closed the work channel (died?)")
-            self._outstanding[i] = max(0, self._outstanding[i] - len(data))
-            if not blocking or self._outstanding[i] < self._ack_window:
+                raise self._mark_dead(link, "closed the work channel (died?)")
+            link.outstanding = max(0, link.outstanding - len(data))
+            if not blocking or link.outstanding < self._ack_window:
                 return
 
     def broadcast(self, xp: np.ndarray, blp: np.ndarray, thr: np.ndarray,
@@ -264,61 +424,84 @@ class WorkChannel:
         rides the frame as a 4th array, so the follower's device-step span
         joins the SAME trace as the front's rpc.* span (and, transitively,
         the client's). Followers accept 3- and 4-array frames alike."""
+        from igaming_platform_tpu.serve import chaos
+
         arrays = (xp, blp, thr) if trace is None else (xp, blp, thr, trace)
         with self._lock:
             self._ensure_alive()
-            for i, s in enumerate(self._socks):
-                self._reap_acks(i, need_room=True)
-                try:
-                    _send_frame(s, MAGIC_WORK, *arrays)
-                except socket.timeout as exc:
-                    raise self._mark_dead(
-                        i, f"send timed out after {self._io_timeout_s}s") from exc
-                except OSError as exc:
-                    raise self._mark_dead(i, f"send failed: {exc}") from exc
-                self._outstanding[i] += 1
+            for link in self._links:
+                with link.lock:
+                    if link.dead is not None:
+                        raise MultihostChannelError(link.dead)
+                    self._reap_acks(link, need_room=True)
+                    try:
+                        if chaos.fire("workchannel.send") == "drop":
+                            # Injected frame loss: the follower never sees
+                            # this step, so its missing ACK must surface
+                            # through the window discipline, not hide.
+                            link.outstanding += 1
+                            continue
+                        _send_frame(link.sock, MAGIC_WORK, *arrays)
+                    except socket.timeout as exc:
+                        raise self._mark_dead(
+                            link, f"send timed out after {self._io_timeout_s}s",
+                        ) from exc
+                    except OSError as exc:
+                        raise self._mark_dead(link, f"send failed: {exc}") from exc
+                    link.outstanding += 1
 
     def broadcast_params(self, leaves: list[np.ndarray]) -> None:
         with self._lock:
             self._ensure_alive()
-            for i, s in enumerate(self._socks):
-                try:
-                    _send_frame(s, MAGIC_PARAMS, *leaves)
-                except OSError as exc:  # includes socket.timeout
-                    raise self._mark_dead(i, f"params send failed: {exc}") from exc
+            for link in self._links:
+                with link.lock:
+                    try:
+                        _send_frame(link.sock, MAGIC_PARAMS, *leaves)
+                    except OSError as exc:  # includes socket.timeout
+                        raise self._mark_dead(
+                            link, f"params send failed: {exc}") from exc
 
     def broadcast_hello(self, fingerprint: np.ndarray) -> None:
         """Handshake is BIDIRECTIONAL: send the fingerprint, then wait
         for every follower's ACK before any work frame — a mismatched
         follower NACKs and dies, and without the read the front's first
-        collective would wedge waiting for a dead participant."""
+        collective would wedge waiting for a dead participant. The
+        fingerprint is kept for resurrection handshakes."""
+        self._fingerprint = np.asarray(fingerprint, dtype=np.uint8).copy()
         with self._lock:
-            for s in self._socks:
-                _send_frame(s, MAGIC_HELLO, fingerprint)
-            for i, reader in enumerate(self._readers):
-                try:
-                    magic, arrays = _recv_frame(reader)
-                except ConnectionError as exc:
-                    raise RuntimeError(
-                        f"multihost follower {i} closed the channel during "
-                        "the model handshake (likely a model mismatch — "
-                        "check its logs)") from exc
+            for link in self._links:
+                with link.lock:
+                    _send_frame(link.sock, MAGIC_HELLO, fingerprint)
+            for link in self._links:
+                with link.lock:
+                    try:
+                        magic, arrays = _recv_frame(link.reader)
+                    except ConnectionError as exc:
+                        raise RuntimeError(
+                            f"multihost follower {link.index} closed the "
+                            "channel during the model handshake (likely a "
+                            "model mismatch — check its logs)") from exc
                 if magic == MAGIC_NACK:
-                    msg = bytes(np.asarray(arrays[0])).decode(errors="replace")                         if arrays else "follower rejected the handshake"
-                    raise RuntimeError(f"multihost follower {i} NACK: {msg}")
+                    msg = bytes(np.asarray(arrays[0])).decode(errors="replace") \
+                        if arrays else "follower rejected the handshake"
+                    raise RuntimeError(
+                        f"multihost follower {link.index} NACK: {msg}")
                 if magic != MAGIC_HELLO:
                     raise RuntimeError(
-                        f"multihost follower {i}: bad handshake reply {magic!r}")
+                        f"multihost follower {link.index}: bad handshake "
+                        f"reply {magic!r}")
 
     def close(self) -> None:
+        self._closed.set()
         with self._lock:
-            for s in self._socks:
-                try:
-                    _send_frame(s, MAGIC_STOP)
-                    s.close()
-                except OSError:
-                    pass
-            self._socks = []
+            for link in self._links:
+                with link.lock:
+                    try:
+                        _send_frame(link.sock, MAGIC_STOP)
+                        link.sock.close()
+                    except OSError:  # noqa: CC04 — shutdown path; link may already be dead
+                        pass
+            self._links = []
 
 
 def model_fingerprint(ml_backend: str, params) -> np.ndarray:
@@ -403,23 +586,39 @@ def follower_serve(port: int, cfg, ml_backend: str, params, mesh) -> None:
             # liveness signal (WorkChannel._reap_acks). A follower that
             # wedges mid-step simply never sends it.
             conn.sendall(ACK_BYTE)
-    except ConnectionError:
+    except ConnectionError:  # noqa: CC04 — front closed the channel: follower exits
         return
     finally:
         try:
             conn.close()
-        except OSError:
+        except OSError:  # noqa: CC04 — follower teardown is best-effort
             pass
         listener.close()
 
 
 def multihost_engine(mesh, follower_ports: list[int], *, batcher_config=None,
                      ml_backend: str = "multitask", params=None,
-                     feature_store=None, config=None):
+                     feature_store=None, config=None, reconnect: bool | None = None,
+                     supervisor=None, channel_kwargs: dict | None = None):
     """Build the front's engine: a real TPUScoringEngine subclass bound
     to the global mesh + a work channel to the followers. ``params`` must
     be a HOST pytree identical to the followers' (checkpoints load that
-    way; jit replicates host leaves across the multi-process mesh)."""
+    way; jit replicates host leaves across the multi-process mesh).
+
+    ``reconnect`` (default: MULTIHOST_RECONNECT env, on) enables follower
+    resurrection: a dead follower flips the engine into SINGLE-HOST
+    DEGRADED MODE — every step runs the front's LOCAL compiled executable
+    of the same graph (same params, same program) instead of failing the
+    RPC — until the channel's supervised reconnect loop re-handshakes and
+    re-syncs the follower, at which point full-mesh lockstep resumes.
+    ``supervisor`` (serve/supervisor.ServingSupervisor) gets the
+    ``multihost`` breaker opened/closed on those transitions.
+
+    ``mesh=None`` is LOOPBACK mode: the full work-channel discipline
+    (handshake, broadcast, ACK windows, resurrection) over a local-only
+    step — the deployment shape chaos tests and ``soak.py --chaos`` drive
+    on hosts where multi-process SPMD is unavailable, and the execution
+    path degraded mode itself uses."""
     from igaming_platform_tpu.core.config import ScoringConfig
     from igaming_platform_tpu.serve.scorer import TPUScoringEngine, pad_batch
 
@@ -428,13 +627,25 @@ def multihost_engine(mesh, follower_ports: list[int], *, batcher_config=None,
     from igaming_platform_tpu.parallel.mesh import AXIS_DATA
 
     cfg = config or ScoringConfig()
-    gfn, row, vec, repl = make_global_scorer(cfg, ml_backend, mesh)
-    divisor = int(mesh.shape[AXIS_DATA])
+    if reconnect is None:
+        reconnect = _os.environ.get("MULTIHOST_RECONNECT", "1") != "0"
+    loopback = mesh is None
+    if loopback:
+        gfn = row = vec = repl = None
+        divisor = 1
+    else:
+        gfn, row, vec, repl = make_global_scorer(cfg, ml_backend, mesh)
+        divisor = int(mesh.shape[AXIS_DATA])
 
     class _Engine(TPUScoringEngine):
         def __init__(self):
-            self._chan = WorkChannel(follower_ports)
-            self._params_global = replicate_pytree(repl, params)
+            self._chan = WorkChannel(follower_ports, reconnect=reconnect,
+                                     **(channel_kwargs or {}))
+            self.supervisor = supervisor
+            self._chan.on_follower_state = self._on_follower_state
+            self._degraded_steps = 0
+            self._params_global = (
+                None if loopback else replicate_pytree(repl, params))
             # One critical section per step: the broadcast and the
             # front's dispatch must be ATOMIC — with concurrent
             # _launch_device callers (gRPC workers + the batcher thread),
@@ -464,16 +675,75 @@ def multihost_engine(mesh, follower_ports: list[int], *, batcher_config=None,
                 s for s in self._shapes
                 if s == self.batch_size or s % divisor == 0
             ]
+            # Resurrection param re-sync: the channel replays the CURRENT
+            # host leaves to a follower that rejoins, so a hot-swap during
+            # its outage is never lost.
+            self._host_leaves = [np.asarray(leaf) for leaf in
+                                 jax.tree_util.tree_leaves(
+                                     jax.device_get(params))]
+            self._chan.set_params_provider(lambda: self._host_leaves)
             self._warmup_global()
 
+        # -- supervisor wiring ------------------------------------------------
+
+        def _on_follower_state(self, index: int, state: str, why: str) -> None:
+            sup = self.supervisor
+            if sup is None:
+                return
+            br = sup.breaker("multihost")
+            if state == "dead":
+                br.force_open(f"follower {index} dead: {why}")
+            else:
+                # The resurrection handshake + param re-sync already
+                # validated the follower — the breaker closes outright.
+                br.reset()
+                if sup.metrics is not None:
+                    sup.metrics.follower_resurrections_total.inc()
+
+        @property
+        def degraded(self) -> bool:
+            """True while any follower is down and steps run single-host."""
+            return not self._chan.alive
+
+        @property
+        def degraded_steps(self) -> int:
+            return self._degraded_steps
+
+        # -- lockstep helpers -------------------------------------------------
+
+        def _local_step(self, xp: np.ndarray, blp: np.ndarray):
+            """The front's LOCAL executable of the same packed graph —
+            loopback mode's only step, and the single-host degraded step
+            while followers resurrect (same params, same program, so
+            scores match the full-mesh result)."""
+            with self._params_lock:
+                p = self._params
+            out, _ = self._packed_fn(p, xp.copy(), blp, self._thresholds)
+            return out
+
+        def _broadcast_step(self, xp, blp, thr, trace) -> bool:
+            """Fan the frame out; False = follower(s) down, run degraded.
+            Dead-channel errors only degrade when resurrection is on —
+            otherwise they propagate (the old fail-loud contract)."""
+            try:
+                self._chan.broadcast(xp, blp, thr, trace=trace)
+                return True
+            except MultihostChannelError:
+                if not reconnect:
+                    raise
+                self._degraded_steps += 1
+                return False
+
         def _warmup_global(self) -> None:
-            """AOT-warm the GLOBAL executable for every ladder shape (in
+            """AOT-warm the serving executable for every ladder shape (in
             lockstep with the followers) before health can flip to
             SERVING — the stock warmup would only compile the local path
             this engine never serves. Also warms the host tier. Starts
             with the model-fingerprint handshake: a follower that
             resolved different params dies loudly instead of running a
-            divergent program."""
+            divergent program. The LOCAL executable is warmed too — it is
+            the single-host degraded step and must not pay its compile
+            during an outage."""
             from igaming_platform_tpu.core.features import NUM_FEATURES
 
             self._chan.broadcast_hello(model_fingerprint(ml_backend, params))
@@ -483,9 +753,15 @@ def multihost_engine(mesh, follower_ports: list[int], *, batcher_config=None,
                 blz = np.zeros((shape,), bool)
                 with self._step_lock:
                     self._chan.broadcast(xz, blz, thr)
-                    out = _global_step(gfn, row, vec, repl,
-                                       self._params_global, xz, blz, thr)
+                    if loopback:
+                        out = self._local_step(xz, blz)
+                    else:
+                        out = _global_step(gfn, row, vec, repl,
+                                           self._params_global, xz, blz, thr)
                 jax.device_get(out)
+                if not loopback and reconnect:
+                    # Degraded-mode executable (same graph, local devices).
+                    jax.device_get(self._local_step(xz, blz))
                 if self._fn_host is not None and shape <= self._pick_shape(self._host_tier):
                     jax.device_get(self._fn_host(
                         self._params_host, xz, blz, self._thresholds_host))
@@ -513,9 +789,21 @@ def multihost_engine(mesh, follower_ports: list[int], *, batcher_config=None,
                 # (set_thresholds only refreshes _thresholds_host when a
                 # host tier exists).
                 thr = np.asarray(self._thresholds, np.int32)
-                self._chan.broadcast(xp, blp, thr, trace=trace)
-                out = _global_step(gfn, row, vec, repl,
-                                   self._params_global, xp, blp, thr)
+                if self._chan.alive:
+                    mesh_up = self._broadcast_step(xp, blp, thr, trace)
+                elif reconnect:
+                    # Follower(s) down, resurrection in flight: serve the
+                    # step single-host instead of failing the RPC.
+                    self._degraded_steps += 1
+                    mesh_up = False
+                else:
+                    raise MultihostChannelError(
+                        self._chan.dead_reason() or "work channel dead")
+                if loopback or not mesh_up:
+                    out = self._local_step(xp, blp)
+                else:
+                    out = _global_step(gfn, row, vec, repl,
+                                       self._params_global, xp, blp, thr)
             if hasattr(out, "copy_to_host_async"):
                 out.copy_to_host_async()
             return out, n
@@ -523,13 +811,23 @@ def multihost_engine(mesh, follower_ports: list[int], *, batcher_config=None,
         def swap_params(self, new_params) -> None:
             """Hot-swap BOTH halves: the followers (params frame over the
             channel, applied before any later work frame) and the front's
-            replicated copy — then the base class for the host tier."""
+            replicated copy — then the base class for the host tier. A
+            follower mid-outage gets the new leaves at resurrection
+            (set_params_provider)."""
             host_params = jax.device_get(new_params)
             leaves = [np.asarray(leaf) for leaf in
                       jax.tree_util.tree_leaves(host_params)]
             with self._step_lock:
-                self._chan.broadcast_params(leaves)
-                self._params_global = replicate_pytree(repl, host_params)
+                self._host_leaves = leaves
+                try:
+                    self._chan.broadcast_params(leaves)
+                except MultihostChannelError:
+                    if not reconnect:
+                        raise
+                    # Follower down: the provider replays these leaves at
+                    # resurrection; the front swaps locally regardless.
+                if not loopback:
+                    self._params_global = replicate_pytree(repl, host_params)
             super().swap_params(new_params)
 
         def close(self) -> None:
@@ -539,3 +837,69 @@ def multihost_engine(mesh, follower_ports: list[int], *, batcher_config=None,
                 super().close()
 
     return _Engine()
+
+
+# -- chaos/test stub follower ------------------------------------------------
+
+
+def stub_follower_serve(port: int, mode: str = "ack",
+                        wedge_after: int = 0) -> int:
+    """A follower speaking the REAL work-channel protocol (handshake,
+    per-step ACK, params frames, STOP) without a jax.distributed mesh —
+    the harness the chaos soak and the supervisor tests SIGKILL and
+    restart to exercise resurrection on backends where multi-process SPMD
+    is unavailable. Modes: ``ack`` (normal), ``wedge`` (stop ACKing after
+    ``wedge_after`` work frames — the wedged-follower shape). Returns the
+    number of work frames served; accepts ONE front connection per call,
+    so a restarted stub process is a fresh accept on the same port."""
+    listener = socket.socket()
+    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    listener.bind(("127.0.0.1", port))
+    listener.listen(1)
+    print("READY", flush=True)
+    conn, _ = listener.accept()
+    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    reader = _Reader(conn)
+    n = 0
+    try:
+        magic, _arrays = _recv_frame(reader)
+        if magic != MAGIC_HELLO:
+            return 0
+        _send_frame(conn, MAGIC_HELLO)
+        while True:
+            magic, _arrays = _recv_frame(reader)
+            if magic == MAGIC_PARAMS:
+                continue
+            if magic != MAGIC_WORK:
+                return n
+            n += 1
+            if mode == "wedge" and n > wedge_after:
+                _sleep(3600)
+            conn.sendall(ACK_BYTE)
+    except ConnectionError:  # noqa: CC04 — front closed the channel: stub exits
+        return n
+    finally:
+        try:
+            conn.close()
+        except OSError:  # noqa: CC04 — stub teardown; nothing to record
+            pass
+        listener.close()
+        print(f"SERVED={n}", flush=True)
+
+
+def _stub_main() -> None:
+    """``python -m igaming_platform_tpu.serve.multihost --stub-follower
+    --port N [--mode ack|wedge] [--wedge-after K]``"""
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--stub-follower", action="store_true", required=True)
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--mode", default="ack", choices=("ack", "wedge"))
+    parser.add_argument("--wedge-after", type=int, default=0)
+    args = parser.parse_args()
+    stub_follower_serve(args.port, mode=args.mode, wedge_after=args.wedge_after)
+
+
+if __name__ == "__main__":
+    _stub_main()

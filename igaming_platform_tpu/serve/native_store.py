@@ -315,6 +315,9 @@ class NativeFeatureStore:
         self._lib.fs_fill_rows(self._handle, n, idxs, amts, types, now or time.time(), out)
 
     def gather_batch(self, requests, now: float | None = None):
+        from igaming_platform_tpu.serve import chaos
+
+        chaos.fire("feature_store.gather")
         reqs = list(requests)
         x = np.zeros((len(reqs), NUM_FEATURES), dtype=np.float32)
         self._fill(
@@ -345,6 +348,9 @@ class NativeFeatureStore:
         ScoreRequest objects of gather_batch() skipped entirely. The
         blacklist check covers the same three keys as check_blacklist
         (device / fingerprint / ip, redis_store.go:267-293)."""
+        from igaming_platform_tpu.serve import chaos
+
+        chaos.fire("feature_store.gather")
         n = len(account_ids)
         x = np.zeros((n, NUM_FEATURES), dtype=np.float32)
         self._fill(x, account_ids, amounts, tx_types, now)
@@ -399,6 +405,9 @@ class NativeFeatureStore:
         for — no Python protobuf parse, no per-row host objects
         (counterpart of the per-request decode grpc-go does for
         proto/risk/v1/risk.proto:34-58)."""
+        from igaming_platform_tpu.serve import chaos
+
+        chaos.fire("feature_store.gather")
         n = self._lib.fs_wire_count(payload, len(payload))
         if n < 0:
             raise ValueError("malformed ScoreBatchRequest")

@@ -45,7 +45,6 @@ import time
 from concurrent.futures import Future
 from typing import Any
 
-import jax
 import numpy as np
 
 from igaming_platform_tpu.obs import tracing
@@ -329,7 +328,7 @@ class HostPipeline:
     # -- readback worker -----------------------------------------------------
 
     def _readback_loop(self) -> None:
-        from igaming_platform_tpu.serve.scorer import _unpack_host
+        from igaming_platform_tpu.serve.scorer import _device_readback, _unpack_host
 
         while True:
             item = self._inflight_q.get()
@@ -339,7 +338,7 @@ class HostPipeline:
             t0 = time.monotonic()
             try:
                 with span("score.readback", parent=job.parent, batch=n):
-                    host = _unpack_host(jax.device_get(out))
+                    host = _unpack_host(_device_readback(out))
             except BaseException as exc:  # noqa: BLE001 — belongs to the job
                 self._note_inflight(-1)
                 self._note_busy("readback", time.monotonic() - t0)

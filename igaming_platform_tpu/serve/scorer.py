@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
+from igaming_platform_tpu.serve import chaos
 from igaming_platform_tpu.core.enums import ReasonCode, action_from_code, decode_reason_mask
 from igaming_platform_tpu.core.features import F, NUM_FEATURES, FeatureVector
 from igaming_platform_tpu.models.ensemble import make_score_fn
@@ -107,6 +108,14 @@ def _pack_outputs(fn, echo_batch: bool = False):
         return (stacked, x) if echo_batch else stacked
 
     return packed
+
+
+def _device_readback(out):
+    """The D2H drain, chokepointed so chaos plans (serve/chaos.py) can
+    inject the tunnel-wedge shape — a readback that delays, errors, or
+    never returns — exactly where the real one failed in round 4."""
+    chaos.fire("device.readback")
+    return jax.device_get(out)
 
 
 def _unpack_host(packed) -> dict:
@@ -615,7 +624,7 @@ class TPUScoringEngine:
         def read_one() -> None:
             out, lo, n = inflight.popleft()
             with span("score.readback", batch=n):
-                host = _unpack_host(jax.device_get(out))
+                host = _unpack_host(_device_readback(out))
             for k in keys:
                 parts[k].append(host[k][:n])
             rtms[lo:lo + n] = int((time.monotonic() - start) * 1000.0)
@@ -703,7 +712,7 @@ class TPUScoringEngine:
 
     def _run_device(self, x: np.ndarray, bl: np.ndarray):
         out, n = self._launch_device(x, bl)
-        return _unpack_host(jax.device_get(out)), n
+        return _unpack_host(_device_readback(out)), n
 
     def _pick_shape(self, n: int) -> int:
         """Smallest compiled shape that fits n rows (latency tiers)."""
@@ -771,7 +780,7 @@ class TPUScoringEngine:
     def _collect_requests(self, handle) -> list[ScoreResponse]:
         out, x, n = handle
         with span("score.readback", batch=n):
-            host = _unpack_host(jax.device_get(out))
+            host = _unpack_host(_device_readback(out))
         return [self._row_response(host, x, i) for i in range(n)]
 
     def _row_response(self, out: dict, x: np.ndarray, i: int) -> ScoreResponse:
@@ -907,7 +916,7 @@ class TPUScoringEngine:
         def read_one() -> None:
             out, lo, n = inflight.popleft()
             with span("score.readback", batch=n):
-                host = _unpack_host(jax.device_get(out))
+                host = _unpack_host(_device_readback(out))
             for k in keys:
                 parts[k].append(host[k][:n])
             rtms[lo : lo + n] = int((time.monotonic() - start) * 1000.0)

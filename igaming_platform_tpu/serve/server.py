@@ -155,20 +155,58 @@ class RiskServer:
         # (the multi-host front uses serve/multihost.multihost_engine)
         # while keeping EVERYTHING else — abuse detector, bridge, gRPC,
         # health, sidecar — the stock assembly.
+        #
+        # Chaos plans (CHAOS_PLAN env, serve/chaos.py) install BEFORE the
+        # engine so even warmup runs under injection — loudly logged:
+        # a production boot must never silently carry a fault plan.
+        from igaming_platform_tpu.serve import chaos as _chaos
+
+        plan = _chaos.install_from_env()
+        if plan is not None:
+            logger.warning("CHAOS PLAN ACTIVE (seed=%d): %s",
+                           plan.seed, sorted(plan.specs))
+
         if engine_factory is not None:
-            self.engine = engine_factory(
-                self.config.scoring, ml_backend=ml_backend, params=params,
-                batcher_config=self.config.batcher, feature_store=feature_store,
-            )
+            def build_engine():
+                return engine_factory(
+                    self.config.scoring, ml_backend=ml_backend, params=params,
+                    batcher_config=self.config.batcher,
+                    feature_store=feature_store,
+                )
         else:
-            self.engine = TPUScoringEngine(
-                self.config.scoring,
-                ml_backend=ml_backend,
-                params=params,
-                mesh=mesh,
-                batcher_config=self.config.batcher,
-                feature_store=feature_store,
+            def build_engine():
+                return TPUScoringEngine(
+                    self.config.scoring,
+                    ml_backend=ml_backend,
+                    params=params,
+                    mesh=mesh,
+                    batcher_config=self.config.batcher,
+                    feature_store=feature_store,
+                )
+
+        # Self-healing supervisor (serve/supervisor.py, SUPERVISOR=0 opts
+        # out): circuit breakers around the device/multihost/feature-
+        # store/AMQP dependencies, a device-step watchdog that rebuilds
+        # the engine through build_engine (replaying warmup), and the CPU
+        # heuristic fallback tier for open-circuit windows.
+        self.supervisor = None
+        if os.environ.get("SUPERVISOR", "1") != "0":
+            from igaming_platform_tpu.serve.supervisor import (
+                ServingSupervisor,
+                SupervisedScoringEngine,
             )
+
+            self.supervisor = ServingSupervisor()
+            self.engine = SupervisedScoringEngine(
+                build_engine, supervisor=self.supervisor)
+            inner = self.engine.inner
+            if getattr(inner, "supervisor", None) is None and hasattr(
+                    inner, "_chan"):
+                # A multihost front built by engine_factory: wire its
+                # follower-state callbacks into the multihost breaker.
+                inner.supervisor = self.supervisor
+        else:
+            self.engine = build_engine()
         # Sequence-parallel abuse scoring when the mesh has a `seq` axis:
         # ring attention shards each event history across chips (CP).
         seq_sharded = mesh is not None and int(mesh.shape.get("seq", 1)) > 1
@@ -199,6 +237,21 @@ class RiskServer:
         self.grpc_server, self.health, self.grpc_port = serve_risk(
             service, grpc_port if grpc_port is not None else self.config.grpc_port
         )
+        if self.supervisor is not None:
+            # BROWNOUT flips the gRPC health service to NOT_SERVING;
+            # DEGRADED keeps answering (flagged) so LBs keep routing.
+            self.supervisor.bind(health=self.health, metrics=self.metrics)
+            publisher = getattr(self.bridge, "publisher", None)
+            if publisher is not None and hasattr(publisher, "on_publish_result"):
+                amqp_breaker = self.supervisor.breaker("amqp")
+
+                def _amqp_result(ok: bool, exc) -> None:
+                    if ok:
+                        amqp_breaker.record_success()
+                    else:
+                        amqp_breaker.record_failure(exc)
+
+                publisher.on_publish_result = _amqp_result
         self.http_server, self.http_port = self._start_http(
             http_port if http_port is not None else self.config.http_port
         )
@@ -337,6 +390,24 @@ class RiskServer:
                 elif self.path == "/debug/thresholds":
                     block, review = server_ref.engine.get_thresholds()
                     self._send(200, json.dumps({"block": block, "review": review}))
+                elif self.path == "/debug/supervisorz":
+                    # Serving state machine + per-dependency breakers —
+                    # the first stop during a degraded window (runbook:
+                    # docs/operations.md "Degraded modes").
+                    sup = getattr(server_ref, "supervisor", None)
+                    if sup is None:
+                        self._send(404, '{"error":"supervisor disabled"}')
+                        return
+                    snap = sup.snapshot()
+                    engine = server_ref.engine
+                    snap["rebuilds"] = getattr(engine, "rebuilds", 0)
+                    inner = getattr(engine, "inner", engine)
+                    snap["degraded_steps"] = getattr(inner, "degraded_steps", 0)
+                    chan = getattr(inner, "_chan", None)
+                    if chan is not None:
+                        snap["followers_alive"] = chan.alive
+                        snap["resurrections"] = chan.resurrections
+                    self._send(200, json.dumps(snap))
                 elif self.path == "/debug/spans":
                     from igaming_platform_tpu.obs.tracing import DEFAULT_COLLECTOR
                     self._send(200, DEFAULT_COLLECTOR.to_json())
@@ -369,7 +440,28 @@ class RiskServer:
                 except json.JSONDecodeError:
                     self._send(400, '{"error":"bad json"}')
                     return
-                if self.path == "/debug/thresholds":
+                if self.path == "/debug/breakers":
+                    # Operator force/clear (runbook): {"dep": "device",
+                    # "action": "open"|"clear"|"probe"}, or
+                    # {"brownout": "force"|"clear"}.
+                    sup = getattr(server_ref, "supervisor", None)
+                    if sup is None:
+                        self._send(404, '{"error":"supervisor disabled"}')
+                        return
+                    try:
+                        if "brownout" in payload:
+                            if payload["brownout"] == "force":
+                                sup.force_brownout("operator /debug/breakers")
+                            else:
+                                sup.clear_brownout()
+                        else:
+                            sup.force_breaker(str(payload.get("dep", "")),
+                                              str(payload.get("action", "")))
+                    except (KeyError, ValueError) as exc:
+                        self._send(400, json.dumps({"error": str(exc)}))
+                        return
+                    self._send(200, json.dumps(sup.snapshot()))
+                elif self.path == "/debug/thresholds":
                     server_ref.engine.set_thresholds(
                         int(payload.get("block", 80)), int(payload.get("review", 50))
                     )
@@ -401,16 +493,18 @@ class RiskServer:
     # -- lifecycle -----------------------------------------------------------
 
     def shutdown(self, grace: float = 30.0) -> None:
-        """NOT_SERVING -> stop bridge -> drain gRPC -> stop HTTP."""
+        """NOT_SERVING -> stop bridge -> drain gRPC, THEN the engine
+        (batcher + host-pipeline in-flight window) -> stop HTTP. The
+        engine drain rides graceful_stop so admitted requests finish
+        against a live engine — SIGTERM under load loses zero of them."""
         self._stopped.set()
         if self.batch_refresh is not None:
             self.batch_refresh.stop()
         self.bridge.stop()
-        graceful_stop(self.grpc_server, self.health, grace)
+        graceful_stop(self.grpc_server, self.health, grace, engine=self.engine)
         self.http_server.shutdown()
         if self.otlp is not None:
             self.otlp.stop()
-        self.engine.close()
 
     def wait_for_signal(self) -> None:
         done = threading.Event()

@@ -1,0 +1,76 @@
+"""Seeded CC04 violations: silent swallowing of broad exception types."""
+
+import logging
+import socket
+
+logger = logging.getLogger(__name__)
+
+
+class Channel:
+    def __init__(self, metrics, breaker):
+        self.sock = socket.socket()
+        self.metrics = metrics
+        self.breaker = breaker
+        self.last = None
+
+    def bad_silent_pass(self):
+        try:
+            self.sock.sendall(b"x")
+        except OSError:  # expect: CC04
+            pass
+
+    def bad_swallow_to_default(self):
+        try:
+            return self.sock.recv(16)
+        except Exception:  # expect: CC04
+            return b""
+
+    def bad_log_without_traceback(self):
+        try:
+            self.sock.sendall(b"x")
+        except OSError:  # expect: CC04
+            logger.warning("send failed")
+
+    def good_reraise(self):
+        try:
+            self.sock.sendall(b"x")
+        except OSError:
+            raise RuntimeError("channel dead")
+
+    def good_recorder(self):
+        try:
+            self.sock.sendall(b"x")
+        except OSError as exc:
+            self.breaker.record_failure(exc)
+
+    def good_metric(self):
+        try:
+            self.sock.sendall(b"x")
+        except OSError:
+            self.metrics.send_failures_total.inc()
+
+    def good_traceback_log(self):
+        try:
+            self.sock.sendall(b"x")
+        except OSError:
+            logger.exception("send failed")
+
+    def good_exc_info_log(self):
+        try:
+            self.sock.sendall(b"x")
+        except OSError:
+            logger.warning("send failed", exc_info=True)
+
+    def good_narrow(self):
+        # Narrow exception types are out of scope — CC04 is about the
+        # broad catch-alls that hide unrelated failures.
+        try:
+            self.sock.sendall(b"x")
+        except BrokenPipeError:
+            pass
+
+    def good_annotated(self):
+        try:
+            self.sock.close()
+        except OSError:  # noqa: CC04 — teardown is best-effort
+            pass

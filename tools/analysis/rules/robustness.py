@@ -1,0 +1,118 @@
+"""CC04 — silent failure swallowing in the serving layer.
+
+The supervisor PR's whole premise is that dependency failures must be
+LOUD — re-raised, recorded into a breaker/`_mark_dead`-style recorder, or
+at least counted on a metric — so the serving state machine can react.
+An ``except OSError: pass`` (or a broad ``except Exception`` that just
+logs-and-forgets without a traceback) is how a dead follower or a
+flapping store stays invisible until the p99 graph finds it. This rule
+flags broad handlers in the concurrency scope (serve/ in repo mode) that
+do none of those things.
+
+A handler counts as LOUD when its body (transitively, at any depth)
+contains any of:
+
+- a ``raise`` (re-raise or translate);
+- a call to a failure recorder — a name matching ``_mark_dead`` /
+  ``record_failure`` / ``fail`` / ``abort`` and friends;
+- a metric write: an attribute call named ``inc`` / ``observe`` /
+  ``observe_many`` / ``set``;
+- a logging call that keeps the traceback: ``logger.exception(...)`` or
+  any logging call with ``exc_info=...``.
+
+Deliberate best-effort swallows (shutdown paths, metrics hooks) carry a
+scoped suppression — the repo's existing ``# noqa: BLE001`` annotations
+alias to this rule, so every intentional broad handler that already
+explains itself stays quiet and the unannotated ones surface.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.analysis.engine import (
+    FileContext,
+    ProjectContext,
+    call_name,
+    rule,
+)
+
+_BROAD_TYPES = {"Exception", "BaseException", "OSError", "ConnectionError"}
+
+_RECORDER_RE = re.compile(
+    r"(mark_dead|mark_failed|mark_.*_dead|record_failure|record_error|"
+    r"record_success|force_open|note_result|on_failure|fail|abort|"
+    r"_domain_error|set_exception)$")
+
+_METRIC_CALLS = {"inc", "observe", "observe_many", "set"}
+
+_LOG_WITH_TRACEBACK = {"exception"}
+
+
+def _scoped_files(project: ProjectContext) -> list[FileContext]:
+    config = project.caches.get("config", {})
+    prefixes = config.get("cc_scope")
+    if not prefixes:
+        return list(project.files)
+    return [f for f in project.files
+            if any(f.relpath.startswith(p) for p in prefixes)]
+
+
+def _handler_types(node: ast.ExceptHandler) -> list[str]:
+    """Rightmost names of the caught exception type(s)."""
+    t = node.type
+    if t is None:
+        return []
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    names = []
+    for e in elts:
+        if isinstance(e, ast.Attribute):
+            names.append(e.attr)
+        elif isinstance(e, ast.Name):
+            names.append(e.id)
+    return names
+
+
+def _is_loud(node: ast.ExceptHandler) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Raise):
+            return True
+        if isinstance(sub, ast.Call):
+            name = call_name(sub)
+            if name is None:
+                continue
+            if _RECORDER_RE.search(name):
+                return True
+            if name in _METRIC_CALLS and isinstance(sub.func, ast.Attribute):
+                return True
+            if name in _LOG_WITH_TRACEBACK:
+                return True
+            if any(kw.arg == "exc_info" for kw in sub.keywords):
+                return True
+    return False
+
+
+@rule("CC04", "silent-exception-swallow",
+      "A broad `except OSError`/`except Exception` handler that neither "
+      "re-raises, calls a `_mark_dead`-style failure recorder, increments "
+      "a metric, nor logs the traceback swallows the dependency failure "
+      "the serving supervisor exists to react to — a dead follower or a "
+      "flapping store stays invisible until the latency graph finds it. "
+      "Make the failure loud, or annotate a deliberate best-effort "
+      "swallow with a scoped `# noqa: CC04` and a reason.",
+      scope="project", aliases=("BLE001",))
+def silent_exception_swallow(project: ProjectContext):
+    for ctx in _scoped_files(project):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            broad = _BROAD_TYPES & set(_handler_types(node))
+            if not broad or _is_loud(node):
+                continue
+            yield ctx, node.lineno, (
+                f"broad `except {'/'.join(sorted(broad))}` swallows the "
+                "failure silently: re-raise, feed a failure recorder/"
+                "breaker, increment a metric, or log with the traceback "
+                "(scoped `# noqa: CC04` for deliberate best-effort "
+                "swallows)")
