@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-fast lint lint-json lint-update-baseline bench bench-all bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
+.PHONY: all test test-fast lint lint-json lint-update-baseline bench bench-all bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
 
 all: native test
 
@@ -52,6 +52,21 @@ soak:
 # Sustained mixed load at the gRPC wire (SOAK_DURATION_S, default 60s).
 soak-wire:
 	$(PY) benchmarks/soak.py --wire
+
+# Follower-kill chaos soak (CHAOS_r06-style artifact).
+soak-chaos:
+	$(PY) benchmarks/soak.py --chaos
+
+# Fleet chaos: K replica processes behind the account-affinity router,
+# replica SIGKILL + brownout + link-drop under load -> FLEET_CHAOS
+# artifact (FLEET_REPLICAS, FLEET_CHAOS_DURATION_S, FLEET_FAULTS).
+soak-fleet-chaos:
+	$(PY) benchmarks/soak.py --fleet-chaos
+
+# Boot a local scoring fleet (FLEET_K replicas, default 3) and print
+# the replica table; Ctrl-C tears it down.
+fleet:
+	$(PY) benchmarks/fleet.py
 
 # API smoke against RUNNING services (the reference's grpcurl api-test).
 api-test:

@@ -17,6 +17,19 @@ ships the compact index-mode frame (serve/wire.py) instead of a protobuf
 of full transactions, and the server's device step gathers feature rows
 from the HBM-resident table — only int32 slot indices + per-txn context
 cross the host->device link (serve/device_cache.py).
+
+``--fleet=addr1,addr2,...`` drives a scoring FLEET through the
+client-side account-affinity picker (serve/router.py
+AccountAffinityPicker): accounts partition by consistent hash so each
+replica's device cache holds a disjoint hot set, every RPC goes wholly
+to its owner, and UNAVAILABLE fails over to the next ring owner.
+
+Retry discipline (both modes): an UNAVAILABLE carrying the server's
+``grpc-retry-pushback-ms`` trailing hint (the supervisor watchdog's
+standard backoff signal, PR 5) is honored — jittered sleep of the hinted
+duration, then a bounded retry — and counted in the artifact
+(``pushback_honored``). Before this, the hint was emitted but no in-tree
+client respected it.
 """
 
 from __future__ import annotations
@@ -79,6 +92,72 @@ def _build_index_payloads(
             devices=[f"dev-{int(rng.integers(0, 64))}" for i in range(rows_per_rpc)],
         ))
     return payloads
+
+
+def _pushback_ms(exc: "grpc.RpcError") -> int | None:
+    """The server's standard retry hint off the trailing metadata, or
+    None when the failure carries no hint."""
+    try:
+        trailing = exc.trailing_metadata() or ()
+    except Exception:  # noqa: BLE001 — a dead channel may carry no metadata
+        return None
+    for key, value in trailing:
+        if key == "grpc-retry-pushback-ms":
+            try:
+                return max(0, int(value))
+            except ValueError:
+                return None
+    return None
+
+
+class _RetryStats:
+    """Shared retry accounting across worker threads (artifact fields)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.retries = 0
+        self.pushback_honored = 0
+        self.failovers = 0
+
+
+def _call_with_retry(calls, payload: bytes, metadata, stats: _RetryStats,
+                     rng: "np.random.Generator", timeout: float = 60,
+                     max_retries: int = 2):
+    """Issue an RPC with the client-side retry contract:
+
+    - ``calls`` is an ordered list of stubs — the ring owner first, then
+      failover owners (a single-server caller passes one stub, retried
+      in place);
+    - UNAVAILABLE with a ``grpc-retry-pushback-ms`` hint sleeps the
+      hinted duration (jittered 0.5x-1.5x, capped 2 s) before retrying;
+      without a hint the failover is immediate on a fleet (the next
+      owner is an independent process) and a hintless single-server
+      UNAVAILABLE after the last stub re-raises;
+    - bounded at ``max_retries`` total retries — a client retry loop
+      with no bound is the CC05 anti-pattern.
+    """
+    last_exc = None
+    for attempt in range(max_retries + 1):
+        call = calls[min(attempt, len(calls) - 1)]
+        try:
+            return call(payload, timeout=timeout, metadata=metadata)
+        except grpc.RpcError as exc:
+            if exc.code() != grpc.StatusCode.UNAVAILABLE or attempt == max_retries:
+                raise
+            last_exc = exc
+            hint = _pushback_ms(exc)
+            with stats.lock:
+                stats.retries += 1
+                if hint is not None:
+                    stats.pushback_honored += 1
+                if len(calls) > 1 and attempt + 1 < len(calls):
+                    stats.failovers += 1
+            if hint is None and len(calls) == 1:
+                raise  # nowhere else to go and no hint: surface it
+            if hint:
+                time.sleep(min(hint, 2000) / 1000.0
+                           * (0.5 + float(rng.random())))
+    raise last_exc  # pragma: no cover — loop always returns or raises
 
 
 def availability_block(events, t_start: float, t_end: float,
@@ -189,6 +268,53 @@ def _seed_store(engine, n_accounts: int = 512, events_per_acct: int = 6) -> None
             ))
 
 
+def _build_fleet_payloads(
+    addrs: list[str], rows_per_rpc: int, wire_mode: str,
+    n_variants: int = 4, n_accounts: int = 512,
+) -> tuple[dict[str, list[bytes]], "object"]:
+    """Per-replica payloads under account affinity: partition the account
+    space by ring owner (serve/router.py AccountAffinityPicker — the SAME
+    ring the L7 router uses), then build each replica's payload variants
+    from only the accounts it owns. Returns ({addr: payloads}, picker)."""
+    from igaming_platform_tpu.serve.router import AccountAffinityPicker
+
+    from igaming_platform_tpu.serve.wire import encode_index_batch
+
+    picker = AccountAffinityPicker(addrs)
+    owned = picker.partition(f"lg-{i}" for i in range(n_accounts))
+    rng = np.random.default_rng(7)
+    tx_types = ("deposit", "bet", "withdraw")
+    per_addr: dict[str, list[bytes]] = {}
+    for addr in addrs:
+        accts = owned.get(addr) or [f"lg-fleet-{addr}"]
+        payloads = []
+        for v in range(n_variants):
+            ids = [accts[int(rng.integers(0, len(accts)))]
+                   for _ in range(rows_per_rpc)]
+            amounts = [int(rng.integers(100, 100_000))
+                       for _ in range(rows_per_rpc)]
+            types = [tx_types[int(rng.integers(0, 3))]
+                     for _ in range(rows_per_rpc)]
+            ips = [f"10.{v}.{i % 200}.{i % 251}" for i in range(rows_per_rpc)]
+            devs = [f"dev-{int(rng.integers(0, 64))}"
+                    for _ in range(rows_per_rpc)]
+            if wire_mode == "index":
+                payloads.append(encode_index_batch(
+                    ids, amounts, types, ips=ips, devices=devs))
+            else:
+                txs = [
+                    risk_pb2.ScoreTransactionRequest(
+                        account_id=ids[i], amount=amounts[i],
+                        transaction_type=types[i], ip_address=ips[i],
+                        device_id=devs[i])
+                    for i in range(rows_per_rpc)
+                ]
+                payloads.append(risk_pb2.ScoreBatchRequest(
+                    transactions=txs).SerializeToString())
+        per_addr[addr] = payloads
+    return per_addr, picker
+
+
 def run_grpc_load(
     addr: str,
     *,
@@ -197,11 +323,20 @@ def run_grpc_load(
     concurrency: int = 4,
     warmup_rpcs: int = 3,
     wire_mode: str = "row",
+    fleet_addrs: list[str] | None = None,
 ) -> dict:
     """Drive ScoreBatch at ``addr`` from ``concurrency`` client threads for
     ``duration_s``; returns sustained txns/s + RPC latency percentiles.
-    ``wire_mode='index'`` ships index-mode frames (HBM feature cache)."""
-    if wire_mode == "index":
+    ``wire_mode='index'`` ships index-mode frames (HBM feature cache).
+    ``fleet_addrs`` switches to fleet mode: each worker drives its
+    account-affine replica through the client-side picker, failing over
+    to the next ring owner on UNAVAILABLE."""
+    fleet_payloads: dict[str, list[bytes]] = {}
+    if fleet_addrs:
+        fleet_payloads, _picker = _build_fleet_payloads(
+            fleet_addrs, rows_per_rpc, wire_mode)
+        payloads = next(iter(fleet_payloads.values()))
+    elif wire_mode == "index":
         payloads = _build_index_payloads(rows_per_rpc)
     else:
         payloads = _build_request_payloads(rows_per_rpc)
@@ -210,6 +345,7 @@ def run_grpc_load(
     results: list[list[tuple[float, float]]] = [[] for _ in range(concurrency)]
     errors = [0]
     shed = [0]
+    retry_stats = _RetryStats()
     # Failures broken down by gRPC status code: a single opaque counter
     # (1236 in BENCH_r05) cannot tell DEADLINE_EXCEEDED backpressure from
     # UNAVAILABLE crashes at a glance. Guarded by errors_lock — worker
@@ -231,15 +367,29 @@ def run_grpc_load(
     def worker(k: int) -> None:
         # Own channel per worker: one HTTP/2 connection each, so the test
         # measures the server, not client-side connection multiplexing.
-        ch = grpc.insecure_channel(addr)
-        call = ch.unary_unary(
-            "/risk.v1.RiskService/ScoreBatch",
-            request_serializer=lambda b: b,
-            response_deserializer=lambda b: b,  # decode cost excluded: server-side measurement
-        )
+        # Fleet mode: the worker's primary is its account-affine replica;
+        # the remaining replicas (ring rotation order) are failover
+        # targets for _call_with_retry.
+        if fleet_addrs:
+            pi = k % len(fleet_addrs)
+            worker_addrs = fleet_addrs[pi:] + fleet_addrs[:pi]
+            worker_payloads = fleet_payloads[worker_addrs[0]]
+        else:
+            worker_addrs = [addr]
+            worker_payloads = payloads
+        channels = [grpc.insecure_channel(a) for a in worker_addrs[:3]]
+        calls = [
+            ch.unary_unary(
+                "/risk.v1.RiskService/ScoreBatch",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,  # decode cost excluded: server-side measurement
+            )
+            for ch in channels
+        ]
+        retry_rng = np.random.default_rng(1000 + k)
         try:
             for i in range(warmup_rpcs):
-                call(payloads[i % len(payloads)], timeout=60)
+                calls[0](worker_payloads[i % len(worker_payloads)], timeout=60)
         except grpc.RpcError as exc:
             _count_error(exc)
         finally:
@@ -257,7 +407,9 @@ def run_grpc_load(
             _, metadata = _client_traceparent()
             t0 = time.perf_counter()
             try:
-                call(payloads[i % len(payloads)], timeout=60, metadata=metadata)
+                _call_with_retry(
+                    calls, worker_payloads[i % len(worker_payloads)],
+                    metadata, retry_stats, retry_rng)
             except grpc.RpcError as exc:
                 # Shed vs failure must not conflate (the soak harness's
                 # discipline, benchmarks/soak.py): RESOURCE_EXHAUSTED is
@@ -278,7 +430,8 @@ def run_grpc_load(
                 t1 = time.perf_counter()
                 results[k].append((t1, (t1 - t0) * 1000.0))
             i += 1
-        ch.close()
+        for ch in channels:
+            ch.close()
 
     threads = [threading.Thread(target=worker, args=(k,)) for k in range(concurrency)]
     t_start = time.perf_counter()
@@ -315,6 +468,13 @@ def run_grpc_load(
         "errors": errors[0],
         "errors_by_code": dict(sorted(errors_by_code.items())),
         "bulk_shed": shed[0],
+        # Client retry contract: UNAVAILABLE retries, how many honored
+        # the server's grpc-retry-pushback-ms hint, and (fleet mode) how
+        # many failed over to the next ring owner.
+        "retries": retry_stats.retries,
+        "pushback_honored": retry_stats.pushback_honored,
+        "failovers": retry_stats.failovers,
+        **({"fleet_replicas": len(fleet_addrs)} if fleet_addrs else {}),
         "rpc_p50_ms": round(float(np.percentile(lat, 50)), 3) if n_rpcs else None,
         "rpc_p99_ms": round(float(np.percentile(lat, 99)), 3) if n_rpcs else None,
         "wall_s": round(wall, 3),
@@ -389,18 +549,23 @@ def start_inprocess_server(
 def main() -> None:
     wire_mode = os.environ.get("LOAD_WIRE_MODE", "row")
     addr = None
+    fleet_addrs: list[str] | None = None
     for arg in sys.argv[1:]:
         if arg.startswith("--wire-mode="):
             wire_mode = arg.split("=", 1)[1]
         elif arg == "--wire-mode":
             raise SystemExit("use --wire-mode=row|index")
+        elif arg.startswith("--fleet="):
+            fleet_addrs = [a for a in arg.split("=", 1)[1].split(",") if a]
         else:
             addr = arg
     if wire_mode not in ("row", "index"):
         raise SystemExit(f"unknown wire mode {wire_mode!r} (row|index)")
     shutdown = None
     engine = None
-    if addr is None:
+    if fleet_addrs:
+        addr = fleet_addrs[0]
+    elif addr is None:
         addr, shutdown, engine = start_inprocess_server(
             batch_size=int(os.environ.get("LOAD_BATCH", 4096)),
         )
@@ -411,6 +576,7 @@ def main() -> None:
             rows_per_rpc=int(os.environ.get("LOAD_ROWS_PER_RPC", 4096)),
             concurrency=int(os.environ.get("LOAD_CONCURRENCY", 4)),
             wire_mode=wire_mode,
+            fleet_addrs=fleet_addrs,
         )
         pipeline = getattr(engine, "pipeline", None)
         if pipeline is not None:

@@ -384,6 +384,34 @@ class ServiceMetrics:
             "reconnect loop (hello/fingerprint + param re-sync) after "
             "dying or wedging",
         )
+        # Scale-out scoring fleet (serve/router.py): ring membership,
+        # failover retries, and hedged-RPC accounting — the dashboard a
+        # fleet chaos soak (FLEET_CHAOS artifacts) reads.
+        self.ring_replicas = self.registry.gauge(
+            f"{service}_ring_replicas",
+            "Scoring replicas by ring {state}: serving and degraded "
+            "replicas are IN the consistent-hash ring (degraded answers "
+            "are flagged, not errored); brownout (replica health "
+            "NOT_SERVING) and dead (probe/forward failures) replicas are "
+            "evicted until the health watcher re-admits them",
+        )
+        self.router_retries_total = self.registry.counter(
+            f"{service}_router_retries_total",
+            "Router forward retries onto the next ring owner by {reason}: "
+            "pushback = UNAVAILABLE carrying the server's "
+            "grpc-retry-pushback-ms hint (honored with jitter), "
+            "unavailable = UNAVAILABLE without a hint, link_drop = "
+            "router->replica link fault (chaos seam router.forward)",
+        )
+        self.hedge_total = self.registry.counter(
+            f"{service}_hedge_total",
+            "Hedged ScoreTransaction RPCs by {outcome}: launched = a "
+            "straggling primary crossed the latency-percentile hedge "
+            "deadline and a copy went to the secondary ring owner; "
+            "win_primary / win_hedge = which copy answered first (the "
+            "loser is cancelled); both_failed = neither answered. Every "
+            "launched hedge lands in exactly one terminal outcome",
+        )
         self.spans_dropped_total = self.registry.counter(
             f"{service}_spans_dropped_total",
             "Host spans evicted from the bounded span ring before export "

@@ -147,7 +147,7 @@ class OtlpExporter:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            self._stop.wait(self.interval_s)
+            self._stop.wait(self.interval_s)  # noqa: CC05 — fixed-cadence export ticker, not a retry backoff
             try:
                 self.flush()
             except Exception:  # noqa: BLE001 — exporter must not die

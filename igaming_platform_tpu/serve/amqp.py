@@ -29,6 +29,7 @@ tests via RABBITMQ_URL (skipped when the broker is absent).
 from __future__ import annotations
 
 import logging
+import random
 import socket
 import struct
 import threading
@@ -568,8 +569,11 @@ class AmqpPublisher:
                 last = exc
                 if attempt == self.max_retries:
                     break
-                # Linear backoff reconnect (publisher.go:91-108).
-                time.sleep(self.retry_delay * (attempt + 1))
+                # Linear backoff reconnect (publisher.go:91-108), with
+                # jitter: N publishers behind one flapping broker must
+                # not re-dial in lockstep (CC05).
+                time.sleep(self.retry_delay * (attempt + 1)
+                           * random.uniform(0.5, 1.5))
                 try:
                     with self._lock:
                         self._connect()
@@ -676,13 +680,13 @@ class AmqpConsumer:
                 if conn is not None:
                     conn.close()
                 conn = None
-                time.sleep(self.reconnect_delay)
+                time.sleep(self.reconnect_delay * random.uniform(0.5, 1.5))
             except AmqpError as exc:
                 logger.warning("consumer %s protocol error: %s", qname, exc)
                 if conn is not None:
                     conn.close()
                 conn = None
-                time.sleep(self.reconnect_delay)
+                time.sleep(self.reconnect_delay * random.uniform(0.5, 1.5))
 
     def _process(
         self, conn: AmqpConnection, tag: int, body: bytes, handler: EventHandler

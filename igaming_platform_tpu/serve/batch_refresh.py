@@ -143,4 +143,4 @@ class BatchFeatureRefreshJob:
                 if self.last_refresh_at > 0
                 else min(15.0, self.interval_s)
             )
-            self._stop.wait(wait)
+            self._stop.wait(wait)  # noqa: CC05 — refresh ticker cadence (interval_s), not a retry backoff

@@ -17,6 +17,18 @@ Seams (each a single ``chaos.fire(seam)`` call at the choke point):
 - ``feature_store.gather`` — host feature gather / native decode+gather
 - ``workchannel.send``  — the front -> follower work-frame socket write
 - ``amqp.publish``      — the event-backbone publish attempt
+- ``router.forward``    — a fleet router's forward of a scoring RPC to a
+  replica (serve/router.py); ``drop`` severs the router↔replica link for
+  that forward, which must retry onto the next ring owner
+- ``router.health``     — the fleet health watcher's probe of a replica;
+  ``drop``/``error`` make the replica look dead to the watcher
+
+Fleet-level *process* faults — replica SIGKILL (pod death) and replica
+wedge (SIGSTOP, the process stops answering but the sockets stay open) —
+cannot be fired from inside the victim: they are scheduled by the fleet
+harness (``benchmarks/fleet.py`` ``FleetFaultSchedule``, driven by
+``benchmarks/soak.py --fleet-chaos``) and recorded in the FLEET_CHAOS
+artifact next to the seam injections above.
 
 Fault kinds: ``delay`` (sleep ``ms``), ``wedge`` (a LONG sleep — the
 tunnel-wedge shape; bounded by ``ms`` so tests terminate), ``error``
@@ -61,6 +73,8 @@ SEAMS = (
     "feature_store.gather",
     "workchannel.send",
     "amqp.publish",
+    "router.forward",
+    "router.health",
 )
 
 _KINDS = ("delay", "wedge", "error", "drop")

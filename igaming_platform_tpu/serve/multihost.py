@@ -48,6 +48,7 @@ MAGIC_STOP = b"S"
 ACK_BYTE = b"A"
 
 import os as _os
+import random as _random
 
 from time import monotonic as _monotonic, sleep as _sleep
 
@@ -187,7 +188,9 @@ def _dial_follower(port: int, dial_timeout_s: float,
         except OSError:
             if _monotonic() > deadline:
                 raise
-            _sleep(0.2)
+            # Jittered dial retry (CC05): K fronts booting against one
+            # follower host must not re-dial in lockstep.
+            _sleep(_random.uniform(0.1, 0.3))
     s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     s.settimeout(io_timeout_s)
     return s

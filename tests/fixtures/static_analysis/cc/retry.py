@@ -1,0 +1,76 @@
+"""Seeded CC05 violations: retry loops without jitter or without a bound
+(compliant siblings below must stay quiet)."""
+
+import random
+import time
+
+
+def bad_unjittered_linear_backoff(op):
+    for attempt in range(5):
+        try:
+            return op()
+        except TimeoutError:
+            time.sleep(0.5 * (attempt + 1))  # expect: CC05
+
+
+def bad_unbounded_retry_never_gives_up(op):
+    while True:
+        try:
+            return op()
+        except TimeoutError:
+            time.sleep(0.1 * (1.0 + random.random()))  # expect: CC05
+
+
+def bad_unjittered_event_wait_backoff(op, stop_event):
+    delay = 0.25
+    while not stop_event.is_set():
+        try:
+            return op()
+        except TimeoutError:
+            stop_event.wait(delay)  # expect: CC05
+
+
+def good_bounded_jittered_backoff(op):
+    for attempt in range(5):
+        try:
+            return op()
+        except TimeoutError:
+            if attempt == 4:
+                raise
+            time.sleep((0.1 * 2 ** attempt) * (0.5 + random.random()))
+
+
+def good_unbounded_shape_but_gives_up(op, deadline):
+    while True:
+        try:
+            return op()
+        except TimeoutError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(random.uniform(0.1, 0.3))
+
+
+def good_jitter_through_local_variable(op, stop_event):
+    while not stop_event.is_set():
+        delay = 0.2 * (0.5 + random.random())
+        try:
+            return op()
+        except TimeoutError:
+            stop_event.wait(delay)
+
+
+def good_jitter_behind_named_helper(op, backoff_s):
+    for _attempt in range(8):
+        try:
+            return op()
+        except TimeoutError:
+            time.sleep(backoff_s())
+
+
+def good_annotated_fixed_cadence_poller(poll, stop_event):
+    while not stop_event.is_set():
+        try:
+            poll()
+        except TimeoutError:
+            pass
+        stop_event.wait(1.0)  # noqa: CC05 — deliberate fixed-cadence poller

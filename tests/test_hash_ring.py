@@ -1,0 +1,185 @@
+"""Property tests for the fleet consistent-hash ring (serve/router.py).
+
+The ring is the fleet's state-placement contract: every router AND every
+client-side picker, in every process, across every restart, must map an
+``account_id`` to the same replica — otherwise "each replica's HBM cache
+holds a disjoint hot set" silently becomes "every replica churns through
+every account". These tests pin:
+
+- restart stability: the mapping is a pure function of the replica list
+  (golden owners hard-coded, so even a hash-function change is LOUD);
+- minimal movement: evicting one replica of N moves only that replica's
+  keys (~1/N), each to its precomputed secondary; readmission restores
+  the exact original mapping;
+- deterministic secondary selection: ``owners(key, 2)[1]`` is exactly
+  where the key lands if the primary dies — hedging and failover agree
+  on placement.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+from igaming_platform_tpu.serve.router import (
+    AccountAffinityPicker,
+    HashRing,
+    LatencyWindow,
+)
+
+KEYS = [f"acct-{i}" for i in range(3000)]
+
+
+def _ring(n: int = 10, vnodes: int = 64) -> HashRing:
+    return HashRing([f"r{i}" for i in range(n)], vnodes=vnodes)
+
+
+# ---------------------------------------------------------------------------
+# Stability
+
+
+def test_owner_mapping_is_restart_stable_golden():
+    """Hard-coded owners: a new process (or a changed hash function)
+    must reproduce these exactly. Recompute only on a DELIBERATE ring
+    format change — every deployed picker must be updated in lockstep."""
+    ring = HashRing([f"r{i}" for i in range(4)], vnodes=64)
+    assert ring.owners("lg-0", 2) == ["r1", "r2"]
+    assert ring.owners("lg-1", 2) == ["r2", "r3"]
+    assert ring.owners("lg-42", 2) == ["r1", "r3"]
+    assert ring.owners("acct-7f3", 2) == ["r1", "r3"]
+    assert ring.owners("whale-9", 2) == ["r0", "r3"]
+
+
+def test_two_rings_same_members_agree_everywhere():
+    a, b = _ring(), _ring()
+    for k in KEYS:
+        assert a.owner(k) == b.owner(k)
+        assert a.owners(k, 3) == b.owners(k, 3)
+
+
+def test_join_order_does_not_matter():
+    ids = [f"r{i}" for i in range(8)]
+    a = HashRing(ids)
+    b = HashRing(reversed(ids))
+    assert all(a.owner(k) == b.owner(k) for k in KEYS)
+
+
+def test_distribution_is_roughly_uniform():
+    ring = _ring(10)
+    counts = Counter(ring.owner(k) for k in KEYS)
+    assert len(counts) == 10
+    # 64 vnodes: no replica owns more than ~3x its fair share.
+    assert max(counts.values()) < 3 * len(KEYS) / 10
+
+
+# ---------------------------------------------------------------------------
+# Minimal movement
+
+
+def test_evict_moves_only_the_evicted_replicas_keys():
+    ring = _ring(10)
+    before = {k: ring.owner(k) for k in KEYS}
+    secondary = {k: ring.owners(k, 2) for k in KEYS}
+    ring.evict("r3")
+    after = {k: ring.owner(k) for k in KEYS}
+    moved = [k for k in KEYS if before[k] != after[k]]
+    # Exactly the evicted replica's keys move — no collateral remapping.
+    assert moved == [k for k in KEYS if before[k] == "r3"]
+    # ~1/N of keys (generous 2x slack for hash variance).
+    assert len(moved) <= 2 * len(KEYS) / 10
+    # Each moved key lands on its precomputed secondary owner.
+    for k in moved:
+        assert after[k] == secondary[k][1]
+
+
+def test_readmission_restores_exact_original_mapping():
+    ring = _ring(10)
+    before = {k: ring.owner(k) for k in KEYS}
+    ring.evict("r7")
+    ring.readmit("r7")
+    assert {k: ring.owner(k) for k in KEYS} == before
+
+
+def test_join_moves_at_most_a_fair_share():
+    ring = _ring(9)
+    before = {k: ring.owner(k) for k in KEYS}
+    ring.add("r9")
+    after = {k: ring.owner(k) for k in KEYS}
+    moved = [k for k in KEYS if before[k] != after[k]]
+    # A joining 10th replica takes ~1/10 of the keys, all to itself.
+    assert all(after[k] == "r9" for k in moved)
+    assert len(moved) <= 2 * len(KEYS) / 10
+
+
+def test_cascading_evictions_never_touch_surviving_placement():
+    ring = _ring(5)
+    ring.evict("r0")
+    mid = {k: ring.owner(k) for k in KEYS}
+    ring.evict("r1")
+    after = {k: ring.owner(k) for k in KEYS}
+    moved = [k for k in KEYS if mid[k] != after[k]]
+    assert moved == [k for k in KEYS if mid[k] == "r1"]
+
+
+# ---------------------------------------------------------------------------
+# Secondary-owner determinism (the hedge target)
+
+
+def test_secondary_owner_is_deterministic_and_distinct():
+    ring = _ring(10)
+    for k in KEYS[:500]:
+        o = ring.owners(k, 2)
+        assert len(o) == 2 and o[0] != o[1]
+        assert ring.owners(k, 2) == o  # stable on re-ask
+
+
+def test_secondary_owner_is_the_failover_owner():
+    """The hedge target IS where the key fails over to: hedging warms
+    exactly the cache that an eviction would start hitting. Evict each
+    key's primary; the new owner must equal the precomputed secondary."""
+    ring = _ring(10)
+    for k in KEYS[:300]:
+        primary, second = ring.owners(k, 2)
+        ring.evict(primary)
+        assert ring.owner(k) == second
+        ring.readmit(primary)
+
+
+def test_owners_skip_inactive_but_remember_members():
+    ring = _ring(3)
+    ring.evict("r0")
+    ring.evict("r1")
+    assert all(ring.owner(k) == "r2" for k in KEYS[:100])
+    assert ring.owners("acct-1", 3) == ["r2"]
+    assert sorted(ring.members) == ["r0", "r1", "r2"]
+    ring.evict("r2")
+    assert ring.owner("acct-1") is None
+
+
+# ---------------------------------------------------------------------------
+# Client-side picker parity + hedge-deadline clamp
+
+
+def test_picker_agrees_with_router_ring():
+    addrs = [f"host{i}:50051" for i in range(4)]
+    picker = AccountAffinityPicker(addrs)
+    ring = HashRing([f"r{i}" for i in range(4)])
+    for k in KEYS[:500]:
+        rid = ring.owner(k)
+        assert picker.owner_addr(k) == addrs[int(rid[1:])]
+    parts = picker.partition(KEYS)
+    assert sum(len(v) for v in parts.values()) == len(KEYS)
+    assert set(parts) <= set(addrs)
+
+
+def test_latency_window_hedge_deadline():
+    lw = LatencyWindow(quantile=0.95, default_ms=75.0, min_ms=5.0,
+                       max_ms=100.0, min_samples=10)
+    # Under min_samples: the default.
+    assert lw.hedge_deadline_s() == 0.075
+    for ms in range(1, 101):
+        lw.observe_ms(float(ms))
+    # p95 of 1..100 ~ 95-96 ms, inside the clamp.
+    assert 0.09 <= lw.hedge_deadline_s() <= 0.1
+    for _ in range(200):
+        lw.observe_ms(5000.0)
+    assert lw.hedge_deadline_s() == 0.1  # max clamp

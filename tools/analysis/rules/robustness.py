@@ -1,4 +1,6 @@
-"""CC04 — silent failure swallowing in the serving layer.
+"""CC04/CC05 — robustness discipline in the serving layer.
+
+CC04: silent failure swallowing.
 
 The supervisor PR's whole premise is that dependency failures must be
 LOUD — re-raised, recorded into a breaker/`_mark_dead`-style recorder, or
@@ -24,6 +26,25 @@ Deliberate best-effort swallows (shutdown paths, metrics hooks) carry a
 scoped suppression — the repo's existing ``# noqa: BLE001`` annotations
 alias to this rule, so every intentional broad handler that already
 explains itself stays quiet and the unannotated ones surface.
+
+CC05: retry-backoff discipline (the fleet-router PR's rule). A retry
+loop that sleeps a FIXED delay synchronizes every retrying client into a
+stampede against the recovering dependency (the reason the router
+jitters its ``grpc-retry-pushback-ms`` honor 0.5x-1.5x), and a retry
+loop that can never give up (``while True`` with no ``raise`` anywhere)
+turns a dead dependency into a silent forever-spin. The rule finds
+loops that contain BOTH an exception handler and a backoff wait
+(``time.sleep(x)`` / ``event.wait(x)``) and flags:
+
+- a delay expression with no jitter — no call to ``random``/``uniform``/
+  ``*jitter*``/``*backoff*``-named helpers, directly or through a local
+  variable assignment;
+- an unbounded loop — ``while True`` whose body (nested functions
+  excluded) contains no ``raise``: nothing ever converts persistent
+  failure into a loud error.
+
+Deliberate fixed-cadence waits (pollers, tickers) carry a scoped
+``# noqa: CC05`` with a reason.
 """
 
 from __future__ import annotations
@@ -116,3 +137,133 @@ def silent_exception_swallow(project: ProjectContext):
                 "breaker, increment a metric, or log with the traceback "
                 "(scoped `# noqa: CC04` for deliberate best-effort "
                 "swallows)")
+
+
+# ---------------------------------------------------------------------------
+# CC05 — retry loops must jitter their backoff and be able to give up
+
+
+_JITTER_CALL_RE = re.compile(
+    r"(random|uniform|randint|normalvariate|expovariate|betavariate|"
+    r"triangular|jitter|backoff)", re.IGNORECASE)
+
+_WAIT_NAMES = {"sleep", "_sleep", "wait"}
+
+
+def _walk_scope(node: ast.AST):
+    """Walk a subtree WITHOUT descending into nested function defs (each
+    function is its own retry scope)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield child
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def _expr_has_jitter(expr: ast.AST,
+                     assignments: dict[str, list[ast.AST]],
+                     depth: int = 0) -> bool:
+    """Does the delay expression involve a randomness/jitter source —
+    directly, or through a local variable assigned one? Helper calls
+    whose NAME declares the discipline (``_backoff_s``, ``jittered``)
+    count: the policy lives behind them."""
+    if depth > 2:
+        return False
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call):
+            name = call_name(sub)
+            if name and _JITTER_CALL_RE.search(name):
+                return True
+        if isinstance(sub, ast.Name) and sub.id in assignments:
+            for assigned in assignments[sub.id]:
+                if _expr_has_jitter(assigned, assignments, depth + 1):
+                    return True
+    return False
+
+
+def _collect_assignments(fn: ast.AST) -> dict[str, list[ast.AST]]:
+    out: dict[str, list[ast.AST]] = {}
+    for node in _walk_scope(fn):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    out.setdefault(target.id, []).append(node.value)
+        elif isinstance(node, ast.AugAssign) and isinstance(
+                node.target, ast.Name):
+            out.setdefault(node.target.id, []).append(node.value)
+    return out
+
+
+def _loop_wait_calls(loop: ast.AST):
+    """(call, delay-expr) for every sleep/wait-with-timeout in the loop
+    body, nested functions excluded."""
+    for node in _walk_scope(loop):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name not in _WAIT_NAMES or not node.args:
+            continue
+        delay = node.args[0]
+        # Waits on a constant-free expression still need the jitter
+        # check; zero-ish literal waits (yield points) are not backoff.
+        if isinstance(delay, ast.Constant) and not delay.value:
+            continue
+        yield node, delay
+
+
+def _is_while_true(loop: ast.AST) -> bool:
+    return (isinstance(loop, ast.While)
+            and isinstance(loop.test, ast.Constant)
+            and loop.test.value is True)
+
+
+@rule("CC05", "retry-backoff-discipline",
+      "A retry loop (a loop containing both an exception handler and a "
+      "backoff sleep) that sleeps a fixed, unjittered delay synchronizes "
+      "every retrying client into a stampede against the recovering "
+      "dependency, and a `while True` retry loop with no `raise` can "
+      "never give up — a dead dependency becomes a silent forever-spin. "
+      "Jitter the delay (multiply by a random factor, or delegate to a "
+      "*backoff*/*jitter* helper) and bound the loop (attempt count or "
+      "deadline that raises). Deliberate fixed-cadence pollers carry a "
+      "scoped `# noqa: CC05` with a reason.",
+      scope="project")
+def retry_backoff_discipline(project: ProjectContext):
+    for ctx in _scoped_files(project):
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            assignments = None
+            for loop in _walk_scope(fn):
+                if not isinstance(loop, (ast.While, ast.For)):
+                    continue
+                handlers = [n for n in _walk_scope(loop)
+                            if isinstance(n, ast.ExceptHandler)]
+                if not handlers:
+                    continue
+                waits = list(_loop_wait_calls(loop))
+                if not waits:
+                    continue
+                if assignments is None:
+                    assignments = _collect_assignments(fn)
+                unbounded = _is_while_true(loop) and not any(
+                    isinstance(n, ast.Raise) for n in _walk_scope(loop))
+                for call, delay in waits:
+                    problems = []
+                    if not _expr_has_jitter(delay, assignments):
+                        problems.append(
+                            "fixed (unjittered) backoff delay — "
+                            "synchronized retries stampede the recovering "
+                            "dependency; multiply by a random factor")
+                    if unbounded:
+                        problems.append(
+                            "unbounded retry: `while True` with no "
+                            "`raise` in the loop never gives up — bound "
+                            "attempts or add a deadline that raises")
+                    if problems:
+                        yield ctx, call.lineno, (
+                            "retry loop backoff: " + "; ".join(problems)
+                            + " (scoped `# noqa: CC05` for a deliberate "
+                            "fixed-cadence poller)")
