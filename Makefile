@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-fast lint lint-json lint-update-baseline bench bench-all bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
+.PHONY: all test test-fast lint lint-json lint-update-baseline bench bench-all bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos soak-chaos-ledger replay-verify fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
 
 all: native test
 
@@ -62,6 +62,18 @@ soak-chaos:
 # artifact (FLEET_REPLICAS, FLEET_CHAOS_DURATION_S, FLEET_FAULTS).
 soak-fleet-chaos:
 	$(PY) benchmarks/soak.py --fleet-chaos
+
+# Ledger chaos: fs-outage + sink-outage + forced-degraded window +
+# mid-run SIGKILL of the server process, then bit-exact replay of the
+# surviving decision WAL -> REPLAY_r08.json (LEDGER_CHAOS_DURATION_S).
+soak-chaos-ledger:
+	$(PY) benchmarks/soak.py --chaos-ledger
+
+# Bit-exact decision replay smoke (tier-1-adjacent): score a seeded
+# batch under CHAOS_PLAN (ledger-append faults), replay the ledger with
+# tools/replay.py, diff every output field — heuristic tier included.
+replay-verify:
+	JAX_PLATFORMS=cpu $(PY) -m tools.replay --verify
 
 # Boot a local scoring fleet (FLEET_K replicas, default 3) and print
 # the replica table; Ctrl-C tears it down.

@@ -194,6 +194,66 @@ def e2e_numbers() -> dict:
         shutdown()
 
 
+def ledger_ab_numbers() -> dict:
+    """Ledger-on vs ledger-off e2e arm: the durable decision ledger
+    (serve/ledger.py) promises its WAL rides OFF the hot path — two
+    short identical wire runs, one with a ledger bound, must land within
+    noise of each other. The artifact records both throughputs, the
+    ratio, and the ledger's own counters (appended / dropped / fsync
+    p99), so a regression in the O(1)-enqueue promise is visible as a
+    ratio, not a vibe. BENCH_LEDGER_AB_S sizes the arms (0 disables)."""
+    import tempfile
+
+    from benchmarks.load_gen import run_grpc_load, start_inprocess_server
+
+    duration_s = float(os.environ.get("BENCH_LEDGER_AB_S", 4.0))
+    if duration_s <= 0:
+        return {}
+    rows = int(os.environ.get("BENCH_E2E_ROWS_PER_RPC", 8192))
+    batch = int(os.environ.get("BENCH_E2E_BATCH", 8192))
+    arms = {}
+    ledger_block = None
+    for arm in ("off", "on"):
+        ledger_dir = tempfile.mkdtemp(prefix="bench-ledger-") if arm == "on" else None
+        addr, shutdown, engine = start_inprocess_server(
+            batch_size=batch, ledger_dir=ledger_dir)
+        try:
+            load = run_grpc_load(addr, duration_s=duration_s,
+                                 rows_per_rpc=rows, concurrency=4)
+            arms[arm] = load["value"]
+            if arm == "on" and engine.ledger is not None:
+                engine.ledger.flush(5.0)
+                ledger_block = engine.ledger.stats_block()
+        finally:
+            shutdown()
+    ratio = arms["on"] / arms["off"] if arms.get("off") else None
+    cores = os.cpu_count() or 1
+    # The hot-path contract is an O(1) enqueue — but the WRITER THREAD's
+    # encode/fsync CPU is real, and on a 1-core control rig it shares
+    # the scoring core, so a flat-out A/B measures that tax directly
+    # (the WALLET_REPLICAS/FLEET_CHAOS honesty caveat). The bounded
+    # queue caps it: drops are counted, scoring is never blocked. On
+    # >=2 cores the writer rides its own core and the arm must land
+    # within normal run-to-run noise.
+    bar = 0.85 if cores >= 2 else 0.45
+    return {
+        "ledger_off_txns_per_sec": arms.get("off"),
+        "ledger_on_txns_per_sec": arms.get("on"),
+        "ledger_overhead_ratio": round(ratio, 4) if ratio else None,
+        "ledger_overhead_within_noise": bool(ratio and ratio >= bar),
+        "ledger_overhead_bar": bar,
+        "ledger_cpu_control_note": (
+            "1-core control rig: the ledger writer thread shares the "
+            "scoring core, so the flat-out ratio records the writer's "
+            "bounded CPU tax (queue drops cap it; the hot path never "
+            "blocks); on a multi-core host the writer owns a core and "
+            "the arm must land within noise (>=0.85)"
+            if cores < 2 else
+            "multi-core host: ratio reflects true hot-path overhead"),
+        "ledger_block": ledger_block,
+    }
+
+
 def main() -> None:
     _ensure_responsive_device()
     from igaming_platform_tpu.core.devices import enable_persistent_compile_cache
@@ -208,6 +268,10 @@ def main() -> None:
 
     try:
         result.update(e2e_numbers())
+        try:
+            result.update(ledger_ab_numbers())
+        except Exception as exc:  # noqa: BLE001 — the A/B arm must not lose the headline
+            result["ledger_ab_error"] = f"{type(exc).__name__}: {exc}"
         headline = float(result["e2e_txns_per_sec"])
         result.update({
             "metric": "e2e_grpc_fraud_score_txns_per_sec",

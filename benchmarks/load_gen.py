@@ -511,12 +511,18 @@ def run_single_txn_probe(addr: str, n: int = 150) -> dict:
 
 
 def start_inprocess_server(
-    *, batch_size: int = 4096, ml_backend: str = "multitask", seed_accounts: int = 512
+    *, batch_size: int = 4096, ml_backend: str = "multitask",
+    seed_accounts: int = 512, ledger_dir: str | None = None,
 ):
     """Production wiring on a free port: native feature store, multitask
     backend, native wire codec. Returns (addr, shutdown_fn, engine) —
     the engine so harnesses can read server-side pipeline stats
-    (inflight depth, host-stage overlap) into their artifacts."""
+    (inflight depth, host-stage overlap) into their artifacts.
+
+    ``ledger_dir`` (or the LEDGER_DIR env) binds a durable decision
+    ledger (serve/ledger.py) so load runs measure the audit pipeline's
+    hot-path cost — ``engine.ledger.stats_block()`` lands in artifacts
+    as ``ledger_block``."""
     import jax
 
     from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
@@ -535,6 +541,14 @@ def start_inprocess_server(
         batcher_config=BatcherConfig(batch_size=batch_size, max_wait_ms=1.0),
         feature_store=best_feature_store(),
     )
+    ledger = None
+    ledger_dir = ledger_dir or os.environ.get("LEDGER_DIR", "")
+    if ledger_dir:
+        from igaming_platform_tpu.serve import ledger as ledger_mod
+
+        ledger = ledger_mod.DecisionLedger(
+            ledger_dir, sink=ledger_mod.sink_from_env())
+        engine.ledger = ledger
     _seed_store(engine, n_accounts=seed_accounts)
     service = RiskGrpcService(engine)
     server, health, port = serve_risk(service, 0, max_workers=32)
@@ -542,6 +556,8 @@ def start_inprocess_server(
     def shutdown() -> None:
         server.stop(0)
         engine.close()
+        if ledger is not None:
+            ledger.close()
 
     return f"localhost:{port}", shutdown, engine
 
@@ -584,6 +600,12 @@ def main() -> None:
             load["pipeline_inflight_depth"] = stats["depth"]
             load["pipeline_max_inflight"] = stats["max_inflight"]
             load["host_stage_overlap_ratio"] = stats["overlap_ratio"]
+        ledger = getattr(engine, "ledger", None)
+        if ledger is not None:
+            # Audit-pipeline health under load: records appended, fsync
+            # p99, spill episodes, sink-queue high-water (serve/ledger.py).
+            ledger.flush(5.0)
+            load["ledger_block"] = ledger.stats_block()
         print(json.dumps(load), flush=True)
         probe = run_single_txn_probe(addr)
         print(json.dumps(probe), flush=True)

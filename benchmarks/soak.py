@@ -751,8 +751,323 @@ def main_fleet_chaos() -> None:
         sys.exit(1)
 
 
+def main_ledger_chaos() -> None:
+    """Ledger chaos soak (``--chaos-ledger``): one production-wired risk
+    server as an OS process (benchmarks/fleet.py replica protocol) with a
+    durable decision ledger (LEDGER_DIR) draining to a ClickHouse-shaped
+    sink owned by THIS harness — then the audit pipeline is broken every
+    way the acceptance criterion names, under live mixed load:
+
+    1. **fs outage** — a CHAOS_PLAN window of ``ledger.append=error``
+       inside the server (WAL writes fail; scoring must be untouched,
+       drops counted, the ``ledger`` breaker opens);
+    2. **sink outage** — the harness's ClickHouse endpoint returns 500
+       for a wall-clock window (the drainer falls behind and must catch
+       up from the WAL at its cursor);
+    3. **degraded window** — POST /debug/breakers forces the device
+       circuit open, so DEGRADED_CPU_HEURISTIC decisions land in the
+       ledger and must replay through the same heuristic tier;
+    4. **SIGKILL mid-run** — the server dies without a goodbye and
+       restarts on the SAME ledger dir (torn-tail truncation, sink
+       cursor resume).
+
+    Afterwards ``tools/replay.py`` re-scores the surviving WAL bit-exact
+    and the verdict + gates land in REPLAY_r08.json. Gates (exit 1 on
+    miss): zero replay mismatches with degraded decisions included,
+    zero scoring errors outside the kill outage window, and every WAL
+    record delivered to the sink at least once.
+    """
+    import tempfile
+    import urllib.request
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    import grpc
+
+    from igaming_platform_tpu.proto_gen.risk.v1 import risk_pb2
+    from fleet import ReplicaProc
+    from load_gen import availability_block
+
+    duration_s = float(os.environ.get("LEDGER_CHAOS_DURATION_S", 30.0))
+    rows = int(os.environ.get("LEDGER_CHAOS_ROWS_PER_RPC", 256))
+    degrade_at = float(os.environ.get("LEDGER_CHAOS_DEGRADE_AT_S", 0.1 * duration_s))
+    degrade_for = 2.5
+    sink_out_at = float(os.environ.get("LEDGER_CHAOS_SINK_OUT_AT_S", 0.22 * duration_s))
+    sink_out_for = float(os.environ.get("LEDGER_CHAOS_SINK_OUT_FOR_S", 0.13 * duration_s))
+    kill_at = float(os.environ.get("LEDGER_CHAOS_KILL_AT_S", 0.45 * duration_s))
+    restart_at = float(os.environ.get("LEDGER_CHAOS_RESTART_AT_S", 0.65 * duration_s))
+    chaos_plan = os.environ.get(
+        "LEDGER_CHAOS_PLAN", "seed=11;ledger.append=error:p=1.0:after=60:count=40")
+
+    # -- harness-owned ClickHouse-shaped sink endpoint -----------------------
+    sink_rows: list[dict] = []
+    sink_state = {"fail": False, "inserts": 0, "rejected": 0}
+    sink_lock = threading.Lock()
+
+    class _SinkHandler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            size = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(size).decode()
+            with sink_lock:
+                if sink_state["fail"]:
+                    sink_state["rejected"] += 1
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(b"Code: 999. DB::Exception: chaos outage")
+                    return
+                if body.startswith("INSERT INTO"):
+                    sink_state["inserts"] += 1
+                    for line in body.splitlines()[1:]:
+                        if line.strip():
+                            sink_rows.append(json.loads(line))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    sink_httpd = ThreadingHTTPServer(("127.0.0.1", 0), _SinkHandler)
+    threading.Thread(target=sink_httpd.serve_forever, daemon=True).start()
+    sink_url = f"http://127.0.0.1:{sink_httpd.server_address[1]}"
+
+    ledger_dir = tempfile.mkdtemp(prefix="soak-ledger-")
+    replica = ReplicaProc("ledger-0", batch_size=rows, env_extra={
+        "LEDGER_DIR": ledger_dir,
+        "LEDGER_SINK": "clickhouse",
+        "LEDGER_CLICKHOUSE_URL": sink_url,
+        "LEDGER_FSYNC_MS": "10",
+        "CHAOS_PLAN": chaos_plan,
+    })
+    replica.spawn()
+    addr = replica.addr
+
+    t0 = time.perf_counter()
+    # Mutable stop mark: the restart blocks on a full JAX boot, so the
+    # post-restart load tail is anchored to restart COMPLETION — the
+    # recovered-after-kill gate needs live traffic against the reborn
+    # process, not a clock that expired while it booted.
+    stop_box = [t0 + duration_s]
+    lock = threading.Lock()
+    events: list[tuple[float, bool]] = []
+    errors: list[str] = []
+    shed = [0]
+
+    load_payload = risk_pb2.ScoreBatchRequest(transactions=[
+        risk_pb2.ScoreTransactionRequest(
+            account_id=f"lg-{i % 128}", amount=1000 + i,
+            transaction_type=("deposit", "bet", "withdraw")[i % 3])
+        for i in range(rows)
+    ]).SerializeToString()
+
+    def _note(ok: bool, exc=None) -> None:
+        with lock:
+            events.append((time.perf_counter(), ok))
+            if not ok and exc is not None:
+                errors.append(repr(exc)[:120])
+
+    class _Caller:
+        """One client's unary call with real-world channel hygiene: a
+        reconnect-backoff cap (the fleet router's lesson — a 12 s kill
+        window otherwise grows gRPC's dial backoff past the restart) AND
+        a channel rebuild after a failure streak (a grpc-python channel
+        whose peer died by SIGKILL can wedge its subchannel fd — a fresh
+        dial succeeds while the old channel reports 'FD Shutdown'
+        timeouts forever)."""
+
+        _OPTS = [("grpc.max_reconnect_backoff_ms", 1000),
+                 ("grpc.initial_reconnect_backoff_ms", 200)]
+
+        def __init__(self, method: str, req_ser, resp_des):
+            self._method = method
+            self._req_ser = req_ser
+            self._resp_des = resp_des
+            self._consec = 0
+            self._ch = None
+            self._rebuild()
+
+        def _rebuild(self) -> None:
+            if self._ch is not None:
+                self._ch.close()
+            self._ch = grpc.insecure_channel(addr, options=self._OPTS)
+            self._call = self._ch.unary_unary(
+                self._method, request_serializer=self._req_ser,
+                response_deserializer=self._resp_des)
+
+        def __call__(self, payload, timeout: float):
+            try:
+                resp = self._call(payload, timeout=timeout)
+            except grpc.RpcError:
+                self._consec += 1
+                if self._consec % 25 == 0:
+                    self._rebuild()
+                raise
+            self._consec = 0
+            return resp
+
+        def close(self) -> None:
+            self._ch.close()
+
+    def batch_worker() -> None:
+        call = _Caller("/risk.v1.RiskService/ScoreBatch",
+                       lambda b: b, lambda b: b)
+        while time.perf_counter() < stop_box[0]:
+            try:
+                call(load_payload, timeout=20)
+                _note(True)
+            except grpc.RpcError as exc:
+                if exc.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    with lock:
+                        shed[0] += 1
+                    time.sleep(0.02)
+                else:
+                    _note(False, exc)
+                    time.sleep(0.05)  # no hot-spin against a dead socket
+            time.sleep(0.005)
+        call.close()
+
+    def prober() -> None:
+        call = _Caller(
+            "/risk.v1.RiskService/ScoreTransaction",
+            risk_pb2.ScoreTransactionRequest.SerializeToString,
+            risk_pb2.ScoreTransactionResponse.FromString)
+        i = 0
+        while time.perf_counter() < stop_box[0]:
+            try:
+                call(risk_pb2.ScoreTransactionRequest(
+                    account_id=f"probe-{i % 64}", amount=1000 + i,
+                    transaction_type="deposit"), timeout=10)
+                _note(True)
+            except grpc.RpcError as exc:
+                _note(False, exc)
+                time.sleep(0.05)  # no hot-spin against a dead socket
+            i += 1
+            time.sleep(0.01)
+        call.close()
+
+    threads = [threading.Thread(target=batch_worker) for _ in range(2)]
+    threads.append(threading.Thread(target=prober))
+    for t in threads:
+        t.start()
+    load_tail_s = max(3.0, duration_s - restart_at)
+
+    def _breaker(action: str) -> None:
+        req = urllib.request.Request(
+            f"http://{replica.http_addr}/debug/breakers",
+            data=json.dumps({"dep": "device", "action": action}).encode(),
+            method="POST")
+        urllib.request.urlopen(req, timeout=5).read()
+
+    def _sleep_until(offset_s: float) -> None:
+        time.sleep(max(0.0, t0 + offset_s - time.perf_counter()))
+
+    # Fault schedule (main thread).
+    _sleep_until(degrade_at)
+    _breaker("open")
+    _sleep_until(degrade_at + degrade_for)
+    _breaker("clear")
+    _sleep_until(sink_out_at)
+    with sink_lock:
+        sink_state["fail"] = True
+    _sleep_until(sink_out_at + sink_out_for)
+    with sink_lock:
+        sink_state["fail"] = False
+    _sleep_until(kill_at)
+    t_kill = time.perf_counter() - t0
+    replica.kill()
+    _sleep_until(restart_at)
+    replica.restart()  # same ports, same LEDGER_DIR: torn-tail recovery
+    t_restart_done = time.perf_counter() - t0
+    stop_box[0] = max(stop_box[0], time.perf_counter() + load_tail_s)
+
+    for t in threads:
+        t.join()
+    stop_at = stop_box[0]
+    # Let the sink drain fully (it is healthy again) before the graceful
+    # stop — /debug/ledgerz exposes the lag the runbook reads.
+    drain_deadline = time.monotonic() + 20.0
+    while time.monotonic() < drain_deadline:
+        try:
+            with urllib.request.urlopen(
+                    f"http://{replica.http_addr}/debug/ledgerz",
+                    timeout=3) as resp:
+                snap = json.loads(resp.read())
+            if snap["sink"]["lag"] == 0:
+                break
+        except Exception:  # noqa: BLE001 — sidecar gone: proceed to stop
+            break
+        time.sleep(0.25)
+    # Graceful stop: the server drains admitted RPCs, the ledger flushes
+    # its WAL and gives the (healthy again) sink a catch-up window.
+    replica.terminate()
+    sink_httpd.shutdown()
+
+    # -- replay the surviving WAL bit-exact ----------------------------------
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from igaming_platform_tpu.serve.ledger import iter_records
+    from tools.replay import replay_directory
+
+    wal_ids = [r.decision_id for r in iter_records(ledger_dir)]
+    verdict = replay_directory(ledger_dir, batch=rows)
+    sink_ids = {r["decision_id"] for r in sink_rows}
+    missing_from_sink = [i for i in wal_ids if i not in sink_ids]
+
+    # Errors OUTSIDE the kill outage window are unexplained — the ledger
+    # faults (fs outage, sink outage, degraded window) must never produce
+    # one. A short grace after restart covers client channel re-dial.
+    outage_lo, outage_hi = t0 + t_kill, t0 + t_restart_done + 3.0
+    errors_outside_outage = sum(
+        1 for (te, ok) in events if not ok and not (outage_lo <= te <= outage_hi))
+
+    availability = availability_block(events, t0, stop_at)
+    result = {
+        "metric": "ledger_chaos_soak",
+        "scenario": ("fs-outage + sink-outage + forced-degraded window + "
+                     "mid-run SIGKILL of the server process; replay the "
+                     "surviving WAL bit-exact"),
+        "duration_s": duration_s,
+        "rows_per_rpc": rows,
+        "chaos_plan": chaos_plan,
+        "degraded_window_s": [degrade_at, degrade_at + degrade_for],
+        "sink_outage_s": [sink_out_at, sink_out_at + sink_out_for],
+        "kill_at_s": round(t_kill, 3),
+        "restart_done_at_s": round(t_restart_done, 3),
+        "availability": availability,
+        "bulk_shed": shed[0],
+        "errors_total": len(errors),
+        "errors_outside_outage_window": errors_outside_outage,
+        "error_samples": errors[:5],
+        "wal_records": len(wal_ids),
+        "sink_rows": len(sink_rows),
+        "sink_inserts": sink_state["inserts"],
+        "sink_rejected_during_outage": sink_state["rejected"],
+        "sink_missing_records": len(missing_from_sink),
+        "ledger_dir": ledger_dir,
+        "replay": verdict,
+    }
+    gates = {
+        "replay_bit_exact": bool(verdict["ok"]),
+        "degraded_decisions_replayed": verdict["replayed_by_tier"].get(
+            "heuristic", 0) > 0,
+        "zero_scoring_errors_outside_kill_window": errors_outside_outage == 0,
+        "sink_delivery_complete": not missing_from_sink,
+        "recovered_after_kill": any(
+            ok for (te, ok) in events if te > t0 + t_restart_done),
+    }
+    result["gates"] = gates
+    out_path = os.environ.get("LEDGER_CHAOS_OUT", "REPLAY_r08.json")
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    print(json.dumps({"gates": gates}), file=sys.stderr, flush=True)
+    if not all(gates.values()):
+        sys.exit(1)
+
+
 if __name__ == "__main__":
-    if "--fleet-chaos" in sys.argv or os.environ.get("SOAK_FLEET_CHAOS") == "1":
+    if "--chaos-ledger" in sys.argv or os.environ.get("SOAK_CHAOS_LEDGER") == "1":
+        # The ledger soak provisions its own replica process (CPU rig).
+        main_ledger_chaos()
+    elif "--fleet-chaos" in sys.argv or os.environ.get("SOAK_FLEET_CHAOS") == "1":
         # The fleet soak provisions its own replica processes (CPU
         # control rig) — the responsive-device gate would only slow it.
         main_fleet_chaos()

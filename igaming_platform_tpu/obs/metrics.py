@@ -412,6 +412,39 @@ class ServiceMetrics:
             "loser is cancelled); both_failed = neither answered. Every "
             "launched hedge lands in exactly one terminal outcome",
         )
+        # Durable decision ledger (serve/ledger.py): WAL append health,
+        # fsync cadence cost, and the sink drain's backlog — the audit
+        # pipeline's dashboard.
+        self.ledger_records_total = self.registry.counter(
+            f"{service}_ledger_records_total",
+            "Decision records durably appended to the ledger WAL "
+            "(CRC-framed, batched fsync off the scoring hot path)",
+        )
+        self.ledger_dropped_total = self.registry.counter(
+            f"{service}_ledger_dropped_total",
+            "Decision records dropped by {reason}: queue_full = the "
+            "bounded append queue was full (scoring is never blocked), "
+            "write_error = the WAL write failed (fs outage; the ledger "
+            "breaker opens)",
+        )
+        self.ledger_fsync_ms = self.registry.histogram(
+            f"{service}_ledger_fsync_ms",
+            "Ledger WAL fsync latency (ms) — batched on a cadence "
+            "(LEDGER_FSYNC_MS) so durability cost never rides a "
+            "ScoreTransaction",
+            buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250),
+        )
+        self.ledger_sink_queue_depth = self.registry.gauge(
+            f"{service}_ledger_sink_queue_depth",
+            "Decision records durable in the WAL but not yet delivered "
+            "to the analytical sink (the drain's lag; grows through a "
+            "sink outage, shrinks as the cursor catches up from disk)",
+        )
+        self.ledger_sink_sent_total = self.registry.counter(
+            f"{service}_ledger_sink_sent_total",
+            "Decision records delivered to the ClickHouse/PG sink "
+            "(at-least-once: a cursor replay after SIGKILL may re-send)",
+        )
         self.spans_dropped_total = self.registry.counter(
             f"{service}_spans_dropped_total",
             "Host spans evicted from the bounded span ring before export "

@@ -275,6 +275,24 @@ def span(name: str, collector: SpanCollector | None = None, *,
 
 
 @contextlib.contextmanager
+def carry(parent: "Span | None"):
+    """Re-enter a span context on ANOTHER thread (worker pools): stage
+    spans opened inside nest under ``parent`` — same trace id, durations
+    landing in its root's stage accounting — exactly as if they ran on
+    the originating thread. The supervised engine's watchdog pool uses
+    this so a guarded wire batch keeps its RPC root (stage attribution
+    and the ledger's decision-id root attribute both depend on it)."""
+    if parent is None:
+        yield
+        return
+    token = _CURRENT.set(parent)
+    try:
+        yield
+    finally:
+        _CURRENT.reset(token)
+
+
+@contextlib.contextmanager
 def annotate(name: str):
     """Named region on the device profile timeline."""
     with jax.profiler.TraceAnnotation(name):

@@ -22,6 +22,12 @@ Seams (each a single ``chaos.fire(seam)`` call at the choke point):
   that forward, which must retry onto the next ring owner
 - ``router.health``     — the fleet health watcher's probe of a replica;
   ``drop``/``error`` make the replica look dead to the watcher
+- ``ledger.append``     — the decision ledger's WAL write (serve/ledger.py);
+  ``error`` is the fs-outage shape — scoring must proceed untouched while
+  drops are counted and the ``ledger`` breaker opens
+- ``ledger.sink``       — the ledger's sink drain push; ``error`` is the
+  sink-outage shape — the drainer falls behind and later catches up from
+  the WAL at its persisted cursor
 
 Fleet-level *process* faults — replica SIGKILL (pod death) and replica
 wedge (SIGSTOP, the process stops answering but the sockets stay open) —
@@ -75,6 +81,8 @@ SEAMS = (
     "amqp.publish",
     "router.forward",
     "router.health",
+    "ledger.append",
+    "ledger.sink",
 )
 
 _KINDS = ("delay", "wedge", "error", "drop")

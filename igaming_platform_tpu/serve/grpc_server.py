@@ -478,6 +478,10 @@ class RiskGrpcService:
         resp = self.engine.score(self._request_from_proto(request))
         self.metrics.score_distribution.observe(resp.score)
         self.metrics.txns_scored_total.inc()
+        if getattr(resp, "decision_id", ""):
+            # Join key across the observability surfaces: the flight
+            # entry, the trace root and the ledger record share this id.
+            tracing.set_root_attribute("decision_id", resp.decision_id)
         # p99-feedback for the bulk admission gate: the single-txn fast
         # lane's latency is the SLO the gate protects.
         self._bulk_gate.observe_single_ms(resp.response_time_ms)

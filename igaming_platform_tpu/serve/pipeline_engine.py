@@ -61,12 +61,14 @@ class _Job:
     """One wire batch moving through the pipeline (one RPC's rows)."""
 
     __slots__ = ("x", "bl", "include_features", "start", "parent", "total",
-                 "n_chunks", "parts", "rtms", "future", "done_chunks")
+                 "n_chunks", "parts", "rtms", "future", "done_chunks",
+                 "account_ids")
 
     def __init__(self, x: np.ndarray, bl: np.ndarray, include_features: bool,
-                 start: float, parent, n_chunks: int):
+                 start: float, parent, n_chunks: int, account_ids=None):
         self.x = x
         self.bl = bl
+        self.account_ids = account_ids
         self.include_features = include_features
         self.start = start
         self.parent = parent  # originating RPC span (cross-thread anchor)
@@ -216,7 +218,8 @@ class HostPipeline:
     # -- submission ----------------------------------------------------------
 
     def score_rows_to_wire(
-        self, x: np.ndarray, bl: np.ndarray, include_features: bool, start: float
+        self, x: np.ndarray, bl: np.ndarray, include_features: bool, start: float,
+        account_ids=None,
     ) -> bytes:
         """Gathered [N, 30] rows -> ScoreBatchResponse wire bytes via the
         stage workers. Blocks the caller until its batch completes; other
@@ -232,7 +235,7 @@ class HostPipeline:
         batch = self._engine.batch_size
         n_chunks = (total + batch - 1) // batch
         job = _Job(x, bl, include_features, start,
-                   tracing.current_span(), n_chunks)
+                   tracing.current_span(), n_chunks, account_ids=account_ids)
         self._job_enter()
         try:
             for idx, lo in enumerate(range(0, total, batch)):
@@ -261,6 +264,14 @@ class HostPipeline:
                         observer(cat["score"])
                     except Exception:  # noqa: BLE001 — metrics must not fail scoring
                         pass
+                # Ledger seam: the encode runs on the submitting (RPC
+                # handler) thread, so the note lands under the RPC span
+                # and stamps the decision-id prefix on its flight entry.
+                from igaming_platform_tpu.serve import ledger as ledger_mod
+
+                ledger_mod.note_decisions(
+                    self._engine, cat, n=job.total, wire_mode="wire_row",
+                    x=job.x, bl=job.bl, account_ids=job.account_ids)
                 return encode_score_batch(
                     cat["score"], cat["action"], cat["reason_mask"],
                     cat["rule_score"], cat["ml_score"], job.rtms,

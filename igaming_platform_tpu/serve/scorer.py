@@ -29,6 +29,7 @@ import numpy as np
 
 from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
 from igaming_platform_tpu.serve import chaos
+from igaming_platform_tpu.serve import ledger as ledger_mod
 from igaming_platform_tpu.core.enums import ReasonCode, action_from_code, decode_reason_mask
 from igaming_platform_tpu.core.features import F, NUM_FEATURES, FeatureVector
 from igaming_platform_tpu.models.ensemble import make_score_fn
@@ -67,6 +68,9 @@ class ScoreResponse:
     ml_score: float
     response_time_ms: float
     features: FeatureVector
+    # Ledger join key (serve/ledger.py): set when a decision ledger is
+    # bound — the same id lands on the WAL record and the flight entry.
+    decision_id: str = ""
 
 
 def _row_divisor(mesh, ml_backend: str) -> int:
@@ -147,6 +151,13 @@ class TPUScoringEngine:
         self.ml_backend = ml_backend
         self._params = params
         self._params_lock = threading.Lock()
+        # Decision ledger (serve/ledger.py): bound by the serving layer
+        # (RiskServer / harnesses); None keeps every note_decisions call
+        # a single attribute check. The params fingerprint is computed
+        # ONCE here (and on hot-swap) so records never hash on the hot
+        # path.
+        self.ledger = None
+        self.params_fingerprint = ledger_mod.params_fingerprint(params)
         self.features = feature_store or InMemoryFeatureStore()
         bcfg = batcher_config or BatcherConfig()
         self.batch_size = bcfg.batch_size
@@ -445,8 +456,10 @@ class TPUScoringEngine:
         params_host = (
             jax.device_put(params, self._host_cpu) if self._fn_host is not None else None
         )
+        fingerprint = ledger_mod.params_fingerprint(params)
         with self._params_lock:
             self._params = params
+            self.params_fingerprint = fingerprint
             if self._fn_host is not None:
                 self._params_host = params_host
 
@@ -648,6 +661,13 @@ class TPUScoringEngine:
                 self.score_observer(cat["score"])
             except Exception:  # noqa: BLE001 — metrics must not fail scoring
                 pass
+        # Ledger seam (index mode): the feature rows live in HBM and never
+        # materialize on the host, so records carry the per-txn context +
+        # outputs without a snapshot (replay marks them unreplayable).
+        ledger_mod.note_decisions(
+            self, cat, n=total, wire_mode="index", tier="device",
+            bl=bl, account_ids=account_ids, amounts=amounts32,
+            tx_codes=types32)
         return cat, rtms
 
     def score_columns_cached(
@@ -707,8 +727,28 @@ class TPUScoringEngine:
                 x, bl = self.features.gather_batch(chunk)
             with span("score.device", batch=len(chunk)), annotate("score_step"):
                 out, n = self._run_device(x, bl)
-            responses.extend(self._row_response(out, x, i) for i in range(n))
+            rows = [self._row_response(out, x, i) for i in range(n)]
+            self._note_decisions_requests(out, x, bl, chunk, rows, "batch")
+            responses.extend(rows)
         return responses
+
+    def _note_decisions_requests(self, out, x, bl, reqs, responses,
+                                 wire_mode: str) -> None:
+        """Ledger seam for the request-object paths (batcher / direct
+        batch): one columnar note per device batch, decision ids stamped
+        back onto the responses. No-op without a bound ledger."""
+        if self.ledger is None:
+            return
+        prefix = ledger_mod.note_decisions(
+            self, out, n=len(responses), wire_mode=wire_mode,
+            x=x, bl=bl,
+            account_ids=[r.account_id for r in reqs],
+            amounts=[r.amount for r in reqs],
+            tx_codes=[r.tx_type for r in reqs],
+        )
+        if prefix is not None:
+            for i, resp in enumerate(responses):
+                resp.decision_id = f"{prefix}.{i}"
 
     def _run_device(self, x: np.ndarray, bl: np.ndarray):
         out, n = self._launch_device(x, bl)
@@ -775,13 +815,15 @@ class TPUScoringEngine:
             x, bl = self.features.gather_batch(reqs)
         with span("score.dispatch", batch=len(reqs)), annotate("score_step"):
             out, n = self._launch_device(x, bl)
-        return out, x, n
+        return out, x, bl, n, reqs
 
     def _collect_requests(self, handle) -> list[ScoreResponse]:
-        out, x, n = handle
+        out, x, bl, n, reqs = handle
         with span("score.readback", batch=n):
             host = _unpack_host(_device_readback(out))
-        return [self._row_response(host, x, i) for i in range(n)]
+        rows = [self._row_response(host, x, i) for i in range(n)]
+        self._note_decisions_requests(host, x, bl, reqs, rows, "single")
+        return rows
 
     def _row_response(self, out: dict, x: np.ndarray, i: int) -> ScoreResponse:
         return ScoreResponse(
@@ -857,7 +899,8 @@ class TPUScoringEngine:
                     for i in range(total)
                 ]
                 x, bl = self.features.gather_batch(rows)
-        return self._score_rows_to_wire(x, bl, include_features, start)
+        return self._score_rows_to_wire(x, bl, include_features, start,
+                                        account_ids=account_ids)
 
     def score_batch_wire_bytes(
         self, payload: bytes, *, include_features: bool = True
@@ -880,20 +923,26 @@ class TPUScoringEngine:
         return self._score_rows_to_wire(x, bl, include_features, start), x.shape[0]
 
     def _score_rows_to_wire(
-        self, x: np.ndarray, bl: np.ndarray, include_features: bool, start: float
+        self, x: np.ndarray, bl: np.ndarray, include_features: bool, start: float,
+        account_ids=None,
     ) -> bytes:
         """Route a gathered [N, 30] batch to response wire bytes: through
         the staged host pipeline when enabled (stage workers overlap this
         RPC's chunks with other in-flight RPCs), else the lockstep
         chunked flow. Device outputs are bit-exact either way
-        (tests/test_host_pipeline.py)."""
+        (tests/test_host_pipeline.py). ``account_ids`` (when the caller
+        still has them — the columnar path) ride to the decision ledger;
+        the fully-native bytes path records snapshot + hash only."""
         pipe = self._ensure_pipeline()
         if pipe is not None:
-            return pipe.score_rows_to_wire(x, bl, include_features, start)
-        return self._score_rows_encode(x, bl, include_features, start)
+            return pipe.score_rows_to_wire(x, bl, include_features, start,
+                                           account_ids=account_ids)
+        return self._score_rows_encode(x, bl, include_features, start,
+                                       account_ids=account_ids)
 
     def _score_rows_encode(
-        self, x: np.ndarray, bl: np.ndarray, include_features: bool, start: float
+        self, x: np.ndarray, bl: np.ndarray, include_features: bool, start: float,
+        account_ids=None,
     ) -> bytes:
         """Pipelined chunked scoring straight to response wire bytes: chunk
         k's readback overlaps chunk k+1's device step, with at most
@@ -944,6 +993,9 @@ class TPUScoringEngine:
                         "score_observer failed; score histogram will be "
                         "empty for wire batches", exc_info=True,
                     )
+        ledger_mod.note_decisions(
+            self, cat, n=total, wire_mode="wire_row", x=x, bl=bl,
+            account_ids=account_ids)
         with span("score.encode", batch=total):
             return encode_score_batch(
                 cat["score"], cat["action"], cat["reason_mask"], cat["rule_score"],

@@ -252,6 +252,26 @@ class RiskServer:
                         amqp_breaker.record_failure(exc)
 
                 publisher.on_publish_result = _amqp_result
+        # Durable decision ledger (serve/ledger.py): LEDGER_DIR opts in.
+        # Records append to a local WAL with batched fsync off the hot
+        # path and drain to the configured sink (LEDGER_SINK); failures
+        # feed the supervisor's `ledger` breaker — never the scoring path.
+        self.ledger = None
+        ledger_dir = os.environ.get("LEDGER_DIR", "")
+        if ledger_dir:
+            from igaming_platform_tpu.serve import ledger as ledger_mod
+
+            breaker = (self.supervisor.breaker("ledger")
+                       if self.supervisor is not None else None)
+            self.ledger = ledger_mod.DecisionLedger(
+                ledger_dir, sink=ledger_mod.sink_from_env(),
+                breaker=breaker, metrics=self.metrics)
+            inner = getattr(self.engine, "inner", self.engine)
+            inner.ledger = self.ledger
+            if self.supervisor is not None:
+                ledger_mod.set_state_provider(lambda: self.supervisor.state)
+            logger.info("decision ledger at %s (sink=%s)", ledger_dir,
+                        os.environ.get("LEDGER_SINK", "none") or "none")
         self.http_server, self.http_port = self._start_http(
             http_port if http_port is not None else self.config.http_port
         )
@@ -411,6 +431,15 @@ class RiskServer:
                 elif self.path == "/debug/spans":
                     from igaming_platform_tpu.obs.tracing import DEFAULT_COLLECTOR
                     self._send(200, DEFAULT_COLLECTOR.to_json())
+                elif self.path == "/debug/ledgerz":
+                    # Decision-ledger health: WAL/fsync/drop counters and
+                    # the sink cursor (runbook: docs/operations.md
+                    # "Audit & replay").
+                    led = getattr(server_ref, "ledger", None)
+                    if led is None:
+                        self._send(404, '{"error":"ledger disabled"}')
+                        return
+                    self._send(200, json.dumps(led.stats()))
                 elif self.path == "/debug/flightz":
                     # Flight recorder: last N requests, each decomposed
                     # into stage durations with its trace id — the first
@@ -502,6 +531,11 @@ class RiskServer:
             self.batch_refresh.stop()
         self.bridge.stop()
         graceful_stop(self.grpc_server, self.health, grace, engine=self.engine)
+        if self.ledger is not None:
+            # After the gRPC drain: every admitted request has scored and
+            # enqueued its records; close() flushes the WAL and gives the
+            # sink a bounded catch-up window.
+            self.ledger.close()
         self.http_server.shutdown()
         if self.otlp is not None:
             self.otlp.stop()

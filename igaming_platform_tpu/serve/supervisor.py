@@ -277,7 +277,10 @@ class ServingSupervisor:
         self._metrics = None
         self.on_state_change = on_state_change
         self.breakers: dict[str, CircuitBreaker] = {}
-        for dep in (*self.SERVING_DEPS, "amqp", "degraded_tier"):
+        # `amqp` and `ledger` are non-serving dependencies: their outages
+        # never degrade the serving state — events queue and decisions
+        # drop-counted/spill respectively, scoring keeps answering.
+        for dep in (*self.SERVING_DEPS, "amqp", "ledger", "degraded_tier"):
             self.breakers[dep] = CircuitBreaker(
                 dep, failure_threshold=failure_threshold, open_s=open_s,
                 on_state_change=self._on_breaker_change)
@@ -490,6 +493,7 @@ class HeuristicScorer:
         return x, np.zeros((len(reqs),), dtype=bool)
 
     def score_requests(self, reqs: list) -> list:
+        from igaming_platform_tpu.serve import ledger as ledger_mod
         from igaming_platform_tpu.serve.scorer import ScoreResponse
 
         engine = self._engine_ref()
@@ -497,6 +501,19 @@ class HeuristicScorer:
         x, bl = self.gather(reqs)
         out = heuristic_scores(x, bl, engine._thresholds)
         elapsed_ms = (time.monotonic() - start) * 1000.0
+        # Degraded decisions are ledgered like any other — tier
+        # "heuristic" — so tools/replay.py can re-run them through the
+        # SAME conservative scorer and prove the degraded window's
+        # answers were defensible.
+        prefix = ledger_mod.note_decisions(
+            engine, out, n=len(reqs), wire_mode="single", tier="heuristic",
+            x=x, bl=bl,
+            account_ids=[r.account_id for r in reqs],
+            amounts=[r.amount for r in reqs],
+            tx_codes=[r.tx_type for r in reqs],
+            model_version=f"{getattr(engine, 'ml_backend', 'unknown')}"
+                          "+degraded-heuristic",
+        )
         responses = []
         for i in range(len(reqs)):
             responses.append(ScoreResponse(
@@ -508,6 +525,7 @@ class HeuristicScorer:
                 ml_score=float(out["ml_score"][i]),
                 response_time_ms=elapsed_ms,
                 features=FeatureVector.from_array(x[i]),
+                decision_id=f"{prefix}.{i}" if prefix else "",
             ))
         return responses
 
@@ -617,6 +635,8 @@ class SupervisedScoringEngine:
                 return "multihost", False
             if exc.seam.startswith("amqp"):
                 return "amqp", False
+            if exc.seam.startswith("ledger"):
+                return "ledger", False
             return "device", False
         return "device", False
 
@@ -664,6 +684,13 @@ class SupervisedScoringEngine:
 
         try:
             out = heuristic_scores(x, bl, self._inner._thresholds)
+            from igaming_platform_tpu.serve import ledger as ledger_mod
+
+            ledger_mod.note_decisions(
+                self._inner, out, n=int(x.shape[0]), wire_mode="wire_row",
+                tier="heuristic", x=np.asarray(x, np.float32), bl=bl,
+                model_version=f"{getattr(self._inner, 'ml_backend', 'unknown')}"
+                              "+degraded-heuristic")
             rtms = np.full((x.shape[0],),
                            int((time.monotonic() - start) * 1000.0), np.int64)
             payload = encode_score_batch(
@@ -683,8 +710,18 @@ class SupervisedScoringEngine:
     def _guard_batch(self, fn: Callable, *args, **kwargs):
         """Run a direct (non-batcher) scoring call under the watchdog
         deadline on the worker pool. A deadline overrun is the wedge
-        signal: fail the window loudly and rebuild."""
-        future = self._pool.submit(fn, *args, **kwargs)
+        signal: fail the window loudly and rebuild. The caller's span
+        context rides along (tracing.carry): without it, a supervised
+        engine's wire batches lose their RPC root — stage spans detach
+        from /debug/flightz and the ledger's decision-id join key never
+        lands on the flight entry."""
+        parent = tracing.current_span()
+
+        def run():
+            with tracing.carry(parent):
+                return fn(*args, **kwargs)
+
+        future = self._pool.submit(run)
         try:
             return future.result(timeout=self._watchdog_s)
         except (FutureTimeout, TimeoutError) as exc:
@@ -872,6 +909,9 @@ class SupervisedScoringEngine:
         """Re-apply the serving layer's hooks to the rebuilt engine (the
         gRPC service bound them to the old one at construction)."""
         new.score_observer = getattr(old, "score_observer", None)
+        # The decision ledger survives a rebuild: the WAL must not lose
+        # the decisions of a freshly-healed engine.
+        new.ledger = getattr(old, "ledger", None)
         old_b = getattr(old, "_batcher", None)
         new_b = getattr(new, "_batcher", None)
         if old_b is not None and new_b is not None:

@@ -1,0 +1,63 @@
+"""Seeded CC06 violations: wall-clock / unseeded-RNG reads in a
+replay-path module outside the injected clock seam (compliant seam
+functions and monotonic timers below must stay quiet).
+
+# analysis: replay-path
+"""
+
+import random
+import time
+import uuid
+from datetime import datetime
+
+import numpy as np
+
+
+def record_clock() -> float:  # analysis: clock-seam
+    """The injected seam: the ONLY place wall time may be read."""
+    return time.time()
+
+
+def fresh_token() -> str:  # analysis: clock-seam
+    return uuid.uuid4().hex[:8]
+
+
+def bad_build_record(score: int) -> dict:
+    return {
+        "score": score,
+        "ts": time.time(),  # expect: CC06
+        "decision_id": uuid.uuid4().hex,  # expect: CC06
+    }
+
+
+def bad_wall_clock_variants() -> tuple:
+    a = datetime.now()  # expect: CC06
+    b = time.localtime()  # expect: CC06
+    return a, b
+
+
+def bad_unseeded_rng() -> float:
+    jitterless = random.random()  # expect: CC06
+    noise = np.random.normal()  # expect: CC06
+    rng = np.random.default_rng()  # expect: CC06
+    return jitterless + noise + float(rng.random())
+
+
+def good_seam_and_derived(record: dict) -> dict:
+    # Wall time through the seam; ids derived from recorded values;
+    # monotonic timers measure work without landing in the record.
+    t0 = time.monotonic()
+    out = {
+        "ts": record_clock(),
+        "decision_id": fresh_token(),
+        "replayed_from": record["decision_id"],
+        "elapsed_s": time.monotonic() - t0,
+        "wall": time.perf_counter(),
+    }
+    return out
+
+
+def good_seeded_rng(seed: int) -> float:
+    rng = np.random.default_rng(seed)
+    jig = random.Random(seed)
+    return float(rng.random()) + jig.random()

@@ -1,0 +1,128 @@
+"""CC06 — replay determinism in decision-record / replay modules.
+
+The decision ledger's contract (serve/ledger.py, tools/replay.py) is
+that ``tools/replay.py`` reproduces every logged decision BIT-EXACT from
+recorded values. That only holds if nondeterminism — wall-clock reads
+and unseeded RNG — enters a record exclusively through the injected
+clock seam: functions whose ``def`` line carries an
+``# analysis: clock-seam`` marker. A stray ``time.time()`` in record
+construction, or a ``uuid.uuid4()`` in the replay path, silently makes
+two replays of the same ledger disagree.
+
+Scope: files that declare themselves replay-path modules with an
+``# analysis: replay-path`` marker line (the ledger and the replay tool
+carry it; the fixture corpus seeds both violating and compliant
+shapes). Monotonic clocks (``time.monotonic`` / ``perf_counter``) stay
+allowed — they time work, they never land in a record.
+
+Flagged calls:
+
+- wall clock: ``time.time``, ``time.localtime``, ``time.ctime``,
+  ``datetime.now`` / ``datetime.utcnow`` / ``date.today``;
+- unseeded RNG: module-level ``random.*`` draws (the global, unseeded
+  generator), ``np.random.*`` legacy globals, ``uuid.uuid1``/``uuid4``,
+  and ``default_rng()`` with no seed argument.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.analysis.engine import FileContext, dotted_name, rule
+
+_FILE_MARKER = re.compile(r"#\s*analysis:\s*replay-path")
+_SEAM_MARKER = re.compile(r"#\s*analysis:\s*clock-seam")
+
+# Dotted suffixes that read the wall clock.
+_WALL_CLOCK = {
+    "time.time", "time.time_ns", "time.localtime", "time.gmtime",
+    "time.ctime", "datetime.now", "datetime.utcnow", "datetime.today",
+    "date.today",
+}
+
+# Module-level unseeded RNG draws (the shared global generator) and
+# random identity sources.
+_GLOBAL_RNG_PREFIXES = ("random.", "np.random.", "numpy.random.")
+_RNG_EXACT = {"uuid.uuid1", "uuid.uuid4"}
+# random.Random(seed)/default_rng(seed) are fine — they're seeded
+# constructions; only the no-arg forms are nondeterministic.
+_SEEDABLE_CTORS = {"Random", "default_rng"}
+
+
+def _seam_lines(ctx: FileContext) -> set[int]:
+    out = set()
+    for lineno, line in enumerate(ctx.src.splitlines(), start=1):
+        if _SEAM_MARKER.search(line):
+            out.add(lineno)
+    return out
+
+
+def _exempt_ranges(ctx: FileContext, seam_lines: set[int]):
+    """(start, end) line ranges of functions marked as the clock seam —
+    the marker sits on the ``def`` line (or a decorator line)."""
+    ranges = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        marker_lines = {node.lineno} | {
+            d.lineno for d in node.decorator_list}
+        if marker_lines & seam_lines:
+            ranges.append((node.lineno, node.end_lineno or node.lineno))
+    return ranges
+
+
+def _flagged(call: ast.Call) -> str | None:
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    # Rightmost two segments are what matter: obs.tracing wraps nothing
+    # here, but `datetime.datetime.now` must match `datetime.now`.
+    tail2 = ".".join(name.split(".")[-2:])
+    if tail2 in _WALL_CLOCK or name in _WALL_CLOCK:
+        return f"wall-clock read `{name}()`"
+    if tail2 in _RNG_EXACT or name in _RNG_EXACT:
+        return f"random identity source `{name}()`"
+    leaf = name.split(".")[-1]
+    for prefix in _GLOBAL_RNG_PREFIXES:
+        if name.startswith(prefix):
+            if leaf in _SEEDABLE_CTORS and call.args:
+                return None  # seeded construction — deterministic
+            if leaf == "seed":
+                return None  # seeding the global generator is the fix
+            return f"unseeded global RNG draw `{name}()`"
+    if leaf == "default_rng" and not call.args:
+        return f"unseeded generator `{name}()`"
+    return None
+
+
+@rule("CC06", "replay-determinism",
+      "A replay-path module (marked `# analysis: replay-path` — the "
+      "decision ledger and tools/replay.py) read the wall clock or drew "
+      "from an unseeded RNG outside the injected clock seam "
+      "(`# analysis: clock-seam` functions). Bit-exact replay of a "
+      "DecisionRecord only holds when every nondeterminism source is "
+      "confined to the seam; route the value through it, derive it from "
+      "recorded fields, or mark a genuine seam function. Monotonic "
+      "timers (time.monotonic/perf_counter) are allowed — they measure, "
+      "they never land in a record.",
+      scope="file")
+def replay_determinism(ctx: FileContext):
+    if not _FILE_MARKER.search(ctx.src):
+        return
+    exempt = _exempt_ranges(ctx, _seam_lines(ctx))
+
+    def exempted(lineno: int) -> bool:
+        return any(start <= lineno <= end for start, end in exempt)
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        problem = _flagged(node)
+        if problem is None or exempted(node.lineno):
+            continue
+        yield node.lineno, (
+            f"{problem} in a replay-path module outside the injected "
+            "clock seam — nondeterminism here breaks bit-exact "
+            "DecisionRecord replay; confine it to an "
+            "`# analysis: clock-seam` function")

@@ -1,0 +1,325 @@
+"""Deterministic decision replay — re-score the ledger bit-exact.
+
+# analysis: replay-path
+
+``python -m tools.replay --dir <LEDGER_DIR>`` reads every
+:class:`DecisionRecord` from a decision-ledger WAL (serve/ledger.py),
+rebuilds the pinned scoring stack, re-scores each record from its
+feature snapshot, and diffs the outputs BIT-EXACT — score, action,
+reason mask, rule score, and the ml score's IEEE-754 bits. Decisions
+taken in the DEGRADED_CPU_HEURISTIC tier replay through the SAME
+conservative scorer (serve/supervisor.heuristic_scores), so a chaos
+window's answers are provable, not just available. The verdict lands in
+a ``REPLAY_r08.json``-shaped artifact.
+
+Pinned checkpoint: by default the repo's seeded convention (multitask
+params from ``jax.random.key(0)``, the same init every serving harness
+and fleet replica resolves); ``--checkpoint`` restores an Orbax
+checkpoint instead. Either way the replay params' fingerprint must match
+the fingerprint recorded on each device/host-tier decision — a mismatch
+is counted and fails the verdict, never silently re-scored against the
+wrong model.
+
+``--verify`` is the self-contained smoke (``make replay-verify``): score
+a seeded batch under a CHAOS_PLAN (ledger-append faults included), then
+replay the resulting ledger and require zero mismatches.
+
+This is a replay-path module: analyzer rule CC06 bans wall-clock reads
+and unseeded RNG here — replay derives everything from recorded values.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+_COMPARE_FIELDS = ("score", "action", "reason_mask", "rule_score",
+                   "ml_score_bits")
+
+
+def _resolve_params(backend: str, checkpoint: str | None):
+    """The pinned checkpoint: an explicit Orbax path, else the repo's
+    seeded init convention for the backend."""
+    if checkpoint:
+        from igaming_platform_tpu.train.checkpoint import (
+            restore_params_for_serving,
+        )
+
+        return {"multitask": restore_params_for_serving(checkpoint)}
+    if backend == "multitask":
+        import jax
+
+        from igaming_platform_tpu.models.multitask import init_multitask
+
+        return {"multitask": jax.device_get(init_multitask(jax.random.key(0)))}
+    return None
+
+
+class _EngineCache:
+    """One warmed engine per (backend, batch) — replay groups share it."""
+
+    def __init__(self, batch: int, checkpoint: str | None):
+        self.batch = batch
+        self.checkpoint = checkpoint
+        self._engines: dict[str, object] = {}
+
+    def get(self, backend: str):
+        eng = self._engines.get(backend)
+        if eng is None:
+            from igaming_platform_tpu.core.config import (
+                BatcherConfig,
+                ScoringConfig,
+            )
+            from igaming_platform_tpu.serve.scorer import TPUScoringEngine
+
+            eng = TPUScoringEngine(
+                ScoringConfig(),
+                ml_backend=backend,
+                params=_resolve_params(backend, self.checkpoint),
+                batcher_config=BatcherConfig(batch_size=self.batch,
+                                             max_wait_ms=1.0),
+            )
+            self._engines[backend] = eng
+        return eng
+
+    def close(self) -> None:
+        for eng in self._engines.values():
+            eng.close()
+
+
+def _replay_compiled(engine, records) -> list[dict]:
+    """Re-score feature-snapshot records through the engine's compiled
+    step (same ladder padding, one packed readback per chunk); returns
+    the recomputed field dict per record."""
+    import jax
+
+    from igaming_platform_tpu.serve.scorer import _unpack_host
+
+    out_rows: list[dict] = []
+    for lo in range(0, len(records), engine.batch_size):
+        chunk = records[lo:lo + engine.batch_size]
+        x = np.stack([r.features for r in chunk]).astype(np.float32)
+        bl = np.array([r.blacklisted for r in chunk], dtype=bool)
+        out, n = engine.launch_packed(x, bl)
+        host = _unpack_host(jax.device_get(out))
+        bits = np.ascontiguousarray(host["ml_score"], np.float32).view(np.uint32)
+        for i in range(n):
+            out_rows.append({
+                "score": int(host["score"][i]),
+                "action": int(host["action"][i]),
+                "reason_mask": int(host["reason_mask"][i]),
+                "rule_score": int(host["rule_score"][i]),
+                "ml_score_bits": int(bits[i]),
+            })
+    return out_rows
+
+
+def _replay_heuristic(records, thresholds) -> list[dict]:
+    from igaming_platform_tpu.serve.supervisor import heuristic_scores
+
+    x = np.stack([r.features for r in records]).astype(np.float32)
+    bl = np.array([r.blacklisted for r in records], dtype=bool)
+    out = heuristic_scores(x, bl, np.asarray(thresholds, np.int32))
+    bits = np.ascontiguousarray(out["ml_score"], np.float32).view(np.uint32)
+    return [{
+        "score": int(out["score"][i]),
+        "action": int(out["action"][i]),
+        "reason_mask": int(out["reason_mask"][i]),
+        "rule_score": int(out["rule_score"][i]),
+        "ml_score_bits": int(bits[i]),
+    } for i in range(len(records))]
+
+
+def _recorded_fields(r) -> dict:
+    return {
+        "score": r.score,
+        "action": r.action,
+        "reason_mask": r.reason_mask,
+        "rule_score": r.rule_score,
+        "ml_score_bits": r.ml_score_bits,
+    }
+
+
+def replay_directory(directory: str, *, batch: int = 256,
+                     checkpoint: str | None = None,
+                     max_mismatch_samples: int = 10) -> dict:
+    """Replay every record in a ledger directory; returns the verdict
+    artifact dict (``ok`` iff zero mismatches AND zero params-fingerprint
+    mismatches; index-mode records without a snapshot are counted as
+    skipped, never as passes)."""
+    from igaming_platform_tpu.serve import ledger as ledger_mod
+
+    records = list(ledger_mod.iter_records(directory))
+    groups: dict[tuple, list] = {}
+    skipped_no_snapshot = 0
+    for r in records:
+        if r.features is None:
+            skipped_no_snapshot += 1
+            continue
+        backend = r.model_version.split("+", 1)[0]
+        tier_class = "heuristic" if r.tier == "heuristic" else "compiled"
+        key = (tier_class, backend, r.block_threshold, r.review_threshold,
+               r.params_fp)
+        groups.setdefault(key, []).append(r)
+
+    engines = _EngineCache(batch, checkpoint)
+    mismatches: list[dict] = []
+    params_mismatch = 0
+    replayed_by_tier: dict[str, int] = {}
+    try:
+        for (tier_class, backend, block, review, fp), recs in sorted(
+                groups.items()):
+            if tier_class == "heuristic":
+                recomputed = _replay_heuristic(recs, (block, review))
+            else:
+                engine = engines.get(backend)
+                if fp != engine.params_fingerprint:
+                    params_mismatch += len(recs)
+                    continue
+                engine.set_thresholds(block, review)
+                recomputed = _replay_compiled(engine, recs)
+            for rec, redo in zip(recs, recomputed):
+                replayed_by_tier[rec.tier] = replayed_by_tier.get(rec.tier, 0) + 1
+                was = _recorded_fields(rec)
+                if was != redo and len(mismatches) < max_mismatch_samples:
+                    mismatches.append({
+                        "decision_id": rec.decision_id,
+                        "account_id": rec.account_id,
+                        "tier": rec.tier,
+                        "recorded": was,
+                        "recomputed": redo,
+                    })
+                elif was != redo:
+                    mismatches.append({"decision_id": rec.decision_id})
+    finally:
+        engines.close()
+
+    replayed = sum(replayed_by_tier.values())
+    return {
+        "metric": "decision_replay_bit_exact",
+        "ledger_dir": directory,
+        "records_total": len(records),
+        "replayed": replayed,
+        "replayed_by_tier": replayed_by_tier,
+        "skipped_no_snapshot": skipped_no_snapshot,
+        "params_fingerprint_mismatch": params_mismatch,
+        "fields_compared": list(_COMPARE_FIELDS),
+        "mismatches": len(mismatches),
+        "mismatch_samples": mismatches[:max_mismatch_samples],
+        "ok": (not mismatches and params_mismatch == 0 and replayed > 0),
+    }
+
+
+# ---------------------------------------------------------------------------
+# --verify: the self-contained smoke (make replay-verify)
+
+
+def run_verify(ledger_dir: str | None = None, *, rows: int = 96,
+               batch: int = 64, chaos_plan: str | None = None) -> dict:
+    """Score a seeded batch — device path, batcher path, and a forced
+    degraded (heuristic) window — under a chaos plan with ledger-append
+    faults, then replay the ledger and diff bit-exact."""
+    import tempfile
+
+    from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
+    from igaming_platform_tpu.serve import chaos as chaos_mod
+    from igaming_platform_tpu.serve import ledger as ledger_mod
+    from igaming_platform_tpu.serve.scorer import ScoreRequest, TPUScoringEngine
+    from igaming_platform_tpu.serve.supervisor import (
+        ServingSupervisor,
+        SupervisedScoringEngine,
+    )
+
+    directory = ledger_dir or tempfile.mkdtemp(prefix="ledger-verify-")
+    plan_str = chaos_plan or os.environ.get(
+        "CHAOS_PLAN", "seed=5;ledger.append=delay:p=0.4:ms=1")
+    plan = chaos_mod.install(plan_str)
+
+    sup = ServingSupervisor(failure_threshold=2, open_s=0.5)
+
+    def factory():
+        return TPUScoringEngine(
+            ScoringConfig(), ml_backend="mock",
+            batcher_config=BatcherConfig(batch_size=batch, max_wait_ms=1.0))
+
+    engine = SupervisedScoringEngine(factory, supervisor=sup)
+    ledger = ledger_mod.DecisionLedger(
+        directory, breaker=sup.breaker("ledger"))
+    engine.inner.ledger = ledger
+    ledger_mod.set_state_provider(lambda: sup.state)
+    try:
+        from igaming_platform_tpu.serve.feature_store import TransactionEvent
+
+        for i in range(64):
+            engine.update_features(TransactionEvent(
+                account_id=f"rv-{i % 32}", amount=500 + 37 * i,
+                tx_type=("deposit", "bet", "withdraw")[i % 3],
+                ip=f"10.9.{i % 20}.{i % 25}", device_id=f"dev-{i % 8}"))
+        reqs = [ScoreRequest(f"rv-{i % 32}", amount=900 + 131 * i,
+                             tx_type=("deposit", "bet", "withdraw")[i % 3])
+                for i in range(rows)]
+        # Device path (direct batch) + the batcher path.
+        engine.score_batch(reqs)
+        for i in range(8):
+            engine.score(reqs[i])
+        # Forced degraded window: the heuristic tier's decisions must be
+        # ledgered and replayable too.
+        sup.breaker("device").force_open("replay-verify degraded window")
+        engine.score_batch(reqs[:rows // 2])
+        sup.breaker("device").reset()
+    finally:
+        ledger.close()
+        chaos_mod.clear()
+        ledger_mod.set_state_provider(None)
+        engine.close()
+
+    verdict = replay_directory(directory, batch=batch)
+    verdict["scenario"] = "replay-verify smoke"
+    verdict["chaos_plan"] = plan.snapshot()
+    verdict["ledger_stats_note"] = (
+        "append-fault drops are counted by the ledger, not replayed — "
+        "replay covers every record that reached the WAL")
+    verdict["degraded_records_replayed"] = verdict["replayed_by_tier"].get(
+        "heuristic", 0)
+    verdict["ok"] = bool(
+        verdict["ok"] and verdict["degraded_records_replayed"] > 0)
+    return verdict
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Re-score a decision ledger bit-exact")
+    parser.add_argument("--dir", help="ledger directory (WAL segments)")
+    parser.add_argument("--out", help="write the verdict artifact here")
+    parser.add_argument("--batch", type=int, default=256,
+                        help="replay engine batch size")
+    parser.add_argument("--checkpoint",
+                        help="pinned Orbax checkpoint (default: the seeded "
+                             "init convention)")
+    parser.add_argument("--verify", action="store_true",
+                        help="self-contained smoke: score under CHAOS_PLAN, "
+                             "replay, diff")
+    args = parser.parse_args(argv)
+
+    if args.verify:
+        verdict = run_verify()
+    elif args.dir:
+        verdict = replay_directory(args.dir, batch=args.batch,
+                                   checkpoint=args.checkpoint)
+    else:
+        parser.error("need --dir or --verify")
+    print(json.dumps(verdict))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(verdict, f, indent=1)
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
