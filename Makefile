@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-fast lint lint-json lint-update-baseline bench bench-all bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos soak-chaos-ledger replay-verify fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
+.PHONY: all test test-fast lint lint-json lint-update-baseline bench bench-all bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos soak-chaos-ledger soak-slo replay-verify fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
 
 all: native test
 
@@ -68,6 +68,13 @@ soak-fleet-chaos:
 # surviving decision WAL -> REPLAY_r08.json (LEDGER_CHAOS_DURATION_S).
 soak-chaos-ledger:
 	$(PY) benchmarks/soak.py --chaos-ledger
+
+# SLO-plane chaos: fleet rig with a device.dispatch latency fault on one
+# replica (burn-rate alert + budget attribution + one auto profile) and
+# a SIGKILL on another (/debug/fleetz stays live, stale-stamped), plus
+# the observability-overhead A/B -> SLO_r09.json (SLO_SOAK_DURATION_S).
+soak-slo:
+	$(PY) benchmarks/soak.py --slo-chaos
 
 # Bit-exact decision replay smoke (tier-1-adjacent): score a seeded
 # batch under CHAOS_PLAN (ledger-append faults), replay the ledger with

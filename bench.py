@@ -189,6 +189,14 @@ def e2e_numbers() -> dict:
             result["host_stage_overlap_ratio"] = stats["overlap_ratio"]
             result["e2e_stage_overlap_ratio_p50"] = breakdown.get(
                 "stage_overlap_ratio_p50")
+        # SLO block (obs/slo.py): attainment against the p99<50ms
+        # objective, burn rates, and the top budget-eating stage — the
+        # arm-level summary the admission-scheduler work will optimize.
+        from igaming_platform_tpu.obs import slo as slo_mod
+
+        slo_engine = slo_mod.get_default()
+        if slo_engine is not None:
+            result["slo_block"] = slo_engine.summary_block()
         return result
     finally:
         shutdown()
@@ -254,6 +262,58 @@ def ledger_ab_numbers() -> dict:
     }
 
 
+def observability_ab_numbers() -> dict:
+    """Observability-overhead A/B: the SLO engine + device-runtime
+    telemetry promise O(1)-per-request accounting off the hot path — two
+    short identical wire runs, one with both planes disabled (SLO=0,
+    RUNTIME_TELEMETRY=0) and one with them on, must land within noise.
+    BENCH_OBS_AB_S sizes the arms (0 disables)."""
+    from benchmarks.load_gen import run_grpc_load, start_inprocess_server
+
+    from igaming_platform_tpu.obs import slo as slo_mod
+
+    duration_s = float(os.environ.get("BENCH_OBS_AB_S", 4.0))
+    if duration_s <= 0:
+        return {}
+    rows = int(os.environ.get("BENCH_E2E_ROWS_PER_RPC", 8192))
+    batch = int(os.environ.get("BENCH_E2E_BATCH", 8192))
+    arms = {}
+    slo_block = None
+    overrides = {"off": {"SLO": "0", "RUNTIME_TELEMETRY": "0"},
+                 "on": {"SLO": "1", "RUNTIME_TELEMETRY": "1"}}
+    saved = {k: os.environ.get(k) for k in ("SLO", "RUNTIME_TELEMETRY")}
+    try:
+        for arm in ("off", "on"):
+            os.environ.update(overrides[arm])
+            addr, shutdown, _engine = start_inprocess_server(batch_size=batch)
+            try:
+                load = run_grpc_load(addr, duration_s=duration_s,
+                                     rows_per_rpc=rows, concurrency=4)
+                arms[arm] = load["value"]
+                if arm == "on" and slo_mod.get_default() is not None:
+                    slo_block = slo_mod.get_default().summary_block()
+            finally:
+                shutdown()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    ratio = arms["on"] / arms["off"] if arms.get("off") else None
+    # Same honesty contract as the ledger A/B: on a 1-core control rig
+    # run-to-run noise dominates; on real cores the planes must be free.
+    bar = 0.85 if (os.cpu_count() or 1) >= 2 else 0.5
+    return {
+        "obs_off_txns_per_sec": arms.get("off"),
+        "obs_on_txns_per_sec": arms.get("on"),
+        "obs_overhead_ratio": round(ratio, 4) if ratio else None,
+        "obs_overhead_within_noise": bool(ratio and ratio >= bar),
+        "obs_overhead_bar": bar,
+        "obs_on_slo_block": slo_block,
+    }
+
+
 def main() -> None:
     _ensure_responsive_device()
     from igaming_platform_tpu.core.devices import enable_persistent_compile_cache
@@ -272,6 +332,10 @@ def main() -> None:
             result.update(ledger_ab_numbers())
         except Exception as exc:  # noqa: BLE001 — the A/B arm must not lose the headline
             result["ledger_ab_error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            result.update(observability_ab_numbers())
+        except Exception as exc:  # noqa: BLE001 — the A/B arm must not lose the headline
+            result["obs_ab_error"] = f"{type(exc).__name__}: {exc}"
         headline = float(result["e2e_txns_per_sec"])
         result.update({
             "metric": "e2e_grpc_fraud_score_txns_per_sec",

@@ -198,10 +198,17 @@ class ReplicaProc:
 
 class ReplicaFleet:
     """K replica processes booted concurrently (JAX init dominates boot;
-    serial boots would triple the rig's setup time)."""
+    serial boots would triple the rig's setup time).
 
-    def __init__(self, k: int, **kwargs):
+    ``env_by_replica`` layers per-replica env on top of the shared
+    ``env_extra`` — how a chaos soak gives ONE replica a CHAOS_PLAN
+    (the latency-fault victim) while the rest stay clean."""
+
+    def __init__(self, k: int, *, env_by_replica: dict[int, dict] | None = None,
+                 **kwargs):
         self.replicas = [ReplicaProc(f"r{i}", **kwargs) for i in range(k)]
+        for idx, extra in (env_by_replica or {}).items():
+            self.replicas[idx].env_extra.update(extra)
 
     def start(self) -> "ReplicaFleet":
         errors: list[str] = []

@@ -606,6 +606,13 @@ def main() -> None:
             # p99, spill episodes, sink-queue high-water (serve/ledger.py).
             ledger.flush(5.0)
             load["ledger_block"] = ledger.stats_block()
+        if engine is not None:
+            # SLO summary for the in-process arm (obs/slo.py): attainment,
+            # burn rates, top budget-eating stage.
+            from igaming_platform_tpu.obs import slo as slo_mod
+
+            if slo_mod.get_default() is not None:
+                load["slo_block"] = slo_mod.get_default().summary_block()
         print(json.dumps(load), flush=True)
         probe = run_single_txn_probe(addr)
         print(json.dumps(probe), flush=True)

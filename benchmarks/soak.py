@@ -751,6 +751,305 @@ def main_fleet_chaos() -> None:
         sys.exit(1)
 
 
+def main_slo_chaos() -> None:
+    """SLO-plane chaos soak (``--slo-chaos``) -> SLO_r09.json: proves the
+    fleet-wide SLO plane detects, attributes and profiles a latency
+    fault, and stays live through replica death. The rig:
+
+    - K replicas (benchmarks/fleet.py, full production RiskServer each)
+      behind the L7 router with the fleet aggregation plane
+      (``/debug/fleetz``) on the router's sidecar;
+    - replica r<victim> boots with a deterministic CHAOS_PLAN delaying
+      ``device.dispatch`` (the latency fault — answers stay correct,
+      they just blow the 50 ms objective);
+    - replica r<casualty> is SIGKILLed mid-run (the liveness fault).
+
+    Gates (exit 1 on miss):
+    1. the victim's FAST-window burn-rate alert fires within one fast
+       window of its first recorded violation;
+    2. budget attribution names the injected stage (``score.dispatch``)
+       as the top consumer;
+    3. the anomaly detector triggers EXACTLY ONE cooldown-respecting
+       profile capture, keyed by the anomalous trace id;
+    4. ``/debug/fleetz`` answers fast (bounded, stale-stamped) through
+       the SIGKILL — never blocks on the dead replica;
+    5. the observability-overhead A/B (slo+telemetry on vs off) lands
+       within noise.
+    """
+    import urllib.request
+
+    import grpc
+
+    from igaming_platform_tpu.proto_gen.risk.v1 import risk_pb2
+    from fleet import ReplicaFleet
+
+    from igaming_platform_tpu.serve.router import ScoringRouter, serve_router
+
+    n_replicas = int(os.environ.get("SLO_REPLICAS", "3"))
+    duration_s = float(os.environ.get("SLO_SOAK_DURATION_S", "40"))
+    kill_at = float(os.environ.get("SLO_KILL_AT_S", 0.65 * duration_s))
+    rows = int(os.environ.get("SLO_ROWS_PER_RPC", "256"))
+    victim = int(os.environ.get("SLO_VICTIM", "1"))
+    casualty = int(os.environ.get("SLO_CASUALTY", "2"))
+    delay_ms = int(os.environ.get("SLO_FAULT_DELAY_MS", "150"))
+    fault_after_ops = int(os.environ.get("SLO_FAULT_AFTER_OPS", "600"))
+    fast_window_s = float(os.environ.get("SLO_FAST_WINDOW_S", "8"))
+
+    # Shared SLO/telemetry env: short fast window so the alert clock fits
+    # a 40 s soak; long anomaly cooldown so gate 3 is exactly-one; the
+    # victim additionally carries the dispatch-delay chaos plan.
+    slo_env = {
+        "SLO_FAST_WINDOW_S": str(fast_window_s),
+        "SLO_SLOW_WINDOW_S": "120",
+        "SLO_FAST_BURN_ALERT": "10",
+        "SLO_SLOW_BURN_ALERT": "1",
+        "ANOMALY_PROFILE_COOLDOWN_S": "600",
+        "ANOMALY_PROFILE_SECONDS": "0.5",
+        "ANOMALY_WARMUP_STEPS": "20",
+    }
+    victim_env = {
+        "CHAOS_PLAN": (
+            f"seed=9;device.dispatch=delay:p=1.0:ms={delay_ms}"
+            f":after={fault_after_ops}:count=1000000"),
+    }
+    fleet = ReplicaFleet(
+        n_replicas, batch_size=rows, env_extra=slo_env,
+        env_by_replica={victim: victim_env}).start()
+    victim_http = fleet.replicas[victim].http_addr
+    casualty_rid = fleet.replicas[casualty].rid
+    result: dict = {
+        "metric": "slo_chaos_soak",
+        "scenario": (
+            f"device.dispatch delay ({delay_ms} ms) on one replica must "
+            "fire the fast-window burn alert, attribute the budget to "
+            "score.dispatch and auto-capture exactly one profile; "
+            "/debug/fleetz must stay live through a second replica's "
+            "SIGKILL"),
+        "replicas": n_replicas,
+        "host_cpu_cores": os.cpu_count() or 1,
+        "objective_ms": 50.0,
+        "fast_window_s": fast_window_s,
+        "fault_delay_ms": delay_ms,
+    }
+    router = None
+    server = None
+    try:
+        router = ScoringRouter(
+            fleet.router_spec(), health_interval_s=0.2,
+            failure_threshold=2, forward_timeout_s=20.0)
+        server, health, port = serve_router(router, 0, http_port=0)
+        addr = f"localhost:{port}"
+        fleetz_addr = f"localhost:{router.http_port}"
+
+        t0 = time.perf_counter()
+        stop_at = t0 + duration_s
+        lock = threading.Lock()
+        errors: list[str] = []
+        ok_count = [0]
+
+        load_payload = risk_pb2.ScoreBatchRequest(transactions=[
+            risk_pb2.ScoreTransactionRequest(
+                account_id=f"slo-{i % 256}", amount=1000 + i,
+                transaction_type=("deposit", "bet", "withdraw")[i % 3])
+            for i in range(rows)
+        ]).SerializeToString()
+
+        def batch_worker() -> None:
+            ch = grpc.insecure_channel(addr)
+            call = ch.unary_unary(
+                "/risk.v1.RiskService/ScoreBatch",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b)
+            while time.perf_counter() < stop_at:
+                try:
+                    call(load_payload, timeout=20)
+                    with lock:
+                        ok_count[0] += 1
+                except grpc.RpcError as exc:
+                    with lock:
+                        errors.append(f"{exc.code().name}: "
+                                      + repr(exc.details())[:120])
+            ch.close()
+
+        def prober() -> None:
+            ch = grpc.insecure_channel(addr)
+            call = ch.unary_unary(
+                "/risk.v1.RiskService/ScoreTransaction",
+                request_serializer=risk_pb2.ScoreTransactionRequest.SerializeToString,
+                response_deserializer=risk_pb2.ScoreTransactionResponse.FromString)
+            i = 0
+            while time.perf_counter() < stop_at:
+                try:
+                    call(risk_pb2.ScoreTransactionRequest(
+                        account_id=f"probe-{i % 64}", amount=1000 + i,
+                        transaction_type="deposit"), timeout=10)
+                    with lock:
+                        ok_count[0] += 1
+                except grpc.RpcError as exc:
+                    with lock:
+                        errors.append(f"{exc.code().name}: "
+                                      + repr(exc.details())[:120])
+                i += 1
+                time.sleep(0.01)
+            ch.close()
+
+        # SLO-plane poller: watches the victim's /debug/sloz for the
+        # first violation and the fast alert, and times /debug/fleetz
+        # polls through the SIGKILL window (gate 4's evidence).
+        marks: dict = {"first_violation_s": None, "fast_alert_s": None,
+                       "fleetz_polls": 0, "fleetz_max_ms": 0.0,
+                       "fleetz_errors": 0}
+
+        def http_json(addr_: str, path: str, timeout: float = 3.0):
+            with urllib.request.urlopen(
+                    f"http://{addr_}{path}", timeout=timeout) as resp:
+                return json.loads(resp.read())
+
+        def poller() -> None:
+            while time.perf_counter() < stop_at:
+                now_s = time.perf_counter() - t0
+                try:
+                    sloz = http_json(victim_http, "/debug/sloz", 1.5)
+                    if (marks["first_violation_s"] is None
+                            and sloz.get("violations_total", 0) > 0):
+                        marks["first_violation_s"] = round(now_s, 3)
+                    if (marks["fast_alert_s"] is None
+                            and sloz["windows"]["fast"]["alert"]):
+                        marks["fast_alert_s"] = round(now_s, 3)
+                except Exception:  # noqa: BLE001 — victim sloz poll is measurement, not load
+                    pass
+                tq0 = time.perf_counter()
+                try:
+                    http_json(fleetz_addr, "/debug/fleetz", 5.0)
+                    marks["fleetz_polls"] += 1
+                    marks["fleetz_max_ms"] = max(
+                        marks["fleetz_max_ms"],
+                        (time.perf_counter() - tq0) * 1000.0)
+                except Exception:  # noqa: BLE001 — a failed poll IS the measurement
+                    marks["fleetz_errors"] += 1
+                time.sleep(0.2)
+
+        threads = [threading.Thread(target=batch_worker) for _ in range(2)]
+        threads.append(threading.Thread(target=prober))
+        threads.append(threading.Thread(target=poller))
+        for t in threads:
+            t.start()
+
+        # The liveness fault: SIGKILL the casualty replica mid-run.
+        delay = t0 + kill_at - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        fleet.replicas[casualty].kill()
+        kill_done_s = time.perf_counter() - t0
+
+        for t in threads:
+            t.join()
+
+        # Post-run evidence, straight off the debug surfaces.
+        victim_sloz = http_json(victim_http, "/debug/sloz", 5.0)
+        victim_telemetry = http_json(victim_http, "/debug/telemetryz", 5.0)
+        # Give the fleetview one more tick so the dead replica's
+        # staleness stamp has settled, then snapshot.
+        time.sleep(2.0)
+        fleetz = http_json(fleetz_addr, "/debug/fleetz", 5.0)
+
+        captures = victim_telemetry.get("profile_captures", [])
+        attribution = victim_sloz["windows"]["slow"]["budget_attribution"]
+        casualty_block = next(
+            (r for r in fleetz["replicas"] if r["replica"] == casualty_rid),
+            None)
+        result.update({
+            "duration_s": duration_s,
+            "kill_at_s": round(kill_done_s, 3),
+            "requests_ok": ok_count[0],
+            "errors": len(errors),
+            "error_samples": errors[:5],
+            "first_violation_s": marks["first_violation_s"],
+            "fast_alert_s": marks["fast_alert_s"],
+            "alert_latency_s": (
+                round(marks["fast_alert_s"] - marks["first_violation_s"], 3)
+                if marks["fast_alert_s"] is not None
+                and marks["first_violation_s"] is not None else None),
+            "victim_slo": {
+                "requests_total": victim_sloz["requests_total"],
+                "violations_total": victim_sloz["violations_total"],
+                "fast": victim_sloz["windows"]["fast"],
+                "budget_attribution_slow": attribution,
+                "alert_events": victim_sloz["alert_events"],
+                "by_state": victim_sloz["by_state"],
+            },
+            "victim_telemetry": {
+                "anomalies_total": victim_telemetry.get("anomalies_total"),
+                "profile_captures": captures,
+                "step_time": victim_telemetry.get("step_time"),
+                "compile": victim_telemetry.get("compile"),
+                "dispatches_total": victim_telemetry.get("dispatches_total"),
+            },
+            "fleetz": {
+                "polls": marks["fleetz_polls"],
+                "poll_errors": marks["fleetz_errors"],
+                "max_poll_ms": round(marks["fleetz_max_ms"], 3),
+                "casualty_block": casualty_block,
+                "stage_latency": fleetz.get("fleet_stage_latency_ms"),
+                "slowest_trace": (fleetz.get("slowest_traces") or [None])[0],
+            },
+        })
+
+        # Observability-overhead A/B (in-process, after the fleet load):
+        # slo+telemetry on vs off must land within noise.
+        from bench import observability_ab_numbers  # repo root on sys.path
+
+        os.environ.setdefault("BENCH_OBS_AB_S", "4.0")
+        os.environ.setdefault("BENCH_E2E_ROWS_PER_RPC", "2048")
+        os.environ.setdefault("BENCH_E2E_BATCH", "2048")
+        try:
+            result["obs_ab"] = observability_ab_numbers()
+        except Exception as exc:  # noqa: BLE001 — the A/B must not lose the fleet evidence
+            result["obs_ab"] = {"error": f"{type(exc).__name__}: {exc}"}
+    finally:
+        try:
+            if router is not None:
+                router.close()
+            if server is not None:
+                server.stop(2)
+        except Exception:  # noqa: BLE001 — teardown best-effort; artifact already built
+            pass
+        fleet.stop()
+
+    captures = result.get("victim_telemetry", {}).get("profile_captures", [])
+    ab = result.get("obs_ab", {})
+    gates = {
+        "fast_alert_fired_within_window": (
+            result.get("alert_latency_s") is not None
+            and result["alert_latency_s"] <= fast_window_s + 1.0),
+        "attribution_names_injected_stage": (
+            result.get("victim_slo", {}).get(
+                "budget_attribution_slow", {}).get("top_stage")
+            == "score.dispatch"),
+        "exactly_one_profile_capture": (
+            len(captures) == 1 and bool(captures[0].get("trace_id"))),
+        "fleetz_live_through_kill": (
+            result.get("fleetz", {}).get("polls", 0) > 0
+            and result.get("fleetz", {}).get("poll_errors", 1) == 0
+            and result.get("fleetz", {}).get("max_poll_ms", 1e9) < 2000.0
+            and bool((result.get("fleetz", {}).get("casualty_block")
+                      or {}).get("stale"))),
+        "obs_overhead_within_noise": bool(
+            ab.get("obs_overhead_within_noise")),
+    }
+    result["gates"] = gates
+    out_path = os.environ.get(
+        "SLO_ARTIFACT",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "SLO_r09.json"))
+    with open(out_path, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps(result))
+    print(json.dumps({"gates": gates}), file=sys.stderr, flush=True)
+    if not all(gates.values()):
+        sys.exit(1)
+
+
 def main_ledger_chaos() -> None:
     """Ledger chaos soak (``--chaos-ledger``): one production-wired risk
     server as an OS process (benchmarks/fleet.py replica protocol) with a
@@ -1067,6 +1366,10 @@ if __name__ == "__main__":
     if "--chaos-ledger" in sys.argv or os.environ.get("SOAK_CHAOS_LEDGER") == "1":
         # The ledger soak provisions its own replica process (CPU rig).
         main_ledger_chaos()
+    elif "--slo-chaos" in sys.argv or os.environ.get("SOAK_SLO_CHAOS") == "1":
+        # The SLO soak provisions its own replica processes (CPU control
+        # rig) — the responsive-device gate would only slow it.
+        main_slo_chaos()
     elif "--fleet-chaos" in sys.argv or os.environ.get("SOAK_FLEET_CHAOS") == "1":
         # The fleet soak provisions its own replica processes (CPU
         # control rig) — the responsive-device gate would only slow it.

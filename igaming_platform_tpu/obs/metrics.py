@@ -445,6 +445,123 @@ class ServiceMetrics:
             "Decision records delivered to the ClickHouse/PG sink "
             "(at-least-once: a cursor replay after SIGKILL may re-send)",
         )
+        # Fleet-wide SLO plane (obs/slo.py): attainment against the
+        # latency objective, multi-window burn rate, and which stage
+        # consumed the error budget on violating requests.
+        self.slo_requests_total = self.registry.counter(
+            f"{service}_slo_requests_total",
+            "Scoring RPCs counted against the latency SLO by {state} "
+            "(the supervisor serving state each sample was scored under)",
+        )
+        self.slo_violations_total = self.registry.counter(
+            f"{service}_slo_violations_total",
+            "SLO-violating scoring RPCs by {state}: latency above the "
+            "objective (SLO_OBJECTIVE_MS) or a server-fault status — "
+            "sheds and caller errors never burn budget",
+        )
+        self.slo_burn_rate = self.registry.gauge(
+            f"{service}_slo_burn_rate",
+            "Error-budget burn rate by {window} (fast ~1 min / slow "
+            "~1 h): violating fraction over the window divided by the "
+            "budget fraction (1 - SLO_TARGET); 1.0 = budget consumed "
+            "exactly at the sustainable rate, 10 = 10x too fast",
+        )
+        self.slo_attainment = self.registry.gauge(
+            f"{service}_slo_attainment",
+            "Fraction of scoring RPCs meeting the latency objective over "
+            "the {window} (1.0 with no traffic — an idle replica is not "
+            "a violating replica)",
+        )
+        self.slo_alert = self.registry.gauge(
+            f"{service}_slo_alert",
+            "Burn-rate alert state by {window}: 1 while the window's "
+            "burn rate is at/above its alert threshold "
+            "(SLO_FAST_BURN_ALERT / SLO_SLOW_BURN_ALERT), else 0",
+        )
+        self.slo_alerts_total = self.registry.counter(
+            f"{service}_slo_alerts_total",
+            "Burn-rate alert RAISE transitions by {window} — one per "
+            "incident, not one per violating request",
+        )
+        self.slo_budget_stage_ms_total = self.registry.counter(
+            f"{service}_slo_budget_stage_ms_total",
+            "Stage busy-time (ms) accumulated on SLO-VIOLATING requests "
+            "by {stage} — the budget-attribution table: the stage with "
+            "the largest share is where the budget went",
+        )
+        # Fleet aggregation plane (obs/fleetview.py): scrape health of
+        # the cross-replica rollup served at /debug/fleetz.
+        self.fleet_replicas_scraped = self.registry.gauge(
+            f"{service}_fleet_replicas_scraped",
+            "Replicas in the fleet view by {freshness}: fresh = last "
+            "scrape within the staleness horizon, stale = dead/hung/"
+            "failing replicas still shown from last-good state",
+        )
+        self.fleet_scrape_failures_total = self.registry.counter(
+            f"{service}_fleet_scrape_failures_total",
+            "Failed sidecar scrape passes by {replica} (bounded-timeout "
+            "fetch of /metrics + debug surfaces; the plane keeps serving "
+            "last-good state)",
+        )
+        self.fleet_scrape_ms = self.registry.histogram(
+            f"{service}_fleet_scrape_ms",
+            "Wall time (ms) of one successful replica sidecar scrape "
+            "(all endpoints)",
+            buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000),
+        )
+        # Device-runtime telemetry (obs/runtime_telemetry.py): the
+        # signals the flight recorder is blind to — recompiles, dispatch
+        # amplification, step-time anomalies, HBM-side occupancy.
+        self.compile_events_total = self.registry.counter(
+            f"{service}_compile_events_total",
+            "Device program compilations observed at the jax monitoring "
+            "seam by {kind} — a non-zero steady-state rate is a "
+            "recompile storm (shape drift or static-arg churn)",
+        )
+        self.compile_wall_ms = self.registry.histogram(
+            f"{service}_compile_wall_ms",
+            "Wall time (ms) of each backend compile — the latency cliff "
+            "a recompiling request falls off",
+            buckets=(1, 5, 25, 100, 500, 1000, 5000, 15000, 60000),
+        )
+        self.compile_signatures_total = self.registry.counter(
+            f"{service}_compile_signatures_total",
+            "Distinct launch shape signatures seen since boot — fires "
+            "exactly once per new (fn, shape, dtype); growth after "
+            "warmup means the batcher is feeding uncompiled shapes",
+        )
+        self.device_dispatches_total = self.registry.counter(
+            f"{service}_device_dispatches_total",
+            "Compiled-step dispatches (score.dispatch/score.device "
+            "stages) — with txns_scored_total this is the "
+            "dispatch-amplification ratio; per-request counts ride the "
+            "flight entries' `dispatches` attribute",
+        )
+        self.step_anomalies_total = self.registry.counter(
+            f"{service}_step_anomalies_total",
+            "Device step-time EWMA anomalies by {stage}: a sample beyond "
+            "mean + k*sigma (ANOMALY_K_SIGMA) and the absolute floor — "
+            "each stamps its flight entry with the anomalous stage",
+        )
+        self.anomaly_profiles_total = self.registry.counter(
+            f"{service}_anomaly_profiles_total",
+            "Automatic device-profile captures triggered by step-time "
+            "anomalies (cooldown-limited: one per "
+            "ANOMALY_PROFILE_COOLDOWN_S, keyed by the anomalous trace id)",
+        )
+        self.arena_buffers = self.registry.gauge(
+            f"{service}_arena_buffers",
+            "Staging-arena buffer accounting by {kind}: allocated = "
+            "fresh allocations since boot, reused = recycled handouts, "
+            "idle = buffers parked on free lists (serve/arena.py); "
+            "refreshed on every /metrics scrape",
+        )
+        self.hbm_bytes = self.registry.gauge(
+            f"{service}_hbm_bytes",
+            "Device memory by {kind} (in_use/limit/peak) from the "
+            "backend's memory_stats — absent on backends that do not "
+            "report (CPU)",
+        )
         self.spans_dropped_total = self.registry.counter(
             f"{service}_spans_dropped_total",
             "Host spans evicted from the bounded span ring before export "

@@ -121,9 +121,14 @@ def union_duration_ms(windows: list | None) -> float:
 # Completion hooks. _SPAN_SINK fires for EVERY completed span (the metrics
 # layer feeds per-stage latency histograms from it); _ROOT_SINK fires for
 # completed ROOT spans only (the flight recorder). Both are best-effort:
-# a failing sink must never fail the traced request.
+# a failing sink must never fail the traced request. The _EXTRA_* lists
+# let independent observers (the SLO engine, device-runtime telemetry)
+# ride the same completion events without fighting over the primary
+# slot — add/remove are idempotent, and extras fire AFTER the primary.
 _SPAN_SINK: Callable[[Span], None] | None = None
 _ROOT_SINK: Callable[[Span], None] | None = None
+_EXTRA_SPAN_SINKS: list[Callable[[Span], None]] = []
+_EXTRA_ROOT_SINKS: list[Callable[[Span], None]] = []
 
 
 def set_span_sink(fn: Callable[[Span], None] | None) -> None:
@@ -134,6 +139,26 @@ def set_span_sink(fn: Callable[[Span], None] | None) -> None:
 def set_root_sink(fn: Callable[[Span], None] | None) -> None:
     global _ROOT_SINK
     _ROOT_SINK = fn
+
+
+def add_span_sink(fn: Callable[[Span], None]) -> None:
+    if fn not in _EXTRA_SPAN_SINKS:
+        _EXTRA_SPAN_SINKS.append(fn)
+
+
+def remove_span_sink(fn: Callable[[Span], None]) -> None:
+    if fn in _EXTRA_SPAN_SINKS:
+        _EXTRA_SPAN_SINKS.remove(fn)
+
+
+def add_root_sink(fn: Callable[[Span], None]) -> None:
+    if fn not in _EXTRA_ROOT_SINKS:
+        _EXTRA_ROOT_SINKS.append(fn)
+
+
+def remove_root_sink(fn: Callable[[Span], None]) -> None:
+    if fn in _EXTRA_ROOT_SINKS:
+        _EXTRA_ROOT_SINKS.remove(fn)
 
 
 def current_span() -> Span | None:
@@ -155,6 +180,19 @@ def set_root_attribute(key: str, value) -> None:
     s = _CURRENT.get()
     if s is not None and s.root is not None:
         s.root.attributes[key] = value
+
+
+def bump_root_attribute_of(s: "Span | None", key: str, delta: float = 1) -> None:
+    """Numerically increment an attribute on ``s``'s ROOT span, safely
+    across threads (pipeline stage workers and the RPC handler both touch
+    the same root). Used for per-request accounting like the device
+    dispatches an RPC issued — the flight recorder snapshots the final
+    value when the root completes."""
+    if s is None:
+        return
+    root = s.root if s.root is not None else s
+    with _STAGE_LOCK:
+        root.attributes[key] = root.attributes.get(key, 0) + delta
 
 
 class SpanCollector:
@@ -267,11 +305,22 @@ def span(name: str, collector: SpanCollector | None = None, *,
                 _SPAN_SINK(s)
             except Exception:  # noqa: BLE001 — sinks must not fail requests
                 pass
-        if root is s and _ROOT_SINK is not None:
+        for sink in tuple(_EXTRA_SPAN_SINKS):
             try:
-                _ROOT_SINK(s)
+                sink(s)
             except Exception:  # noqa: BLE001 — sinks must not fail requests
                 pass
+        if root is s:
+            if _ROOT_SINK is not None:
+                try:
+                    _ROOT_SINK(s)
+                except Exception:  # noqa: BLE001 — sinks must not fail requests
+                    pass
+            for sink in tuple(_EXTRA_ROOT_SINKS):
+                try:
+                    sink(s)
+                except Exception:  # noqa: BLE001 — sinks must not fail requests
+                    pass
 
 
 @contextlib.contextmanager

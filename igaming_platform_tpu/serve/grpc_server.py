@@ -29,6 +29,8 @@ import grpc
 
 from igaming_platform_tpu.core.enums import ReasonCode
 from igaming_platform_tpu.obs import flight as _flight
+from igaming_platform_tpu.obs import runtime_telemetry as _runtime_telemetry
+from igaming_platform_tpu.obs import slo as _slo
 from igaming_platform_tpu.obs import tracing
 from igaming_platform_tpu.obs.metrics import ServiceMetrics
 from igaming_platform_tpu.obs.tracing import span
@@ -258,6 +260,12 @@ def _rpc(metrics: ServiceMetrics, method: str, fn: Callable):
         # the completed root lands in the flight recorder (/debug/flightz).
         with span(f"rpc.{method}",
                   traceparent=_traceparent_from_metadata(context)) as s:
+            # Serving-state annotation (obs/slo.py): the supervisor's
+            # state AT SCORE TIME rides the root span, so flight entries
+            # and SLO samples attribute degraded-tier latency honestly.
+            state = _slo.current_state()
+            if state is not None:
+                s.attributes["serving_state"] = state
             try:
                 resp = fn(request, context)
                 metrics.observe_rpc(method, start)
@@ -399,6 +407,21 @@ class RiskGrpcService:
         # them (one serving engine per process in every deployment shape).
         tracing.set_span_sink(self.metrics.observe_stage_span)
         tracing.DEFAULT_COLLECTOR.on_drop = self.metrics.spans_dropped_total.inc
+        # SLO engine (obs/slo.py, SLO=0 opts out) + device-runtime
+        # telemetry (obs/runtime_telemetry.py): both ride the tracing
+        # fan-out and follow the same ownership contract as the sinks
+        # above. The server layer binds the supervisor state provider
+        # and the anomaly->profile trigger on top.
+        if os.environ.get("SLO", "1") != "0":
+            _slo.install(_slo.SLOEngine(metrics=self.metrics))
+        else:
+            _slo.uninstall()
+        self.telemetry = None
+        if os.environ.get("RUNTIME_TELEMETRY", "1") != "0":
+            self.telemetry = _runtime_telemetry.install(self.metrics)
+            self.telemetry.bind_engine(engine)
+        else:
+            _runtime_telemetry.uninstall()
         batcher = getattr(engine, "_batcher", None)
         if batcher is not None:
             batcher.on_batch = self._observe_batcher_batch

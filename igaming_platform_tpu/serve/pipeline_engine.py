@@ -215,6 +215,13 @@ class HostPipeline:
                 "arena": self._arena.stats(),
             }
 
+    def arena_stats(self) -> dict:
+        """Staging-arena occupancy alone (allocated/reused/idle) — the
+        device-runtime telemetry gauges (obs/runtime_telemetry.py)
+        refresh from this on every /metrics scrape without paying for
+        the full stats() snapshot."""
+        return self._arena.stats()
+
     # -- submission ----------------------------------------------------------
 
     def score_rows_to_wire(

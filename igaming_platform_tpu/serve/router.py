@@ -534,12 +534,81 @@ class ScoringRouter:
             "hedges_both_failed": 0, "link_drops": 0,
         }
 
+        # Fleet aggregation plane (obs/fleetview.py): built by
+        # start_fleetview — None until then.
+        self.fleetview = None
+        self.http_server = None
+        self.http_port = 0
+
     def start(self) -> "ScoringRouter":
         self.watcher.start()
         return self
 
+    def start_fleetview(self, http_port: int = 0) -> int:
+        """Start the cross-replica aggregation plane plus the router's
+        own HTTP sidecar: ``/debug/fleetz`` (fleet rollup — merged stage
+        histograms, per-replica SLO burn, slowest traces fleet-wide),
+        ``/debug/routerz`` (ring/watcher/stats snapshot) and
+        ``/metrics``. Returns the bound HTTP port. Replica targets
+        resolve live so a restarted replica's sidecar is re-scraped at
+        its (stable) address without re-wiring."""
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from igaming_platform_tpu.obs.fleetview import FleetView
+
+        def targets() -> dict[str, str]:
+            return {rid: r.http_addr for rid, r in self.replicas.items()
+                    if r.http_addr}
+
+        self.fleetview = FleetView(
+            targets, metrics=self.metrics,
+            ring_provider=self.snapshot).start()
+        router_ref = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _send(self, code: int, body: str,
+                      content_type: str = "application/json") -> None:
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/debug/fleetz":
+                    # Always last-good state — never a live scrape: a
+                    # dead or SIGSTOP'd replica shows stale-stamped,
+                    # the endpoint never blocks on it.
+                    self._send(200, _json.dumps(
+                        router_ref.fleetview.snapshot()))
+                elif self.path == "/debug/routerz":
+                    self._send(200, _json.dumps(router_ref.snapshot()))
+                elif self.path == "/metrics":
+                    self._send(200,
+                               router_ref.metrics.registry.render_text(),
+                               "text/plain")
+                else:
+                    self._send(404, '{"error":"not found"}')
+
+        httpd = ThreadingHTTPServer(("0.0.0.0", http_port), Handler)
+        thread = threading.Thread(target=httpd.serve_forever,
+                                  name="router-http-sidecar", daemon=True)
+        thread.start()
+        self.http_server = httpd
+        self.http_port = httpd.server_address[1]
+        return self.http_port
+
     def close(self) -> None:
         self.watcher.stop()
+        if self.fleetview is not None:
+            self.fleetview.stop()
+        if self.http_server is not None:
+            self.http_server.shutdown()
         self._pool.shutdown(wait=False)
         for r in self.replicas.values():
             r.close()
@@ -569,6 +638,18 @@ class ScoringRouter:
             base_s = 0.02
         return base_s * self._jitter()
 
+    @staticmethod
+    def _outbound_metadata(fallback: tuple = ()) -> tuple:
+        """Trace context for a replica hop: the CURRENT span's
+        traceparent when the router is inside one (so the replica's rpc
+        span parents under the router's attempt span — router time and
+        hedges become visible stages of the same trace), else the
+        caller's forwarded header."""
+        tp = tracing.current_traceparent()
+        if tp:
+            return (("traceparent", tp),)
+        return fallback
+
     def _forward(self, call_attr: str, payload: bytes, key: str,
                  timeout_s: float, metadata: tuple = ()) -> bytes:
         """Forward to the ring owner of ``key``; UNAVAILABLE walks the
@@ -584,12 +665,18 @@ class ScoringRouter:
             replica = self.replicas[target]
             self._bump("forwards")
             try:
-                if chaos.fire("router.forward") == "drop":
-                    self._bump("link_drops")
-                    raise RouterForwardError(
-                        f"router->{target} link dropped (chaos)")
-                return getattr(replica, call_attr)(
-                    payload, timeout=timeout_s, metadata=metadata)
+                # Each attempt is a trace stage: fleet traces show which
+                # replica answered, which attempts burned time, and the
+                # stage histogram gains a `router.attempt` row.
+                with tracing.span("router.attempt", replica=target,
+                                  attempt=attempt):
+                    if chaos.fire("router.forward") == "drop":
+                        self._bump("link_drops")
+                        raise RouterForwardError(
+                            f"router->{target} link dropped (chaos)")
+                    return getattr(replica, call_attr)(
+                        payload, timeout=timeout_s,
+                        metadata=self._outbound_metadata(metadata))
             except grpc.RpcError as exc:
                 if exc.code() != grpc.StatusCode.UNAVAILABLE:
                     raise  # the replica answered; its status is the answer
@@ -632,7 +719,8 @@ class ScoringRouter:
         t0 = time.monotonic()
         self._bump("forwards")
         fut_primary = primary.score_txn.future(
-            payload, timeout=timeout_s, metadata=metadata)
+            payload, timeout=timeout_s,
+            metadata=self._outbound_metadata(metadata))
         hedge_s = self.latency.hedge_deadline_s()
         try:
             data = fut_primary.result(timeout=hedge_s)
@@ -652,51 +740,58 @@ class ScoringRouter:
             self.latency.observe_ms((time.monotonic() - t0) * 1000.0)
             return data
 
-        # Hedge: the secondary owner races the straggling primary.
+        # Hedge: the secondary owner races the straggling primary. The
+        # race runs inside a `router.hedge` span whose outcome attribute
+        # records who won — hedge outcomes become visible trace stages.
         self._bump("hedges_launched")
         self.metrics.hedge_total.inc(outcome="launched")
         tracing.set_root_attribute("hedged", secondary.id)
         self._bump("forwards")
-        fut_hedge = secondary.score_txn.future(
-            payload, timeout=timeout_s, metadata=metadata)
-        done = threading.Event()
-        fut_primary.add_done_callback(lambda _f: done.set())
-        fut_hedge.add_done_callback(lambda _f: done.set())
-        deadline = time.monotonic() + timeout_s
-        failed: set[str] = set()
-        while time.monotonic() < deadline:
-            done.wait(timeout=max(0.0, deadline - time.monotonic()))
-            done.clear()
-            for name, fut, loser in (
-                ("primary", fut_primary, fut_hedge),
-                ("hedge", fut_hedge, fut_primary),
-            ):
-                if name in failed or not fut.done():
-                    continue
-                try:
-                    data = fut.result(timeout=0)
-                except (grpc.RpcError, grpc.FutureTimeoutError,
-                        grpc.FutureCancelledError) as exc:
-                    failed.add(name)
-                    if isinstance(exc, grpc.RpcError):
-                        rid = primary.id if name == "primary" else secondary.id
-                        self.watcher.note_forward_failure(rid, exc)
-                    continue
-                loser.cancel()
-                self.latency.observe_ms((time.monotonic() - t0) * 1000.0)
-                if name == "primary":
-                    self._bump("primary_wins")
-                    self.metrics.hedge_total.inc(outcome="win_primary")
-                else:
-                    self._bump("hedge_wins")
-                    self.metrics.hedge_total.inc(outcome="win_hedge")
-                return data
-            if {"primary", "hedge"} <= failed:
-                break
-        fut_primary.cancel()
-        fut_hedge.cancel()
-        self._bump("hedges_both_failed")
-        self.metrics.hedge_total.inc(outcome="both_failed")
+        with tracing.span("router.hedge", replica=secondary.id) as hedge_span:
+            fut_hedge = secondary.score_txn.future(
+                payload, timeout=timeout_s,
+                metadata=self._outbound_metadata(metadata))
+            done = threading.Event()
+            fut_primary.add_done_callback(lambda _f: done.set())
+            fut_hedge.add_done_callback(lambda _f: done.set())
+            deadline = time.monotonic() + timeout_s
+            failed: set[str] = set()
+            while time.monotonic() < deadline:
+                done.wait(timeout=max(0.0, deadline - time.monotonic()))
+                done.clear()
+                for name, fut, loser in (
+                    ("primary", fut_primary, fut_hedge),
+                    ("hedge", fut_hedge, fut_primary),
+                ):
+                    if name in failed or not fut.done():
+                        continue
+                    try:
+                        data = fut.result(timeout=0)
+                    except (grpc.RpcError, grpc.FutureTimeoutError,
+                            grpc.FutureCancelledError) as exc:
+                        failed.add(name)
+                        if isinstance(exc, grpc.RpcError):
+                            rid = primary.id if name == "primary" else secondary.id
+                            self.watcher.note_forward_failure(rid, exc)
+                        continue
+                    loser.cancel()
+                    self.latency.observe_ms((time.monotonic() - t0) * 1000.0)
+                    if name == "primary":
+                        self._bump("primary_wins")
+                        self.metrics.hedge_total.inc(outcome="win_primary")
+                        hedge_span.attributes["outcome"] = "win_primary"
+                    else:
+                        self._bump("hedge_wins")
+                        self.metrics.hedge_total.inc(outcome="win_hedge")
+                        hedge_span.attributes["outcome"] = "win_hedge"
+                    return data
+                if {"primary", "hedge"} <= failed:
+                    break
+            fut_primary.cancel()
+            fut_hedge.cancel()
+            self._bump("hedges_both_failed")
+            self.metrics.hedge_total.inc(outcome="both_failed")
+            hedge_span.attributes["outcome"] = "both_failed"
         raise RouterForwardError(
             f"hedged ScoreTransaction failed on both owners "
             f"({primary.id}, {secondary.id}) for account {key!r}")
@@ -747,12 +842,16 @@ class ScoringRouter:
         metadata = self._propagate_metadata(context)
         timeout_s = self._timeout_for(context)
         try:
-            if self.hedge_enabled:
-                data = self._hedged_score_txn(
-                    buf, account_id, timeout_s, metadata)
-            else:
-                data = self._forward("score_txn", buf, account_id,
-                                     timeout_s, metadata)
+            # Routing is a trace stage of the client's request: the time
+            # between "router had the bytes" and "a replica answered" —
+            # attempts and hedges nest under it.
+            with tracing.span("router.route", method="ScoreTransaction"):
+                if self.hedge_enabled:
+                    data = self._hedged_score_txn(
+                        buf, account_id, timeout_s, metadata)
+                else:
+                    data = self._forward("score_txn", buf, account_id,
+                                         timeout_s, metadata)
         except RouterForwardError as exc:
             raise self._abort(exc) from exc
         self.metrics.txns_scored_total.inc()
@@ -781,8 +880,10 @@ class ScoringRouter:
                                f"bad index-mode frame: {exc}") from exc
             key = ids[0].decode(errors="replace") if ids else ""
             try:
-                data = self._forward("score_batch", buf, key,
-                                     timeout_s, metadata)
+                with tracing.span("router.route", method="ScoreBatch",
+                                  mode="index"):
+                    data = self._forward("score_batch", buf, key,
+                                         timeout_s, metadata)
             except RouterForwardError as exc:
                 raise self._abort(exc) from exc
             self.metrics.txns_scored_total.inc(len(ids))
@@ -804,13 +905,15 @@ class ScoringRouter:
                 raise self._abort(RouterForwardError("ring has no active replicas"))
             groups.setdefault(owner, []).append(i)
         try:
-            if len(groups) <= 1:
-                key = txs[0].account_id if txs else ""
-                data = self._forward("score_batch", buf, key,
-                                     timeout_s, metadata)
-                self.metrics.txns_scored_total.inc(len(txs))
-                return RawProtoMessage(data)
-            data = self._split_batch(req, groups, timeout_s, metadata)
+            with tracing.span("router.route", method="ScoreBatch",
+                              owners=len(groups)):
+                if len(groups) <= 1:
+                    key = txs[0].account_id if txs else ""
+                    data = self._forward("score_batch", buf, key,
+                                         timeout_s, metadata)
+                    self.metrics.txns_scored_total.inc(len(txs))
+                    return RawProtoMessage(data)
+                data = self._split_batch(req, groups, timeout_s, metadata)
         except RouterForwardError as exc:
             raise self._abort(exc) from exc
         self.metrics.txns_scored_total.inc(len(txs))
@@ -825,14 +928,18 @@ class ScoringRouter:
         from risk.v1 import risk_pb2
 
         txs = req.transactions
+        parent = tracing.current_span()
 
         def _one(owner: str, idxs: list[int]):
-            sub = risk_pb2.ScoreBatchRequest(
-                transactions=[txs[i] for i in idxs])
-            payload = self._forward(
-                "score_batch", sub.SerializeToString(),
-                txs[idxs[0]].account_id, timeout_s, metadata)
-            return idxs, risk_pb2.ScoreBatchResponse.FromString(payload)
+            # Re-enter the routing span on the fan-out thread so each
+            # sub-forward's `router.attempt` stays in the client's trace.
+            with tracing.carry(parent):
+                sub = risk_pb2.ScoreBatchRequest(
+                    transactions=[txs[i] for i in idxs])
+                payload = self._forward(
+                    "score_batch", sub.SerializeToString(),
+                    txs[idxs[0]].account_id, timeout_s, metadata)
+                return idxs, risk_pb2.ScoreBatchResponse.FromString(payload)
 
         futures = [self._pool.submit(_one, owner, idxs)
                    for owner, idxs in groups.items()]
@@ -900,10 +1007,14 @@ class AccountAffinityPicker:
 # Server assembly
 
 
-def serve_router(router: ScoringRouter, port: int, max_workers: int = 32):
+def serve_router(router: ScoringRouter, port: int, max_workers: int = 32,
+                 http_port: int | None = None):
     """Start the router's gRPC front; returns (server, health, port).
     The health servicer reports NOT_SERVING when the ring has no active
-    replicas — an empty fleet must fail its own health check."""
+    replicas — an empty fleet must fail its own health check.
+    ``http_port`` (0 = ephemeral) additionally starts the fleet
+    aggregation plane and its sidecar (``/debug/fleetz``); the bound
+    port lands on ``router.http_port``."""
     from concurrent import futures as _futures
 
     from risk.v1 import risk_pb2
@@ -932,4 +1043,6 @@ def serve_router(router: ScoringRouter, port: int, max_workers: int = 32):
     bound = server.add_insecure_port(f"[::]:{port}")
     server.start()
     router.start()
+    if http_port is not None:
+        router.start_fleetview(http_port)
     return server, health, bound

@@ -114,6 +114,19 @@ def _pack_outputs(fn, echo_batch: bool = False):
     return packed
 
 
+def _device_dispatch(fn_name: str, shape, dtype) -> None:
+    """The launch-side chokepoint, mirroring ``_device_readback``: the
+    ``device.dispatch`` chaos seam fires here (inside the dispatch stage
+    span, so an injected delay attributes to ``score.dispatch`` in the
+    SLO budget table), and the padded shape signature is noted with the
+    compile watcher (obs/runtime_telemetry.py) — a signature seen for
+    the first time after warmup is the recompile-storm tripwire."""
+    from igaming_platform_tpu.obs import runtime_telemetry as _rt
+
+    chaos.fire("device.dispatch")
+    _rt.note_compile_signature(fn_name, shape, dtype)
+
+
 def _device_readback(out):
     """The D2H drain, chokepointed so chaos plans (serve/chaos.py) can
     inject the tunnel-wedge shape — a readback that delays, errors, or
@@ -441,8 +454,10 @@ class TPUScoringEngine:
             params = self._params_host if use_host else self._params
             thresholds = self._thresholds_host if use_host else self._thresholds
         if use_host:
+            _device_dispatch("packed_step_host", xp.shape, xp.dtype)
             out, _ = self._fn_host(params, xp, blp, thresholds)
             return out
+        _device_dispatch("packed_step", xp.shape, xp.dtype)
         out, _ = self._packed_fn(params, xp, blp, thresholds)
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
@@ -589,6 +604,7 @@ class TPUScoringEngine:
         blp, _ = pad_batch(bl, shape)
         with self._params_lock:
             params = self._params
+        _device_dispatch("cached_step", idxsp.shape, idxsp.dtype)
         out = self._cached_fn(
             params, self.cache.table, self.cache.flags,
             idxsp, amtp, typp, blp, self._thresholds)
@@ -784,12 +800,14 @@ class TPUScoringEngine:
             params = self._params_host if use_host else self._params
             thresholds = self._thresholds_host if use_host else self._thresholds
         if use_host:
+            _device_dispatch("packed_step_host", xp.shape, xp.dtype)
             out, _ = self._fn_host(params, xp, blp, thresholds)
             return out, n
         # The echo (the donated staging slot, recycled in place) is
         # dropped here: this lockstep path pads into fresh arrays. The
         # pipelined path (serve/pipeline_engine.py) holds its arena
         # buffers until readback instead.
+        _device_dispatch("packed_step", xp.shape, xp.dtype)
         out, _ = self._packed_fn(params, xp, blp, thresholds)
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()

@@ -234,6 +234,17 @@ class RiskServer:
             metrics=self.metrics,
             rate_limit_per_minute=self.config.rate_limit_per_minute,
         )
+        # SLO plane + device-runtime telemetry (installed by the service
+        # constructor): the server layer adds what only it has — the
+        # anomaly->profile trigger (the /debug/profilez capture path,
+        # artifacts keyed by the anomalous trace id, cooldown enforced
+        # by the telemetry side).
+        from igaming_platform_tpu.obs import slo as slo_mod
+
+        self.slo = slo_mod.get_default()
+        self.telemetry = service.telemetry
+        if self.telemetry is not None:
+            self.telemetry.bind_profile_trigger(self._anomaly_profile_trigger)
         self.grpc_server, self.health, self.grpc_port = serve_risk(
             service, grpc_port if grpc_port is not None else self.config.grpc_port
         )
@@ -352,13 +363,16 @@ class RiskServer:
         except Exception:  # noqa: BLE001 — timeout or device error
             return False
 
-    def capture_profile(self, seconds: float) -> dict:
+    def capture_profile(self, seconds: float, trace_id: str = "") -> dict:
         """On-demand jax.profiler capture (`/debug/profilez?seconds=S`):
         records a TensorBoard-compatible device trace for ``seconds``
         while live traffic keeps flowing, via the same ``device_trace``
         helper the offline drills use. Bounded at 30 s (the capture
         blocks its HTTP worker thread and profile buffers grow with
-        duration); 409 when a capture is already running."""
+        duration); 409 when a capture is already running. ``trace_id``
+        keys the artifact directory name so an anomaly-triggered capture
+        joins back to its flight entry / SLO exemplar."""
+        import re as _re
         import tempfile
         import time as _time
 
@@ -368,7 +382,12 @@ class RiskServer:
         if not self._profile_lock.acquire(blocking=False):
             return {"error": "profile capture already in progress"}
         try:
-            log_dir = tempfile.mkdtemp(prefix="igaming-profilez-")
+            suffix = _re.sub(r"[^0-9a-zA-Z_-]", "", trace_id)[:32]
+            prefix = (f"igaming-profilez-{suffix}-" if suffix
+                      else "igaming-profilez-")
+            log_dir = tempfile.mkdtemp(
+                prefix=prefix,
+                dir=os.environ.get("ANOMALY_PROFILE_DIR") or None)
             with device_trace(log_dir):
                 _time.sleep(seconds)
             return {"ok": True, "seconds": seconds, "log_dir": log_dir,
@@ -377,6 +396,31 @@ class RiskServer:
             return {"error": f"profile capture failed: {exc}"}
         finally:
             self._profile_lock.release()
+
+    def _anomaly_profile_trigger(self, trace_id: str, stage: str,
+                                 duration_ms: float) -> dict:
+        """Runtime-telemetry anomaly hook: capture a device profile in
+        the BACKGROUND (the hook fires on a serving thread and must not
+        block), keyed by the anomalous trace id. Cooldown accounting is
+        the telemetry side's job; this only does the capture."""
+        seconds = float(os.environ.get("ANOMALY_PROFILE_SECONDS", "1.5"))
+
+        def run() -> None:
+            result = self.capture_profile(seconds, trace_id=trace_id)
+            self.telemetry.note_capture_result(trace_id, result)
+            if "error" in result:
+                logger.warning("anomaly profile capture (%s, %s): %s",
+                               trace_id, stage, result["error"])
+            else:
+                logger.warning(
+                    "anomaly profile captured: stage=%s trace=%s "
+                    "duration_ms=%.1f -> %s", stage, trace_id, duration_ms,
+                    result["log_dir"])
+
+        thread = threading.Thread(
+            target=run, name="anomaly-profile", daemon=True)
+        thread.start()
+        return {"seconds": seconds, "async": True}
 
     # -- HTTP sidecar (main.go:160-202 equivalent) ---------------------------
 
@@ -397,6 +441,12 @@ class RiskServer:
 
             def do_GET(self):
                 if self.path == "/metrics":
+                    # Occupancy gauges (arena buffers, device memory) are
+                    # refreshed per scrape so they are scrape-fresh
+                    # without a background ticker.
+                    tel = getattr(server_ref, "telemetry", None)
+                    if tel is not None:
+                        tel.refresh_gauges()
                     self._send(200, server_ref.metrics.registry.render_text(), "text/plain")
                 elif self.path == "/health":
                     self._send(200, '{"status":"healthy"}')
@@ -428,6 +478,25 @@ class RiskServer:
                         snap["followers_alive"] = chan.alive
                         snap["resurrections"] = chan.resurrections
                     self._send(200, json.dumps(snap))
+                elif self.path == "/debug/sloz":
+                    # SLO engine: burn rates, attainment, budget
+                    # attribution, alert timeline (runbook:
+                    # docs/operations.md "SLO & fleet view").
+                    from igaming_platform_tpu.obs import slo as _slo_mod
+
+                    slo_engine = _slo_mod.get_default()
+                    if slo_engine is None:
+                        self._send(404, '{"error":"slo engine disabled"}')
+                        return
+                    self._send(200, json.dumps(slo_engine.snapshot()))
+                elif self.path == "/debug/telemetryz":
+                    # Device-runtime telemetry: compile events, dispatch
+                    # counts, step-time EWMAs, anomaly + auto-profile log.
+                    tel = getattr(server_ref, "telemetry", None)
+                    if tel is None:
+                        self._send(404, '{"error":"telemetry disabled"}')
+                        return
+                    self._send(200, json.dumps(tel.snapshot()))
                 elif self.path == "/debug/spans":
                     from igaming_platform_tpu.obs.tracing import DEFAULT_COLLECTOR
                     self._send(200, DEFAULT_COLLECTOR.to_json())

@@ -375,6 +375,13 @@ class ServingSupervisor:
         """Attach the health servicer and/or a ServiceMetrics registry;
         current state is pushed immediately so a freshly-scraped gauge
         never reads the default 0 while degraded."""
+        # SLO-plane annotation (obs/slo.py): every scoring sample is
+        # stamped with the serving state it was scored under, so a
+        # degraded window's latency burns budget AS degraded latency —
+        # same registration pattern as ledger.set_state_provider.
+        from igaming_platform_tpu.obs import slo as _slo
+
+        _slo.set_state_provider(lambda: self.state)
         if health is not None:
             self._health = health
             self._apply_health(self.state)

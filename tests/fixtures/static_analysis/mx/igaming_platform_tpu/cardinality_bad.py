@@ -1,0 +1,18 @@
+"""Seeded MX05 violations: unbounded identifier values used as metric
+LABELS. Each call mints one time series per account/decision/trace —
+the exemplar channel (cardinality_ok.py) is the sanctioned click-through."""
+
+from igaming_platform_tpu.obs.metrics import Registry
+
+registry = Registry()
+
+txns = registry.counter("txns_total", "Transactions scored")
+lat = registry.histogram("latency_ms", "Request latency in milliseconds")
+depth = registry.gauge("queue_depth", "Requests waiting in the batcher")
+
+
+def record(resp, span, account_id: str):
+    txns.inc(account_id=account_id)  # expect: MX05
+    txns.inc(decision=resp.decision_id)  # expect: MX05
+    lat.observe(12.5, trace=span.trace_id)  # expect: MX05
+    depth.set(3.0, who=f"acct-{account_id}")  # expect: MX05

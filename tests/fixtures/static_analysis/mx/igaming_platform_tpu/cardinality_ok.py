@@ -1,0 +1,17 @@
+"""Compliant siblings of cardinality_bad.py: bounded enumeration labels
+and the exemplar channel for trace-id click-through."""
+
+from igaming_platform_tpu.obs.metrics import Registry
+
+registry = Registry()
+
+txns = registry.counter("txns_total", "Transactions scored")
+lat = registry.histogram("latency_ms", "Request latency in milliseconds")
+
+
+def record(resp, span, tx_type: str):
+    # Bounded enumerations are what labels are for.
+    txns.inc(type=tx_type, code="OK")
+    # Exemplars are the sanctioned high-cardinality channel: one
+    # (trace_id, value) per bucket, bounded by construction.
+    lat.observe(12.5, exemplar=span.trace_id, stage="score.dispatch")

@@ -432,3 +432,47 @@ def test_load_gen_retry_helper_honors_pushback():
     finally:
         ch.close()
         server.stop(0)
+
+
+def test_router_emits_route_attempt_spans_in_client_trace(fleet3):
+    """Satellite (ISSUE 8): the router participates in the client's
+    trace — `router.route` and `router.attempt` spans carry the client's
+    trace id, and the replica's rpc span parents under the router's
+    attempt (one fleet-wide trace, router time visible as a stage)."""
+    from igaming_platform_tpu.obs import tracing
+
+    router, server, addr = _router_over(fleet3, hedge=False)
+    ch, txn, _ = _stubs(addr)
+    client_trace = "ab" * 16
+    client_span = "cd" * 8
+    try:
+        tracing.DEFAULT_COLLECTOR.drain()
+        txn(risk_pb2.ScoreTransactionRequest(
+            account_id="traced-acct", amount=100,
+            transaction_type="deposit"),
+            metadata=(("traceparent",
+                       f"00-{client_trace}-{client_span}-01"),),
+            timeout=10)
+        spans = tracing.DEFAULT_COLLECTOR.drain()
+        in_trace = [s for s in spans if s.trace_id == client_trace]
+        names = {s.name for s in in_trace}
+        assert "router.route" in names
+        assert "router.attempt" in names
+        # Router + replica rpc roots both adopted the client trace.
+        rpc_spans = [s for s in in_trace
+                     if s.name == "rpc.ScoreTransaction"]
+        assert len(rpc_spans) == 2
+        attempt = next(s for s in in_trace if s.name == "router.attempt")
+        route = next(s for s in in_trace if s.name == "router.route")
+        # attempt nests under route; the REPLICA's rpc span parents
+        # under the router's attempt span (cross-process contract,
+        # exercised in-process here).
+        assert attempt.parent_id == route.span_id
+        replica_rpc = next(s for s in rpc_spans
+                           if s.parent_id == attempt.span_id)
+        assert replica_rpc.attributes.get("code") == "OK"
+        assert attempt.attributes.get("replica") in {"r0", "r1", "r2"}
+    finally:
+        ch.close()
+        router.close()
+        server.stop(0)

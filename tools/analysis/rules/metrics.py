@@ -188,6 +188,73 @@ def hot_loop_alloc(ctx: FileContext):
                 "pool (serve/arena.py) or pass pad_batch(out=...)")
 
 
+# MX05: metric *labels* are a cartesian dimension — every distinct value
+# mints a new time series forever. Identifier-shaped values (account ids,
+# decision ids, trace ids, ...) are unbounded, so one busy day melts the
+# scrape. The sanctioned high-cardinality channel is the EXEMPLAR (one
+# trace id per bucket, bounded by construction) — the `exemplar=` kwarg
+# is exempt.
+_METRIC_WRITE_METHODS = {"inc", "set", "observe", "observe_many"}
+_NON_LABEL_KWARGS = {"exemplar", "value", "timeout"}
+_UNBOUNDED_IDENTIFIERS = {
+    "account_id", "player_id", "decision_id", "trace_id", "span_id",
+    "parent_id", "session_id", "request_id", "transaction_id", "tx_id",
+    "idempotency_key", "device_id", "fingerprint", "round_id", "game_id",
+}
+
+
+def _unbounded_mention(node: ast.AST) -> str | None:
+    """An identifier-shaped name appearing anywhere in a label-value
+    expression (bare name, attribute access, f-string interpolation)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in _UNBOUNDED_IDENTIFIERS:
+            return sub.id
+        if isinstance(sub, ast.Attribute) and sub.attr in _UNBOUNDED_IDENTIFIERS:
+            return sub.attr
+    return None
+
+
+@rule("MX05", "metric-label-cardinality",
+      "Metric labels must be bounded enumerations: a per-account/"
+      "per-decision/per-trace label value mints a new time series per "
+      "value and melts the scrape within a day. High-cardinality "
+      "click-through belongs in the exemplar channel (`exemplar=`, "
+      "bounded at one per bucket), the flight recorder, or the ledger — "
+      "never in a label.")
+def metric_label_cardinality(ctx: FileContext):
+    if "igaming_platform_tpu" not in ctx.path.parts:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute)
+                and fn.attr in _METRIC_WRITE_METHODS):
+            continue
+        # `self.observe(...)` is a method of the enclosing class (the
+        # SLO engine's sample intake, a detector, ...), not a metric
+        # write: metric objects are always attributes of something
+        # (`self.metrics.x.inc`, `txns.inc`), never `self` itself.
+        if isinstance(fn.value, ast.Name) and fn.value.id == "self":
+            continue
+        for kw in node.keywords:
+            if kw.arg is None or kw.arg in _NON_LABEL_KWARGS:
+                continue
+            if kw.arg in _UNBOUNDED_IDENTIFIERS:
+                yield node.lineno, (
+                    f"unbounded metric label `{kw.arg}`: one time series "
+                    "per value — use a bounded enumeration, or carry the "
+                    "id as an exemplar/flight/ledger field")
+                continue
+            hit = _unbounded_mention(kw.value)
+            if hit is not None:
+                yield node.lineno, (
+                    f"metric label `{kw.arg}` carries unbounded "
+                    f"identifier `{hit}`: one time series per value — "
+                    "use a bounded enumeration, or carry the id as an "
+                    "exemplar/flight/ledger field")
+
+
 @rule("MX03", "orphan-metric",
       "Production code must construct metrics via "
       "Registry.counter/gauge/histogram: a bare Counter()/Gauge()/"
