@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-fast lint lint-json lint-update-baseline bench bench-all bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos soak-chaos-ledger soak-slo replay-verify fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
+.PHONY: all test test-fast lint lint-json lint-update-baseline bench bench-all bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos soak-chaos-ledger soak-slo soak-online replay-verify fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
 
 all: native test
 
@@ -75,6 +75,15 @@ soak-chaos-ledger:
 # the observability-overhead A/B -> SLO_r09.json (SLO_SOAK_DURATION_S).
 soak-slo:
 	$(PY) benchmarks/soak.py --slo-chaos
+
+# Online-learning chaos: one production server with the full loop
+# (ONLINE_LOOP=1) under live load — ledger-mined hard negatives,
+# in-server learner + shadow scoring, gated auto-promotion, injected
+# quality regression forcing auto-rollback, SIGKILL mid-loop, then
+# bit-exact replay across the promotion boundary + the shadow-overhead
+# A/B -> ONLINE_r10.json (ONLINE_SOAK_DURATION_S).
+soak-online:
+	$(PY) benchmarks/soak.py --online-chaos
 
 # Bit-exact decision replay smoke (tier-1-adjacent): score a seeded
 # batch under CHAOS_PLAN (ledger-append faults), replay the ledger with

@@ -262,6 +262,74 @@ def ledger_ab_numbers() -> dict:
     }
 
 
+def shadow_ab_numbers() -> dict:
+    """Shadow-on vs shadow-off e2e arm: the shadow scorer
+    (serve/shadow.py) promises its candidate steps ride a bounded queue
+    OFF the response path — two short identical wire runs, one with a
+    candidate shadow-scoring every batch, must land within noise. The
+    artifact records both throughputs, the ratio, and the shadow's own
+    counters (rows scored/dropped, flip rate) so the promotion loop's
+    serving tax is a measured number. BENCH_SHADOW_AB_S sizes the arms
+    (0 disables)."""
+    from benchmarks.load_gen import run_grpc_load, start_inprocess_server
+
+    duration_s = float(os.environ.get("BENCH_SHADOW_AB_S", 4.0))
+    if duration_s <= 0:
+        return {}
+    rows = int(os.environ.get("BENCH_E2E_ROWS_PER_RPC", 8192))
+    batch = int(os.environ.get("BENCH_E2E_BATCH", 8192))
+    arms = {}
+    shadow_block = None
+    for arm in ("off", "on"):
+        addr, shutdown, engine = start_inprocess_server(batch_size=batch)
+        shadow = None
+        try:
+            if arm == "on":
+                import jax
+
+                from igaming_platform_tpu.models.multitask import (
+                    init_multitask,
+                )
+                from igaming_platform_tpu.serve.shadow import ShadowScorer
+
+                shadow = ShadowScorer(
+                    engine,
+                    {"multitask": init_multitask(jax.random.key(7))})
+                engine.shadow = shadow
+            load = run_grpc_load(addr, duration_s=duration_s,
+                                 rows_per_rpc=rows, concurrency=4)
+            arms[arm] = load["value"]
+            if shadow is not None:
+                shadow.drain(5.0)
+                rep = shadow.report()
+                shadow_block = {
+                    "rows_scored": rep["total"]["rows"],
+                    "rows_dropped": rep["rows_dropped"],
+                    "flip_rate": rep["total"]["flip_rate"],
+                    "score_delta_mean": rep["total"]["score_delta_mean"],
+                }
+        finally:
+            if shadow is not None:
+                shadow.close()
+            shutdown()
+    ratio = arms["on"] / arms["off"] if arms.get("off") else None
+    cores = os.cpu_count() or 1
+    # Same honesty contract as the ledger A/B: the shadow WORKER's device
+    # steps are real compute, and on a 1-core control rig they share the
+    # scoring core, so the flat-out ratio records that bounded tax (the
+    # queue drops cap it; responses are never blocked). On >=2 cores the
+    # worker interleaves and the arm must land within noise.
+    bar = 0.85 if cores >= 2 else 0.45
+    return {
+        "shadow_off_txns_per_sec": arms.get("off"),
+        "shadow_on_txns_per_sec": arms.get("on"),
+        "shadow_overhead_ratio": round(ratio, 4) if ratio else None,
+        "shadow_overhead_within_noise": bool(ratio and ratio >= bar),
+        "shadow_overhead_bar": bar,
+        "shadow_block": shadow_block,
+    }
+
+
 def observability_ab_numbers() -> dict:
     """Observability-overhead A/B: the SLO engine + device-runtime
     telemetry promise O(1)-per-request accounting off the hot path — two
@@ -336,6 +404,10 @@ def main() -> None:
             result.update(observability_ab_numbers())
         except Exception as exc:  # noqa: BLE001 — the A/B arm must not lose the headline
             result["obs_ab_error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            result.update(shadow_ab_numbers())
+        except Exception as exc:  # noqa: BLE001 — the A/B arm must not lose the headline
+            result["shadow_ab_error"] = f"{type(exc).__name__}: {exc}"
         headline = float(result["e2e_txns_per_sec"])
         result.update({
             "metric": "e2e_grpc_fraud_score_txns_per_sec",

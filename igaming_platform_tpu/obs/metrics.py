@@ -562,6 +562,54 @@ class ServiceMetrics:
             "backend's memory_stats — absent on backends that do not "
             "report (CPU)",
         )
+        # Online learning loop (train/online.py, serve/shadow.py,
+        # train/promote.py): shadow-scoring evidence, mined training
+        # examples, and the promotion/rollback event stream.
+        self.shadow_rows_total = self.registry.counter(
+            f"{service}_shadow_rows_total",
+            "Live rows handled by the shadow scorer by {outcome}: scored "
+            "= candidate params re-scored them next to production, "
+            "dropped = the bounded shadow queue was full (production is "
+            "never blocked), skipped = no host feature snapshot "
+            "(index-mode / heuristic-tier rows)",
+        )
+        self.shadow_action_flips_total = self.registry.counter(
+            f"{service}_shadow_action_flips_total",
+            "Shadow-scored rows whose candidate action differs from the "
+            "action production actually took — the numerator of the "
+            "promotion flip-rate gate",
+        )
+        self.shadow_score_divergence = self.registry.histogram(
+            f"{service}_shadow_score_divergence",
+            "Absolute candidate-vs-production risk-score divergence per "
+            "shadow-scored row (0-100 scale)",
+            buckets=(0, 1, 2, 5, 10, 20, 40, 60, 80, 100),
+        )
+        self.online_mined_total = self.registry.counter(
+            f"{service}_online_mined_total",
+            "Training examples mined from the decision WAL by {kind}: "
+            "hard = hard negatives (scored risky, outcome legitimate) "
+            "plus missed fraud, labeled = other outcome-labeled rows",
+        )
+        self.online_train_steps_total = self.registry.counter(
+            f"{service}_online_train_steps_total",
+            "Incremental learner steps taken by the online loop on the "
+            "serving device budget (train/serve coexistence)",
+        )
+        self.promotions_total = self.registry.counter(
+            f"{service}_promotions_total",
+            "Param-set transitions on the serving engine by {event}: "
+            "promote (all gates passed), rollback (post-promotion gate "
+            "regressed), forced_promote / forced_rollback (operator "
+            "knobs) — each also lands a PromotionRecord in the ledger",
+        )
+        self.promotion_gate_failures_total = self.registry.counter(
+            f"{service}_promotion_gate_failures_total",
+            "Candidate promotions held back by {gate} (train/gates.py "
+            "bounds: probe-AUC floor, no-regression margin, shadow "
+            "rows/flip-rate, SLO-quiet) — a persistently failing gate "
+            "is the drift dashboard's first stop",
+        )
         self.spans_dropped_total = self.registry.counter(
             f"{service}_spans_dropped_total",
             "Host spans evicted from the bounded span ring before export "

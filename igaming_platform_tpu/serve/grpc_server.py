@@ -501,10 +501,16 @@ class RiskGrpcService:
         resp = self.engine.score(self._request_from_proto(request))
         self.metrics.score_distribution.observe(resp.score)
         self.metrics.txns_scored_total.inc()
+        trailing: list[tuple[str, str]] = []
         if getattr(resp, "decision_id", ""):
             # Join key across the observability surfaces: the flight
             # entry, the trace root and the ledger record share this id.
+            # Exposed in trailing metadata so label-backfill callers
+            # (the outcome feed posting chargebacks/dispute verdicts to
+            # /debug/outcomes) can reference the decision without a
+            # wire-schema change.
             tracing.set_root_attribute("decision_id", resp.decision_id)
+            trailing.append(("risk-decision-id", resp.decision_id))
         # p99-feedback for the bulk admission gate: the single-txn fast
         # lane's latency is the SLO the gate protects.
         self._bulk_gate.observe_single_ms(resp.response_time_ms)
@@ -512,11 +518,11 @@ class RiskGrpcService:
             # Degraded-tier answer: wire-compatible, but the caller can
             # SEE it — model-version suffix in trailing metadata plus the
             # reason code already on the response (never an error).
-            if context is not None:
-                context.set_trailing_metadata((
-                    ("risk-model-version",
-                     getattr(self.engine, "model_version", "degraded-heuristic")),
-                ))
+            trailing.append((
+                "risk-model-version",
+                getattr(self.engine, "model_version", "degraded-heuristic")))
+        if trailing and context is not None:
+            context.set_trailing_metadata(tuple(trailing))
         return self._score_to_proto(resp)
 
     def ScoreBatch(self, request, context):

@@ -59,6 +59,16 @@ from igaming_platform_tpu.serve import chaos
 logger = logging.getLogger(__name__)
 
 SCHEMA_VERSION = 1
+# Side-record schema versions riding the SAME WAL framing: the version
+# byte doubles as the record-kind tag, so v1 DecisionRecords stay
+# byte-identical (golden-pinned) while outcome backfill (PR 9's label
+# seam) and promotion events append without a schema break. Readers
+# built before a version reject it loudly (LedgerSchemaError), never
+# mis-parse it.
+OUTCOME_SCHEMA_VERSION = 2
+PROMOTION_SCHEMA_VERSION = 3
+_KNOWN_VERSIONS = (SCHEMA_VERSION, OUTCOME_SCHEMA_VERSION,
+                   PROMOTION_SCHEMA_VERSION)
 SEGMENT_MAGIC = b"DLG1"
 _FRAME = struct.Struct("<II")  # payload length, crc32(payload)
 
@@ -321,6 +331,128 @@ def decode_record(payload: bytes) -> DecisionRecord:
 
 
 # ---------------------------------------------------------------------------
+# Side records: outcome backfill + promotion events (v2 / v3 frames)
+
+
+@dataclass(slots=True)
+class OutcomeRecord:
+    """The label-backfill seam: a later-arriving ground-truth outcome for
+    one decision (chargeback, manual-review verdict, cleared dispute,
+    KYC result) joined to its DecisionRecord by ``decision_id``. Miners
+    (train/online.py) and replay read these without any change to the
+    golden-pinned v1 decision frames."""
+
+    decision_id: str
+    label: int  # 0 = legitimate, 1 = fraud
+    source: str  # chargeback | manual_review | dispute_cleared | kyc | ...
+    ts_unix: float
+
+
+_OUTCOME_HEAD = struct.Struct("<Bd")  # label, wall timestamp
+
+
+def encode_outcome(r: OutcomeRecord) -> bytes:
+    return b"".join([
+        bytes([OUTCOME_SCHEMA_VERSION]),
+        _OUTCOME_HEAD.pack(1 if r.label else 0, float(r.ts_unix)),
+        _pack_str(r.decision_id),
+        _pack_str(r.source),
+    ])
+
+
+def decode_outcome(payload: bytes) -> OutcomeRecord:
+    buf = memoryview(payload)
+    if len(buf) < 1 or buf[0] != OUTCOME_SCHEMA_VERSION:
+        raise LedgerSchemaError("not an outcome record")
+    if len(buf) < 1 + _OUTCOME_HEAD.size:
+        raise LedgerSchemaError("outcome record truncated (head)")
+    label, ts = _OUTCOME_HEAD.unpack_from(buf, 1)
+    pos = 1 + _OUTCOME_HEAD.size
+    decision_id, pos = _read_str(buf, pos)
+    source, pos = _read_str(buf, pos)
+    return OutcomeRecord(decision_id=decision_id, label=int(label),
+                         source=source, ts_unix=ts)
+
+
+@dataclass(slots=True)
+class PromotionRecord:
+    """One param-set transition on the serving engine, written by the
+    promotion controller (train/promote.py) through the SAME durable WAL
+    as the decisions it explains — replay resolves which params scored
+    which decision by joining ``params_fp`` across the boundary."""
+
+    event: str  # promote | rollback
+    old_fp: str  # 16 hex chars — the params serving BEFORE the swap
+    new_fp: str  # 16 hex chars — the params serving AFTER the swap
+    model_version: str
+    reason: str
+    gates_json: str  # compact JSON of the gate table at decision time
+    ts_unix: float
+
+
+_PROMO_EVENTS = {"promote": 0, "rollback": 1}
+_PROMO_NAMES = {v: k for k, v in _PROMO_EVENTS.items()}
+_PROMOTION_HEAD = struct.Struct("<Bd8s8s")  # event, ts, old fp, new fp
+
+
+def encode_promotion(r: PromotionRecord) -> bytes:
+    return b"".join([
+        bytes([PROMOTION_SCHEMA_VERSION]),
+        _PROMOTION_HEAD.pack(
+            _PROMO_EVENTS.get(r.event, 0), float(r.ts_unix),
+            bytes.fromhex(r.old_fp), bytes.fromhex(r.new_fp)),
+        _pack_str(r.model_version),
+        _pack_str(r.reason),
+        _pack_str(r.gates_json),
+    ])
+
+
+def decode_promotion(payload: bytes) -> PromotionRecord:
+    buf = memoryview(payload)
+    if len(buf) < 1 or buf[0] != PROMOTION_SCHEMA_VERSION:
+        raise LedgerSchemaError("not a promotion record")
+    if len(buf) < 1 + _PROMOTION_HEAD.size:
+        raise LedgerSchemaError("promotion record truncated (head)")
+    event, ts, old_fp, new_fp = _PROMOTION_HEAD.unpack_from(buf, 1)
+    pos = 1 + _PROMOTION_HEAD.size
+    model_version, pos = _read_str(buf, pos)
+    reason, pos = _read_str(buf, pos)
+    gates_json, pos = _read_str(buf, pos)
+    return PromotionRecord(
+        event=_PROMO_NAMES.get(event, "promote"), old_fp=old_fp.hex(),
+        new_fp=new_fp.hex(), model_version=model_version, reason=reason,
+        gates_json=gates_json, ts_unix=ts)
+
+
+def encode_entry(record) -> bytes:
+    """Any ledger entry -> its versioned wire bytes."""
+    if isinstance(record, DecisionRecord):
+        return encode_record(record)
+    if isinstance(record, OutcomeRecord):
+        return encode_outcome(record)
+    if isinstance(record, PromotionRecord):
+        return encode_promotion(record)
+    raise TypeError(f"not a ledger entry: {type(record).__name__}")
+
+
+def decode_entry(payload: bytes):
+    """Wire bytes -> ("decision" | "outcome" | "promotion", record).
+    A frame from a FUTURE schema version is rejected loudly."""
+    if len(payload) < 1:
+        raise LedgerSchemaError("empty record")
+    version = payload[0]
+    if version == SCHEMA_VERSION:
+        return "decision", decode_record(payload)
+    if version == OUTCOME_SCHEMA_VERSION:
+        return "outcome", decode_outcome(payload)
+    if version == PROMOTION_SCHEMA_VERSION:
+        return "promotion", decode_promotion(payload)
+    raise LedgerSchemaError(
+        f"unknown ledger entry schema version {version} "
+        f"(this build reads {sorted(_KNOWN_VERSIONS)})")
+
+
+# ---------------------------------------------------------------------------
 # WAL segments
 
 
@@ -392,14 +524,38 @@ def ledger_segments(directory: str) -> list[tuple[int, str]]:
     return sorted(out)
 
 
-def iter_records(directory: str):
-    """Yield every decodable DecisionRecord across the directory's
-    segments, in append order. Torn tails stop a segment's scan cleanly
-    (the recovery contract); records from a future schema version raise
-    LedgerSchemaError — an audit read must never silently skip them."""
+def iter_entries(directory: str):
+    """Yield every decodable ("kind", record) entry across the
+    directory's segments, in append order — decisions, outcome
+    backfills, and promotion events interleaved as written. Torn tails
+    stop a segment's scan cleanly (the recovery contract); frames from a
+    future schema version raise LedgerSchemaError — an audit read must
+    never silently skip them."""
     for _seq, path in ledger_segments(directory):
         for payload, _end in iter_segment_frames(path):
-            yield decode_record(payload)
+            yield decode_entry(payload)
+
+
+def iter_records(directory: str):
+    """Yield every decodable DecisionRecord across the directory's
+    segments, in append order. Side records (outcomes, promotions) are
+    skipped — read them via :func:`iter_entries` — but a frame from an
+    UNKNOWN schema version still raises LedgerSchemaError."""
+    for kind, record in iter_entries(directory):
+        if kind == "decision":
+            yield record
+
+
+def iter_outcomes(directory: str):
+    for kind, record in iter_entries(directory):
+        if kind == "outcome":
+            yield record
+
+
+def iter_promotions(directory: str):
+    for kind, record in iter_entries(directory):
+        if kind == "promotion":
+            yield record
 
 
 # ---------------------------------------------------------------------------
@@ -644,6 +800,8 @@ class DecisionLedger:
         # Stats (guarded by _cv).
         self.records_appended = 0
         self.records_dropped = 0
+        self.outcome_records = 0
+        self.promotion_records = 0
         self.append_errors = 0
         self.fsync_count = 0
         self._fsync_ms: deque[float] = deque(maxlen=2048)
@@ -785,6 +943,19 @@ class DecisionLedger:
 
         return self.append_columns(_Ready(records))  # type: ignore[arg-type]
 
+    def append_outcome(self, record: OutcomeRecord) -> bool:
+        """Label backfill (the v2 side-record): durably append a
+        ground-truth outcome for an earlier decision. Same hot-path
+        guarantees as decisions — O(1), never raises, drop-counted."""
+        return self._append_ready([record])
+
+    def append_promotion(self, record: PromotionRecord) -> bool:
+        """Promotion/rollback event (the v3 side-record): the params
+        transition the promotion controller just performed, with both
+        fingerprints — replay joins decisions to the params that scored
+        them across the boundary."""
+        return self._append_ready([record])
+
     # -- writer thread ------------------------------------------------------
 
     def _writer_loop(self) -> None:
@@ -831,7 +1002,7 @@ class DecisionLedger:
                 records = batch.to_records()
                 frames = []
                 for rec in records:
-                    payload = encode_record(rec)
+                    payload = encode_entry(rec)
                     frames.append(_FRAME.pack(len(payload), zlib.crc32(payload))
                                   + payload)
                 chaos.fire("ledger.append")
@@ -892,6 +1063,11 @@ class DecisionLedger:
             seg[3] = count0 + len(records)
             self._durable_count = self._segments[-1][3]
             self.records_appended += len(records)
+            for rec in records:
+                if isinstance(rec, OutcomeRecord):
+                    self.outcome_records += 1
+                elif isinstance(rec, PromotionRecord):
+                    self.promotion_records += 1
             seq = seg[0]
         if self.sink is not None:
             with self._sink_cv:
@@ -946,14 +1122,17 @@ class DecisionLedger:
             logger.warning("ledger sink cursor persist failed", exc_info=True)
 
     def _read_catchup(self, limit: int) -> tuple[list[DecisionRecord], dict]:
-        """Read up to ``limit`` records from the WAL at the cursor (the
-        spill path). Returns (records, new_cursor)."""
+        """Read up to ``limit`` frames from the WAL at the cursor (the
+        spill path). Returns (decision records, new_cursor) — side
+        records (outcomes/promotions) advance the cursor but never ship
+        to the decision sink."""
         cur = dict(self._cursor)
         out: list[DecisionRecord] = []
+        scanned = 0
         with self._cv:
             segments = [tuple(s) for s in self._segments]
         for seq, path, end_offset, end_count in segments:
-            if seq < cur["seq"] or len(out) >= limit:
+            if seq < cur["seq"] or scanned >= limit:
                 continue
             start = cur["offset"] if seq == cur["seq"] else 0
             if start >= end_offset:
@@ -961,10 +1140,13 @@ class DecisionLedger:
             for payload, frame_end in iter_segment_frames(path, start):
                 if frame_end > end_offset:
                     break
-                out.append(decode_record(payload))
+                kind, rec = decode_entry(payload)
+                if kind == "decision":
+                    out.append(rec)
+                scanned += 1
                 cur = {"seq": seq, "offset": frame_end,
                        "count": cur["count"] + 1}
-                if len(out) >= limit:
+                if scanned >= limit:
                     break
         return out, cur
 
@@ -993,6 +1175,12 @@ class DecisionLedger:
             return True
         batch, new_cursor, spilled = self._next_sink_batch()
         if not batch:
+            if new_cursor["count"] > self._cursor["count"]:
+                # A run of side records only: the cursor still advances
+                # (nothing for the sink to send) or the drain livelocks
+                # on a permanent non-zero lag.
+                self._cursor = new_cursor
+                self._persist_cursor()
             return True
         try:
             chaos.fire("ledger.sink")
@@ -1034,10 +1222,15 @@ class DecisionLedger:
             if head_matches:
                 batch: list[DecisionRecord] = []
                 cur = dict(self._cursor)
-                while (self._sink_q and len(batch) < self.sink_batch
+                taken = 0
+                while (self._sink_q and taken < self.sink_batch
                        and self._sink_q[0][0] == cur["count"]):
                     cnt, seq, end_offset, rec = self._sink_q.popleft()
-                    batch.append(rec)
+                    # Side records advance the cursor but never ship to
+                    # the decision sink (their table is the WAL itself).
+                    if isinstance(rec, DecisionRecord):
+                        batch.append(rec)
+                    taken += 1
                     cur = {"seq": seq, "offset": end_offset, "count": cnt + 1}
                 return batch, cur, False
         records, cur = self._read_catchup(self.sink_batch)
@@ -1061,6 +1254,8 @@ class DecisionLedger:
             stats = {
                 "records_appended": self.records_appended,
                 "records_dropped": self.records_dropped,
+                "outcome_records": self.outcome_records,
+                "promotion_records": self.promotion_records,
                 "append_errors": self.append_errors,
                 "queue_rows": self._pending_rows,
                 "fsync_count": self.fsync_count,
@@ -1205,6 +1400,7 @@ def note_decisions(
     amounts=None,
     tx_codes=None,
     model_version: str | None = None,
+    params_fp: str | None = None,
     mark_root: bool = True,
 ) -> str | None:
     """THE DecisionRecord construction seam: every scoring path — device
@@ -1212,7 +1408,18 @@ def note_decisions(
     fallback — funnels its results through here. O(1) on the hot path
     (columnar references handed to the writer thread). Returns the batch
     decision-id prefix (row i is ``<prefix>.<i>``), or None when no
-    ledger is bound. Never raises."""
+    ledger is bound. Never raises.
+
+    A bound shadow scorer (serve/shadow.py, ``engine.shadow``) taps the
+    same seam: compiled-tier batches WITH a feature snapshot are handed
+    to it by reference (its own O(1) bounded enqueue) so candidate
+    params score the live stream without touching any response."""
+    shadow = getattr(engine, "shadow", None)
+    if shadow is not None and n > 0:
+        # Heuristic-tier rows come from a different scorer (not the
+        # compiled graph a candidate would replace) and index-mode rows
+        # have no host snapshot — the shadow counts both as skipped.
+        shadow.submit(out, x=x if tier != "heuristic" else None, bl=bl, n=n)
     ledger = getattr(engine, "ledger", None)
     if ledger is None or n <= 0:
         return None
@@ -1243,7 +1450,13 @@ def note_decisions(
             serving_state=serving_state(),
             wire_mode=wire_mode,
             model_version=model_version or getattr(engine, "ml_backend", "unknown"),
-            params_fp=getattr(engine, "params_fingerprint", "0" * 16),
+            # Callers on the compiled paths pass the fingerprint captured
+            # AT DISPATCH (engine.params_snapshot): with online promotion
+            # a hot-swap can land between the device step and this seam,
+            # and the post-swap fingerprint would be a lie the replay
+            # tool catches as an unreplayable record.
+            params_fp=params_fp or getattr(engine, "params_fingerprint",
+                                           "0" * 16),
             block_threshold=block_thr, review_threshold=review_thr,
             trace_id=trace_id,
         )

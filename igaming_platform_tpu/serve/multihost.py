@@ -769,14 +769,20 @@ def multihost_engine(mesh, follower_ports: list[int], *, batcher_config=None,
                     jax.device_get(self._fn_host(
                         self._params_host, xz, blz, self._thresholds_host))
 
-        def _launch_device(self, x: np.ndarray, bl: np.ndarray):
+        def _launch_device(self, x: np.ndarray, bl: np.ndarray,
+                           snap: tuple | None = None):
+            # ``snap`` (params_snapshot pinning for mid-swap ledger
+            # attribution) is accepted for interface parity; the global
+            # step always serves the channel-synced params — a swap
+            # here re-syncs followers, so per-batch pinning would
+            # desync the mesh.
             n = x.shape[0]
             shape = self._pick_shape(n)
             # The front's host latency tier stays local (no collectives,
             # no follower involvement — a near-empty flush must not pay
             # a DCN round trip).
             if self._fn_host is not None and n <= self._host_tier:
-                return super()._launch_device(x, bl)
+                return super()._launch_device(x, bl, snap)
             xp, _ = pad_batch(np.asarray(x, np.float32), shape)
             blp, _ = pad_batch(np.asarray(bl, bool), shape)
             # Propagate the active trace onto the work channel: the

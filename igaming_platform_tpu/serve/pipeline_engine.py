@@ -62,13 +62,19 @@ class _Job:
 
     __slots__ = ("x", "bl", "include_features", "start", "parent", "total",
                  "n_chunks", "parts", "rtms", "future", "done_chunks",
-                 "account_ids")
+                 "account_ids", "snap")
 
     def __init__(self, x: np.ndarray, bl: np.ndarray, include_features: bool,
-                 start: float, parent, n_chunks: int, account_ids=None):
+                 start: float, parent, n_chunks: int, account_ids=None,
+                 snap=None):
         self.x = x
         self.bl = bl
         self.account_ids = account_ids
+        # Params snapshot (engine.params_snapshot) captured at submit:
+        # every chunk of this job scores with the SAME tree and the
+        # ledger note records the fingerprint that actually scored it,
+        # even when an online promotion hot-swaps params mid-job.
+        self.snap = snap
         self.include_features = include_features
         self.start = start
         self.parent = parent  # originating RPC span (cross-thread anchor)
@@ -242,7 +248,8 @@ class HostPipeline:
         batch = self._engine.batch_size
         n_chunks = (total + batch - 1) // batch
         job = _Job(x, bl, include_features, start,
-                   tracing.current_span(), n_chunks, account_ids=account_ids)
+                   tracing.current_span(), n_chunks, account_ids=account_ids,
+                   snap=self._engine.params_snapshot())
         self._job_enter()
         try:
             for idx, lo in enumerate(range(0, total, batch)):
@@ -278,7 +285,8 @@ class HostPipeline:
 
                 ledger_mod.note_decisions(
                     self._engine, cat, n=job.total, wire_mode="wire_row",
-                    x=job.x, bl=job.bl, account_ids=job.account_ids)
+                    x=job.x, bl=job.bl, account_ids=job.account_ids,
+                    params_fp=job.snap[2] if job.snap else None)
                 return encode_score_batch(
                     cat["score"], cat["action"], cat["reason_mask"],
                     cat["rule_score"], cat["ml_score"], job.rtms,
@@ -308,7 +316,7 @@ class HostPipeline:
             xp, _ = pad_batch(chunk, shape, out=xp_buf)
             bl_buf = self._arena.acquire((shape,), np.bool_)
             blp, _ = pad_batch(blc, shape, out=bl_buf)
-        out = engine._launch_padded(xp, blp, use_host)
+        out = engine._launch_padded(xp, blp, use_host, snap=job.snap)
         return out, xp_buf, bl_buf
 
     def _stage_loop(self) -> None:

@@ -170,6 +170,11 @@ class TPUScoringEngine:
         # ONCE here (and on hot-swap) so records never hash on the hot
         # path.
         self.ledger = None
+        # Shadow scorer (serve/shadow.py): bound by the online-learning
+        # loop; None keeps the seam a single attribute check. Candidate
+        # params score the live stream off the note_decisions seam with
+        # zero effect on responses.
+        self.shadow = None
         self.params_fingerprint = ledger_mod.params_fingerprint(params)
         self.features = feature_store or InMemoryFeatureStore()
         bcfg = batcher_config or BatcherConfig()
@@ -445,14 +450,17 @@ class TPUScoringEngine:
                     self._host_pipeline = pipe
         return self._host_pipeline
 
-    def _launch_padded(self, xp: np.ndarray, blp: np.ndarray, use_host: bool):
+    def _launch_padded(self, xp: np.ndarray, blp: np.ndarray, use_host: bool,
+                       snap: tuple | None = None):
         """Dispatch one already-padded staging batch (pipeline dispatch
         worker). The caller owns the staging buffers and must keep them
         alive until readback — jax may alias host memory zero-copy on
-        the CPU backend."""
-        with self._params_lock:
-            params = self._params_host if use_host else self._params
-            thresholds = self._thresholds_host if use_host else self._thresholds
+        the CPU backend. ``snap`` (params_snapshot) pins the params a
+        multi-chunk job scores with across a concurrent hot-swap."""
+        if snap is None:
+            snap = self.params_snapshot()
+        params = snap[1] if use_host else snap[0]
+        thresholds = self._thresholds_host if use_host else self._thresholds
         if use_host:
             _device_dispatch("packed_step_host", xp.shape, xp.dtype)
             out, _ = self._fn_host(params, xp, blp, thresholds)
@@ -465,9 +473,13 @@ class TPUScoringEngine:
 
     # -- params / thresholds -------------------------------------------------
 
-    def swap_params(self, params: Any) -> None:
+    def swap_params(self, params: Any) -> None:  # analysis: param-swap-seam
         """Atomically install new model parameters (hot-swap from train/).
-        The host latency tier gets its own CPU-committed copy."""
+        The host latency tier gets its own CPU-committed copy. This is
+        THE served-param mutation seam — analyzer rule CC07 flags any
+        write to the served tree outside it, because a bare rebind skips
+        the fingerprint refresh (breaking ledger attribution + replay)
+        and the host-tier copy (splitting the tiers' models)."""
         params_host = (
             jax.device_put(params, self._host_cpu) if self._fn_host is not None else None
         )
@@ -477,6 +489,22 @@ class TPUScoringEngine:
             self.params_fingerprint = fingerprint
             if self._fn_host is not None:
                 self._params_host = params_host
+
+    def get_params(self) -> Any:
+        """Snapshot the live served params (promotion controller /
+        vault). Read-only: mutation goes through swap_params (CC07)."""
+        with self._params_lock:
+            return self._params
+
+    def params_snapshot(self) -> tuple[Any, Any, str]:
+        """(params, params_host, fingerprint) captured atomically. A
+        batch dispatched from one snapshot must LEDGER the fingerprint
+        of the tree that actually scored it — with online promotion a
+        hot-swap can land between dispatch and the note_decisions seam,
+        and a record stamped with the post-swap fingerprint would be
+        silently unreplayable."""
+        with self._params_lock:
+            return self._params, self._params_host, self.params_fingerprint
 
     def get_thresholds(self) -> tuple[int, int]:
         t = self._thresholds
@@ -591,7 +619,8 @@ class TPUScoringEngine:
         return cache
 
     def _launch_cached(self, idxs: np.ndarray, amounts: np.ndarray,
-                       types: np.ndarray, bl: np.ndarray):
+                       types: np.ndarray, bl: np.ndarray,
+                       snap: tuple | None = None):
         """Dispatch the cached score step: the device gathers rows from
         the HBM-resident table; only int32 indices + per-txn context
         cross the link. Pad rows index slot 0 — scored and discarded,
@@ -602,8 +631,9 @@ class TPUScoringEngine:
         amtp, _ = pad_batch(amounts, shape)
         typp, _ = pad_batch(types, shape)
         blp, _ = pad_batch(bl, shape)
-        with self._params_lock:
-            params = self._params
+        if snap is None:
+            snap = self.params_snapshot()
+        params = snap[0]
         _device_dispatch("cached_step", idxsp.shape, idxsp.dtype)
         out = self._cached_fn(
             params, self.cache.table, self.cache.flags,
@@ -649,6 +679,7 @@ class TPUScoringEngine:
         parts: dict[str, list[np.ndarray]] = {k: [] for k in keys}
         rtms = np.empty((total,), dtype=np.int64)
         inflight: deque = deque()
+        snap = self.params_snapshot()
 
         def read_one() -> None:
             out, lo, n = inflight.popleft()
@@ -664,7 +695,7 @@ class TPUScoringEngine:
                 idxs = self.cache.lookup(account_ids[lo:hi], now=now)
             with span("score.dispatch", batch=hi - lo), annotate("score_step"):
                 out, n = self._launch_cached(
-                    idxs, amounts32[lo:hi], types32[lo:hi], bl[lo:hi])
+                    idxs, amounts32[lo:hi], types32[lo:hi], bl[lo:hi], snap)
             inflight.append((out, lo, n))
             if len(inflight) > self._pipeline_depth:
                 read_one()
@@ -683,7 +714,7 @@ class TPUScoringEngine:
         ledger_mod.note_decisions(
             self, cat, n=total, wire_mode="index", tier="device",
             bl=bl, account_ids=account_ids, amounts=amounts32,
-            tx_codes=types32)
+            tx_codes=types32, params_fp=snap[2])
         return cat, rtms
 
     def score_columns_cached(
@@ -741,23 +772,26 @@ class TPUScoringEngine:
             chunk = reqs[start : start + self.batch_size]
             with span("score.gather", batch=len(chunk)):
                 x, bl = self.features.gather_batch(chunk)
+            snap = self.params_snapshot()
             with span("score.device", batch=len(chunk)), annotate("score_step"):
-                out, n = self._run_device(x, bl)
+                out, n = self._run_device(x, bl, snap)
             rows = [self._row_response(out, x, i) for i in range(n)]
-            self._note_decisions_requests(out, x, bl, chunk, rows, "batch")
+            self._note_decisions_requests(out, x, bl, chunk, rows, "batch",
+                                          params_fp=snap[2])
             responses.extend(rows)
         return responses
 
     def _note_decisions_requests(self, out, x, bl, reqs, responses,
-                                 wire_mode: str) -> None:
+                                 wire_mode: str,
+                                 params_fp: str | None = None) -> None:
         """Ledger seam for the request-object paths (batcher / direct
         batch): one columnar note per device batch, decision ids stamped
-        back onto the responses. No-op without a bound ledger."""
-        if self.ledger is None:
+        back onto the responses. No-op without a bound ledger or shadow."""
+        if self.ledger is None and self.shadow is None:
             return
         prefix = ledger_mod.note_decisions(
             self, out, n=len(responses), wire_mode=wire_mode,
-            x=x, bl=bl,
+            x=x, bl=bl, params_fp=params_fp,
             account_ids=[r.account_id for r in reqs],
             amounts=[r.amount for r in reqs],
             tx_codes=[r.tx_type for r in reqs],
@@ -766,8 +800,9 @@ class TPUScoringEngine:
             for i, resp in enumerate(responses):
                 resp.decision_id = f"{prefix}.{i}"
 
-    def _run_device(self, x: np.ndarray, bl: np.ndarray):
-        out, n = self._launch_device(x, bl)
+    def _run_device(self, x: np.ndarray, bl: np.ndarray,
+                    snap: tuple | None = None):
+        out, n = self._launch_device(x, bl, snap)
         return _unpack_host(_device_readback(out)), n
 
     def _pick_shape(self, n: int) -> int:
@@ -777,7 +812,8 @@ class TPUScoringEngine:
                 return shape
         return self.batch_size
 
-    def _launch_device(self, x: np.ndarray, bl: np.ndarray):
+    def _launch_device(self, x: np.ndarray, bl: np.ndarray,
+                       snap: tuple | None = None):
         """Dispatch the compiled step and start the async D2H copy of the
         packed int32 [5, B] result WITHOUT blocking on readback — one
         transfer, not five (readback cost is per-array, not per-byte, at
@@ -794,11 +830,12 @@ class TPUScoringEngine:
             x = self._wire_encode(x)
         xp, _ = pad_batch(x, shape)
         blp, _ = pad_batch(bl, shape)
-        with self._params_lock:
+        if snap is None:
             # Snapshot under the lock, dispatch outside it — scoring must
             # never serialize on the params mutex.
-            params = self._params_host if use_host else self._params
-            thresholds = self._thresholds_host if use_host else self._thresholds
+            snap = self.params_snapshot()
+        params = snap[1] if use_host else snap[0]
+        thresholds = self._thresholds_host if use_host else self._thresholds
         if use_host:
             _device_dispatch("packed_step_host", xp.shape, xp.dtype)
             out, _ = self._fn_host(params, xp, blp, thresholds)
@@ -831,16 +868,18 @@ class TPUScoringEngine:
         # (engine.go:326-417, :277-288) as host timeline segments.
         with span("score.gather", batch=len(reqs)):
             x, bl = self.features.gather_batch(reqs)
+        snap = self.params_snapshot()
         with span("score.dispatch", batch=len(reqs)), annotate("score_step"):
-            out, n = self._launch_device(x, bl)
-        return out, x, bl, n, reqs
+            out, n = self._launch_device(x, bl, snap)
+        return out, x, bl, n, reqs, snap
 
     def _collect_requests(self, handle) -> list[ScoreResponse]:
-        out, x, bl, n, reqs = handle
+        out, x, bl, n, reqs, snap = handle
         with span("score.readback", batch=n):
             host = _unpack_host(_device_readback(out))
         rows = [self._row_response(host, x, i) for i in range(n)]
-        self._note_decisions_requests(host, x, bl, reqs, rows, "single")
+        self._note_decisions_requests(host, x, bl, reqs, rows, "single",
+                                      params_fp=snap[2])
         return rows
 
     def _row_response(self, out: dict, x: np.ndarray, i: int) -> ScoreResponse:
@@ -988,10 +1027,11 @@ class TPUScoringEngine:
                 parts[k].append(host[k][:n])
             rtms[lo : lo + n] = int((time.monotonic() - start) * 1000.0)
 
+        snap = self.params_snapshot()
         for lo in range(0, total, self.batch_size):
             hi = min(lo + self.batch_size, total)
             with span("score.dispatch", batch=hi - lo), annotate("score_step"):
-                out, n = self._launch_device(x[lo:hi], bl[lo:hi])
+                out, n = self._launch_device(x[lo:hi], bl[lo:hi], snap)
             inflight.append((out, lo, n))
             if len(inflight) > self._pipeline_depth:
                 read_one()
@@ -1013,7 +1053,7 @@ class TPUScoringEngine:
                     )
         ledger_mod.note_decisions(
             self, cat, n=total, wire_mode="wire_row", x=x, bl=bl,
-            account_ids=account_ids)
+            account_ids=account_ids, params_fp=snap[2])
         with span("score.encode", batch=total):
             return encode_score_batch(
                 cat["score"], cat["action"], cat["reason_mask"], cat["rule_score"],

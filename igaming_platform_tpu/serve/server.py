@@ -283,6 +283,46 @@ class RiskServer:
                 ledger_mod.set_state_provider(lambda: self.supervisor.state)
             logger.info("decision ledger at %s (sink=%s)", ledger_dir,
                         os.environ.get("LEDGER_SINK", "none") or "none")
+        # Online learning loop (ONLINE_LOOP=1 opts in): a miner tails
+        # the decision WAL for outcome-labeled hard examples, a learner
+        # trains the multitask net incrementally on the same device
+        # budget, a shadow scorer runs the candidate next to production,
+        # and the promotion controller hot-swaps it in (and back out)
+        # through the gates in train/gates.py. Config errors fail the
+        # boot loudly — a silently-disabled learning loop is drift's
+        # best friend.
+        self.online = None
+        if os.environ.get("ONLINE_LOOP", "") == "1":
+            if self.ledger is None:
+                raise RuntimeError(
+                    "ONLINE_LOOP=1 requires LEDGER_DIR: the miner tails "
+                    "the decision WAL for labeled hard examples")
+            inner_engine = getattr(self.engine, "inner", self.engine)
+            if getattr(inner_engine, "ml_backend", "") != "multitask":
+                raise RuntimeError(
+                    "ONLINE_LOOP=1 requires the trainable multitask "
+                    "backend (ML_BACKEND=multitask); got "
+                    f"{getattr(inner_engine, 'ml_backend', None)!r}")
+            from igaming_platform_tpu.serve.shadow import ShadowScorer
+            from igaming_platform_tpu.train.online import (
+                LedgerMiner,
+                OnlineLearner,
+                OnlineLoop,
+            )
+            from igaming_platform_tpu.train.promote import PromotionController
+
+            shadow = ShadowScorer(self.engine, metrics=self.metrics)
+            inner_engine.shadow = shadow
+            controller = PromotionController(
+                self.engine, shadow, ledger=self.ledger,
+                vault_dir=os.path.join(ledger_dir, "params-vault"),
+                metrics=self.metrics)
+            self.online = OnlineLoop(
+                miner=LedgerMiner(ledger_dir, metrics=self.metrics),
+                learner=OnlineLearner(metrics=self.metrics),
+                shadow=shadow, controller=controller).start()
+            logger.info("online learning loop up (tick=%.1fs)",
+                        self.online.tick_s)
         self.http_server, self.http_port = self._start_http(
             http_port if http_port is not None else self.config.http_port
         )
@@ -509,6 +549,22 @@ class RiskServer:
                         self._send(404, '{"error":"ledger disabled"}')
                         return
                     self._send(200, json.dumps(led.stats()))
+                elif self.path == "/debug/shadowz":
+                    # Online-learning loop: shadow divergence/flip-rate
+                    # evidence, miner/learner progress, promotion
+                    # history + gate tables (runbook: docs/operations.md
+                    # "Online learning & promotion").
+                    online = getattr(server_ref, "online", None)
+                    if online is not None:
+                        self._send(200, json.dumps(online.report()))
+                        return
+                    inner = getattr(server_ref.engine, "inner",
+                                    server_ref.engine)
+                    shadow = getattr(inner, "shadow", None)
+                    if shadow is None:
+                        self._send(404, '{"error":"online loop disabled"}')
+                        return
+                    self._send(200, json.dumps({"shadow": shadow.report()}))
                 elif self.path == "/debug/flightz":
                     # Flight recorder: last N requests, each decomposed
                     # into stage durations with its trace id — the first
@@ -564,6 +620,67 @@ class RiskServer:
                         int(payload.get("block", 80)), int(payload.get("review", 50))
                     )
                     self._send(200, '{"ok":true}')
+                elif self.path == "/debug/promotion":
+                    # Promotion-controller knobs (runbook): {"action":
+                    # "pause"|"resume"|"rollback"|"tick"|
+                    # "inject_regression"}. The drill knob exists so the
+                    # auto-rollback path is rehearsed, not hoped for.
+                    online = getattr(server_ref, "online", None)
+                    if online is None:
+                        self._send(404, '{"error":"online loop disabled"}')
+                        return
+                    ctl = online.controller
+                    action = str(payload.get("action", ""))
+                    try:
+                        if action == "pause":
+                            ctl.pause()
+                        elif action == "resume":
+                            ctl.resume()
+                        elif action == "rollback":
+                            ctl.force_rollback(
+                                str(payload.get("reason",
+                                                "operator /debug/promotion")))
+                        elif action == "inject_regression":
+                            ctl.inject_regression()
+                        elif action == "tick":
+                            online.tick()
+                        else:
+                            raise ValueError(
+                                f"unknown promotion action {action!r} (use "
+                                "pause|resume|rollback|inject_regression|tick)")
+                    except ValueError as exc:
+                        self._send(400, json.dumps({"error": str(exc)}))
+                        return
+                    self._send(200, json.dumps(ctl.report()))
+                elif self.path == "/debug/outcomes":
+                    # Label backfill (the v2 ledger side-record): the
+                    # operational entry for ground-truth outcomes —
+                    # chargebacks, manual-review verdicts, cleared
+                    # disputes — joined to decisions by decision_id.
+                    led = getattr(server_ref, "ledger", None)
+                    if led is None:
+                        self._send(404, '{"error":"ledger disabled"}')
+                        return
+                    from igaming_platform_tpu.serve import (
+                        ledger as _ledger_mod,
+                    )
+
+                    rows = payload.get("outcomes")
+                    if rows is None:
+                        rows = [payload]
+                    accepted = 0
+                    for row in rows:
+                        did = str(row.get("decision_id", ""))
+                        if not did:
+                            continue
+                        if led.append_outcome(_ledger_mod.OutcomeRecord(
+                                decision_id=did,
+                                label=1 if row.get("label") else 0,
+                                source=str(row.get("source", "manual")),
+                                ts_unix=_ledger_mod.wall_clock())):
+                            accepted += 1
+                    self._send(200, json.dumps({"accepted": accepted,
+                                                "submitted": len(rows)}))
                 elif self.path == "/debug/score":
                     resp = server_ref.engine.score(ScoreRequest(
                         account_id=str(payload.get("account_id", "debug")),
@@ -596,6 +713,10 @@ class RiskServer:
         engine drain rides graceful_stop so admitted requests finish
         against a live engine — SIGTERM under load loses zero of them."""
         self._stopped.set()
+        if self.online is not None:
+            # Stop the learner/promotion ticker before the drain: a
+            # mid-shutdown hot-swap has nothing left to serve with.
+            self.online.stop()
         if self.batch_refresh is not None:
             self.batch_refresh.stop()
         self.bridge.stop()

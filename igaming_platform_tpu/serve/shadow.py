@@ -1,0 +1,330 @@
+"""Shadow scoring — candidate params score the live stream, risk-free.
+
+The online-learning loop (ROADMAP item 4, the Podracer same-pod shape)
+needs live evidence about a candidate param set BEFORE it serves: how
+often would it have changed the action production just took, and by how
+much do its scores diverge. This module provides that evidence with a
+hard guarantee: **the shadow path can never alter, delay, or fail a
+production response.**
+
+Mechanics:
+
+- The production scoring paths already funnel every compiled-tier batch
+  through the ledger seam (``serve/ledger.note_decisions``); a bound
+  :class:`ShadowScorer` (``engine.shadow``) taps the same seam with an
+  O(1) bounded enqueue of columnar references — full queue drops the
+  batch (counted), it never blocks.
+- A single shadow worker thread scores queued batches through its OWN
+  jitted copy of the serving graph (same ``make_score_fn`` composition,
+  same padded shape ladder) with the CANDIDATE params — so shadow steps
+  interleave with production steps on the same device budget, the
+  train+serve coexistence this PR exists to stress.
+- Per-batch comparison against the production outputs (carried by
+  reference alongside the inputs) accumulates score divergence,
+  action-flip counts (by direction), and rolling window stats the
+  promotion controller (train/promote.py) reads; ``report()`` is the
+  ``/debug/shadowz`` payload.
+
+Bit-exactness contract (pinned by tests/test_online_promotion.py): the
+shadow's outputs for a batch equal offline scoring of the same rows with
+the same candidate params — same graph, same padding, same dtype — so a
+promotion decision based on shadow evidence is a decision about exactly
+the program that will serve.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+import numpy as np
+
+from igaming_platform_tpu.serve import ledger as ledger_mod
+
+logger = logging.getLogger(__name__)
+
+_ACTION_NAMES = {1: "approve", 2: "review", 3: "block"}
+
+
+def _new_stats() -> dict:
+    return {
+        "batches": 0,
+        "rows": 0,
+        "flips": 0,
+        "flips_by_direction": {},
+        "score_delta_sum": 0.0,
+        "score_delta_max": 0,
+        "ml_delta_sum": 0.0,
+        "ml_delta_max": 0.0,
+    }
+
+
+class ShadowScorer:
+    """Score the live stream with candidate params next to production.
+
+    ``submit`` is the only hot-path entry (called from the ledger seam):
+    it appends column references to a bounded deque under a short lock
+    and returns — it NEVER raises and NEVER blocks. Everything else
+    (padding, the device step, the diff) happens on the shadow worker
+    thread.
+    """
+
+    def __init__(self, engine, candidate_params: Any = None, *,
+                 backend: str | None = None,
+                 queue_max_rows: int | None = None,
+                 metrics=None,
+                 on_result: Callable[[dict, dict, int], None] | None = None):
+        import jax
+
+        from igaming_platform_tpu.models.ensemble import make_score_fn
+        from igaming_platform_tpu.serve.scorer import _pack_outputs
+
+        self._engine = engine
+        self.backend = backend or getattr(engine, "ml_backend", "mock")
+        # The shadow compiles the SAME graph composition as serving —
+        # promotion evidence must be about the program that will serve.
+        # (Unsharded: the shadow rides the default device even when the
+        # production step spans a mesh; candidate params are host trees.)
+        self._fn = jax.jit(_pack_outputs(
+            make_score_fn(engine.config, self.backend)))
+        self._candidate = candidate_params
+        self.candidate_fp = ledger_mod.params_fingerprint(candidate_params)
+        self.queue_max_rows = queue_max_rows or int(
+            os.environ.get("SHADOW_QUEUE_MAX_ROWS", "16384"))
+        self._metrics = metrics
+        # Test/controller hook: called as (candidate_out, production_out,
+        # n) after each shadow batch, on the worker thread.
+        self.on_result = on_result
+
+        self._cv = threading.Condition()
+        self._pending: deque = deque()
+        self._pending_rows = 0
+        self._working = False  # worker holds a popped batch in hand
+        self._stopping = False
+        self._generation = 0  # bumped on set_candidate: stale batches drop
+
+        # Stats (guarded by _cv): lifetime + a resettable window the
+        # promotion controller reads (reset on every candidate change).
+        self.total = _new_stats()
+        self.window = _new_stats()
+        self.rows_dropped = 0
+        self.rows_skipped_no_snapshot = 0
+        self.errors = 0
+        self._started_at = time.monotonic()
+        self._last_scored_at: float | None = None
+
+        self._thread = threading.Thread(
+            target=self._worker, name="shadow-scorer", daemon=True)
+        self._thread.start()
+
+    # -- hot-path entry ------------------------------------------------------
+
+    def submit(self, out: dict, *, x: np.ndarray | None,
+               bl: np.ndarray | None, n: int) -> bool:
+        """Enqueue one production-scored batch for shadow scoring. O(1);
+        never raises; returns False when dropped (no snapshot, stopped,
+        queue full, or no candidate yet)."""
+        try:
+            if x is None:
+                with self._cv:
+                    self.rows_skipped_no_snapshot += n
+                return False
+            with self._cv:
+                if (self._stopping or self._candidate is None
+                        or self._pending_rows + n > self.queue_max_rows):
+                    self.rows_dropped += n
+                    dropped = True
+                else:
+                    thresholds = np.asarray(self._engine._thresholds,
+                                            dtype=np.int32)
+                    self._pending.append(
+                        (self._generation, out, x, bl, n, thresholds))
+                    self._pending_rows += n
+                    dropped = False
+                    self._cv.notify()
+            if dropped and self._metrics is not None:
+                self._metrics.shadow_rows_total.inc(n, outcome="dropped")
+            return not dropped
+        except Exception:  # noqa: CC04 — the shadow must never fail scoring; drops are visible in its own report
+            logger.warning("shadow submit failed", exc_info=True)
+            return False
+
+    # -- candidate management ------------------------------------------------
+
+    def set_candidate(self, params: Any) -> str:
+        """Install a new candidate param tree; resets the evidence window
+        (old-candidate batches still queued are dropped as stale).
+        Returns the new candidate fingerprint."""
+        fp = ledger_mod.params_fingerprint(params)
+        with self._cv:
+            self._candidate = params
+            self.candidate_fp = fp
+            self._generation += 1
+            self.window = _new_stats()
+        return fp
+
+    @property
+    def candidate_params(self) -> Any:
+        with self._cv:
+            return self._candidate
+
+    def window_rows(self) -> int:
+        with self._cv:
+            return self.window["rows"]
+
+    def flip_rate(self) -> float:
+        """Action-flip fraction over the CURRENT candidate's window."""
+        with self._cv:
+            rows = self.window["rows"]
+            return self.window["flips"] / rows if rows else 0.0
+
+    # -- worker --------------------------------------------------------------
+
+    def _worker(self) -> None:
+        from igaming_platform_tpu.serve.batcher import pad_batch
+
+        while True:
+            with self._cv:
+                while not self._pending and not self._stopping:
+                    self._cv.wait(timeout=0.1)
+                if self._stopping and not self._pending:
+                    return
+                gen, out, x, bl, n, thresholds = self._pending.popleft()
+                self._pending_rows -= n
+                params = self._candidate
+                current_gen = self._generation
+                self._working = True
+            try:
+                if gen == current_gen and params is not None:
+                    cand = self._score(params, x, bl, n, thresholds,
+                                       pad_batch)
+                    self._diff(out, cand, n)
+                    hook = self.on_result
+                    if hook is not None:
+                        hook(cand, out, n)
+            except Exception:  # noqa: CC04 — shadow failures are counted below, never surface to serving
+                with self._cv:
+                    self.errors += 1
+                logger.warning("shadow scoring failed (batch of %d rows "
+                               "skipped)", n, exc_info=True)
+            finally:
+                with self._cv:
+                    self._working = False
+
+    def _score(self, params, x, bl, n, thresholds, pad_batch) -> dict:
+        """One candidate device step over the production rows, padded to
+        the engine's compiled shape ladder (same padding discipline as
+        serving — bit-exact vs offline scoring of the same rows)."""
+        import jax
+
+        from igaming_platform_tpu.serve.scorer import _unpack_host
+
+        x32 = np.ascontiguousarray(x[:n], dtype=np.float32)
+        blv = (np.ascontiguousarray(bl[:n], dtype=bool) if bl is not None
+               else np.zeros((n,), dtype=bool))
+        shape = self._engine._pick_shape(n)
+        xp, _ = pad_batch(x32, shape)
+        blp, _ = pad_batch(blv, shape)
+        packed = jax.device_get(self._fn(params, xp, blp, thresholds))
+        host = _unpack_host(packed)
+        return {k: v[:n] for k, v in host.items()}
+
+    def _diff(self, prod: dict, cand: dict, n: int) -> None:
+        prod_action = np.asarray(prod["action"][:n], dtype=np.int64)
+        cand_action = np.asarray(cand["action"], dtype=np.int64)
+        flips = prod_action != cand_action
+        flip_count = int(flips.sum())
+        d_score = np.abs(np.asarray(prod["score"][:n], np.int64)
+                         - np.asarray(cand["score"], np.int64))
+        d_ml = np.abs(np.asarray(prod["ml_score"][:n], np.float64)
+                      - np.asarray(cand["ml_score"], np.float64))
+        directions: dict[str, int] = {}
+        if flip_count:
+            for p, c in zip(prod_action[flips], cand_action[flips]):
+                key = (f"{_ACTION_NAMES.get(int(p), int(p))}->"
+                       f"{_ACTION_NAMES.get(int(c), int(c))}")
+                directions[key] = directions.get(key, 0) + 1
+        with self._cv:
+            for stats in (self.total, self.window):
+                stats["batches"] += 1
+                stats["rows"] += n
+                stats["flips"] += flip_count
+                stats["score_delta_sum"] += float(d_score.sum())
+                stats["score_delta_max"] = max(stats["score_delta_max"],
+                                               int(d_score.max(initial=0)))
+                stats["ml_delta_sum"] += float(d_ml.sum())
+                stats["ml_delta_max"] = max(stats["ml_delta_max"],
+                                            float(d_ml.max(initial=0.0)))
+                for key, c in directions.items():
+                    by_dir = stats["flips_by_direction"]
+                    by_dir[key] = by_dir.get(key, 0) + c
+            self._last_scored_at = time.monotonic()
+        if self._metrics is not None:
+            self._metrics.shadow_rows_total.inc(n, outcome="scored")
+            if flip_count:
+                self._metrics.shadow_action_flips_total.inc(flip_count)
+            self._metrics.shadow_score_divergence.observe_many(d_score)
+
+    # -- reporting / lifecycle -----------------------------------------------
+
+    @staticmethod
+    def _stats_view(stats: dict) -> dict:
+        rows = stats["rows"]
+        return {
+            "batches": stats["batches"],
+            "rows": rows,
+            "action_flips": stats["flips"],
+            "flip_rate": round(stats["flips"] / rows, 6) if rows else 0.0,
+            "flips_by_direction": dict(stats["flips_by_direction"]),
+            "score_delta_mean": (round(stats["score_delta_sum"] / rows, 4)
+                                 if rows else 0.0),
+            "score_delta_max": stats["score_delta_max"],
+            "ml_delta_mean": (round(stats["ml_delta_sum"] / rows, 6)
+                              if rows else 0.0),
+            "ml_delta_max": round(stats["ml_delta_max"], 6),
+        }
+
+    def report(self) -> dict:
+        """The shadow half of the ``/debug/shadowz`` payload."""
+        with self._cv:
+            total = self._stats_view(self.total)
+            window = self._stats_view(self.window)
+            snap = {
+                "backend": self.backend,
+                "candidate_fp": self.candidate_fp,
+                "production_fp": getattr(self._engine, "params_fingerprint",
+                                         None),
+                "queue_rows": self._pending_rows,
+                "queue_max_rows": self.queue_max_rows,
+                "rows_dropped": self.rows_dropped,
+                "rows_skipped_no_snapshot": self.rows_skipped_no_snapshot,
+                "errors": self.errors,
+                "uptime_s": round(time.monotonic() - self._started_at, 3),
+                "last_scored_age_s": (
+                    round(time.monotonic() - self._last_scored_at, 3)
+                    if self._last_scored_at is not None else None),
+            }
+        snap["total"] = total
+        snap["window"] = window
+        return snap
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Wait until every queued batch has been shadow-scored (tests /
+        controller ticks). Returns False on timeout."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._cv:
+                if not self._pending and not self._working:
+                    return True
+            time.sleep(0.005)
+        return False
+
+    def close(self) -> None:
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        self._thread.join(timeout=10.0)

@@ -919,6 +919,9 @@ class SupervisedScoringEngine:
         # The decision ledger survives a rebuild: the WAL must not lose
         # the decisions of a freshly-healed engine.
         new.ledger = getattr(old, "ledger", None)
+        # So does the shadow scorer — the online loop keeps accumulating
+        # candidate evidence against the rebuilt engine's stream.
+        new.shadow = getattr(old, "shadow", None)
         old_b = getattr(old, "_batcher", None)
         new_b = getattr(new, "_batcher", None)
         if old_b is not None and new_b is not None:

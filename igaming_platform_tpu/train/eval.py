@@ -30,6 +30,7 @@ import numpy as np
 
 from igaming_platform_tpu.core.config import ScoringConfig
 from igaming_platform_tpu.core.features import normalize, standardize_for_model
+from igaming_platform_tpu.train import gates as gates_mod
 from igaming_platform_tpu.train.fraudgen import KIND_NAMES, generate_labeled
 
 # ---------------------------------------------------------------------------
@@ -249,11 +250,10 @@ def run_eval(
         },
         "models": models,
         "trained_ensemble_recall_at_review": per_kind,
-        "ordering": {
-            "trained_beats_mock": models["multitask_trained"]["auc"] > models["mock"]["auc"],
-            "mock_beats_rules": models["mock"]["auc"] > models["rules_only"]["auc"],
-            "gbdt_beats_mock": models["gbdt_trained"]["auc"] > models["mock"]["auc"],
-        },
+        # Gate definitions live in train/gates.py (ONE source of truth
+        # shared with the promotion controller and the soak gate checks).
+        "ordering": gates_mod.ordering_gates(models),
+        "gates": gates_mod.eval_gates(models),
     }
     return result
 

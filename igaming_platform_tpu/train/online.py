@@ -1,0 +1,366 @@
+"""Online learning: mine the decision WAL, train incrementally, in-pod.
+
+Closes ROADMAP item 4's first arc: the scoring stream feeds a learner on
+the SAME device budget as serving (the Podracer same-pod shape,
+PAPERS.md) instead of an offline train->export->redeploy cycle.
+
+- :class:`LedgerMiner` tails the durable decision WAL (serve/ledger.py
+  segments — the same bytes the auditor reads) with an incremental
+  cursor, joining v2 **outcome side-records** (the label-backfill seam:
+  ``decision_id -> label, source``) to the v1 decisions' feature
+  snapshots. The yield is labeled training examples with the ones that
+  matter flagged: **hard negatives** (the model scored it risky, ground
+  truth says legitimate — the false positives that cost real customers)
+  and **hard positives** (missed fraud).
+- :class:`OnlineLearner` feeds those into the existing multitask trainer
+  (train/trainer.py) incrementally: each step's batch mixes mined
+  examples (hard ones oversampled) with fresh synthetic base traffic
+  (train/fraudgen.py) so a thin mined stream never collapses the model
+  onto a few disputed rows (catastrophic forgetting guard).
+- :class:`OnlineLoop` is the orchestration ticker: mine -> train ->
+  hand the candidate to the shadow scorer (serve/shadow.py) -> run the
+  promotion controller's tick (train/promote.py). One thread, bounded
+  work per tick, report() feeds ``/debug/shadowz``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from igaming_platform_tpu.serve import ledger as ledger_mod
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class MinedExamples:
+    """One miner pass's yield: labeled rows + provenance counters."""
+
+    x: np.ndarray  # [n, NUM_FEATURES] float32 snapshots
+    y: np.ndarray  # [n] float32 labels (0 legit / 1 fraud)
+    hard: np.ndarray  # [n] bool — hard negative OR hard positive
+    decision_ids: list = field(default_factory=list)
+    counts: dict = field(default_factory=dict)
+
+    @property
+    def n(self) -> int:
+        return int(self.x.shape[0])
+
+
+class LedgerMiner:
+    """Incremental hard-example miner over a decision-ledger directory.
+
+    ``poll()`` scans only frames appended since the last call (cursor =
+    segment seq + byte offset, the WAL's own recovery discipline), so
+    tailing a live ledger is O(new frames). Decisions carrying a feature
+    snapshot are stashed in a bounded pending window awaiting their
+    outcome; outcomes join by decision id and emit labeled examples.
+    """
+
+    def __init__(self, directory: str, *, pending_max: int | None = None,
+                 metrics=None):
+        self.directory = directory
+        self.pending_max = pending_max or int(
+            os.environ.get("MINER_PENDING_MAX", "65536"))
+        self._metrics = metrics
+        self._cursor = {"seq": -1, "offset": 0}
+        # decision_id -> (features, score, review_threshold) awaiting an
+        # outcome; insertion-ordered so eviction drops the oldest.
+        self._pending: OrderedDict[str, tuple] = OrderedDict()
+        self.stats = {
+            "frames_scanned": 0,
+            "decisions_seen": 0,
+            "decisions_snapshotless": 0,
+            "outcomes_seen": 0,
+            "outcomes_unmatched": 0,
+            "mined_total": 0,
+            "hard_negatives": 0,
+            "hard_positives": 0,
+            "pending_evicted": 0,
+            "promotions_seen": 0,
+        }
+
+    def _new_frames(self):
+        """Frames appended since the cursor, advancing it."""
+        cur = self._cursor
+        for seq, path in ledger_mod.ledger_segments(self.directory):
+            if seq < cur["seq"]:
+                continue
+            start = cur["offset"] if seq == cur["seq"] else 0
+            for payload, end in ledger_mod.iter_segment_frames(path, start):
+                yield payload
+                cur["seq"], cur["offset"] = seq, end
+
+    def poll(self) -> MinedExamples:
+        """Mine every frame appended since the last poll."""
+        xs: list[np.ndarray] = []
+        ys: list[float] = []
+        hard: list[bool] = []
+        ids: list[str] = []
+        s = self.stats
+        for payload in self._new_frames():
+            s["frames_scanned"] += 1
+            try:
+                kind, rec = ledger_mod.decode_entry(payload)
+            except ledger_mod.LedgerSchemaError:
+                logger.warning("miner: undecodable ledger frame skipped",
+                               exc_info=True)
+                continue
+            if kind == "decision":
+                s["decisions_seen"] += 1
+                if rec.features is None:
+                    s["decisions_snapshotless"] += 1
+                    continue
+                self._pending[rec.decision_id] = (
+                    rec.features, rec.score, rec.review_threshold)
+                while len(self._pending) > self.pending_max:
+                    self._pending.popitem(last=False)
+                    s["pending_evicted"] += 1
+            elif kind == "promotion":
+                s["promotions_seen"] += 1
+            elif kind == "outcome":
+                s["outcomes_seen"] += 1
+                pend = self._pending.pop(rec.decision_id, None)
+                if pend is None:
+                    s["outcomes_unmatched"] += 1
+                    continue
+                features, score, review_thr = pend
+                label = float(rec.label)
+                # The examples worth their bytes: confident-and-wrong.
+                is_hard_neg = rec.label == 0 and score >= review_thr
+                is_hard_pos = rec.label == 1 and score < review_thr
+                xs.append(np.asarray(features, np.float32))
+                ys.append(label)
+                hard.append(is_hard_neg or is_hard_pos)
+                ids.append(rec.decision_id)
+                s["mined_total"] += 1
+                if is_hard_neg:
+                    s["hard_negatives"] += 1
+                if is_hard_pos:
+                    s["hard_positives"] += 1
+        from igaming_platform_tpu.core.features import NUM_FEATURES
+
+        x = (np.stack(xs) if xs
+             else np.empty((0, NUM_FEATURES), np.float32))
+        mined = MinedExamples(
+            x=x, y=np.asarray(ys, np.float32),
+            hard=np.asarray(hard, bool), decision_ids=ids,
+            counts={"hard_negatives": s["hard_negatives"],
+                    "hard_positives": s["hard_positives"]})
+        if self._metrics is not None and mined.n:
+            self._metrics.online_mined_total.inc(
+                mined.n - int(mined.hard.sum()), kind="labeled")
+            self._metrics.online_mined_total.inc(
+                int(mined.hard.sum()), kind="hard")
+        return mined
+
+
+class OnlineLearner:
+    """Incremental trainer over mined examples + synthetic base replay.
+
+    A bounded reservoir holds mined rows (hard examples carry a sampling
+    weight); each training step draws ``mined_frac`` of its batch from
+    the reservoir and the rest from the labeled synthetic generator —
+    so the model keeps its base competence while it learns the stream's
+    corrections. Runs the stock Trainer (same step function serving's
+    checkpoints come from) so a candidate is a REAL serving param tree.
+    """
+
+    def __init__(self, *, trunk: tuple[int, ...] | None = None,
+                 batch_size: int | None = None, seed: int = 0,
+                 mined_frac: float | None = None, hard_weight: float = 4.0,
+                 reservoir_max: int | None = None, metrics=None):
+        from igaming_platform_tpu.train.trainer import TrainConfig, Trainer
+
+        if trunk is None:
+            trunk = tuple(int(t) for t in os.environ.get(
+                "ONLINE_TRUNK", "64,64").split(",") if t)
+        if batch_size is None:
+            batch_size = int(os.environ.get("ONLINE_BATCH", "256"))
+        if mined_frac is None:
+            mined_frac = float(os.environ.get("ONLINE_MINED_FRAC", "0.5"))
+        self.trainer = Trainer(TrainConfig(
+            batch_size=batch_size, trunk=trunk, seed=seed))
+        self.mined_frac = float(mined_frac)
+        self.hard_weight = float(hard_weight)
+        self.reservoir_max = reservoir_max or int(
+            os.environ.get("ONLINE_RESERVOIR_MAX", "16384"))
+        self._metrics = metrics
+        self._rng = np.random.default_rng(seed + 1)
+        from igaming_platform_tpu.core.features import NUM_FEATURES
+
+        self._res_x = np.empty((0, NUM_FEATURES), np.float32)
+        self._res_y = np.empty((0,), np.float32)
+        self._res_w = np.empty((0,), np.float64)
+        self.examples_ingested = 0
+        self.steps_total = 0
+        self.last_metrics: dict[str, float] = {}
+
+    def ingest(self, mined: MinedExamples) -> None:
+        if mined.n == 0:
+            return
+        w = np.where(mined.hard, self.hard_weight, 1.0)
+        self._res_x = np.concatenate([self._res_x, mined.x])[-self.reservoir_max:]
+        self._res_y = np.concatenate([self._res_y, mined.y])[-self.reservoir_max:]
+        self._res_w = np.concatenate([self._res_w, w])[-self.reservoir_max:]
+        self.examples_ingested += mined.n
+
+    @property
+    def reservoir_size(self) -> int:
+        return int(self._res_x.shape[0])
+
+    def _batch(self):
+        from igaming_platform_tpu.train.data import Batch, make_aux_targets
+        from igaming_platform_tpu.train.fraudgen import generate_labeled
+
+        bs = self.trainer.cfg.batch_size
+        n_mined = min(int(bs * self.mined_frac), self.reservoir_size)
+        n_base = bs - n_mined
+        xb, yb, _ = generate_labeled(self._rng, n_base)
+        parts_x, parts_y = [xb], [yb.astype(np.float32)]
+        if n_mined:
+            p = self._res_w / self._res_w.sum()
+            idx = self._rng.choice(self.reservoir_size, n_mined, p=p)
+            parts_x.append(self._res_x[idx])
+            parts_y.append(self._res_y[idx])
+        x = np.concatenate(parts_x)
+        y = np.concatenate(parts_y)
+        ltv_t, churn_t = make_aux_targets(x)
+        return Batch(x=x, fraud=y, ltv=ltv_t, churn=churn_t)
+
+    def train_steps(self, steps: int) -> dict[str, float]:
+        """Run ``steps`` incremental steps (double-buffered H2D like the
+        offline loop); metrics materialize once at the end."""
+        if steps <= 0:
+            return self.last_metrics
+        pending = self.trainer.put_batch(self._batch())
+        metrics_dev = None
+        for i in range(steps):
+            current = pending
+            if i + 1 < steps:
+                pending = self.trainer.put_batch(self._batch())
+            metrics_dev = self.trainer.train_step_device(current)
+        self.steps_total += steps
+        if self._metrics is not None:
+            self._metrics.online_train_steps_total.inc(steps)
+        self.last_metrics = self.trainer.materialize_metrics(metrics_dev)
+        return self.last_metrics
+
+    def candidate(self):
+        """The serving-shaped candidate param tree (hot-swap input).
+
+        A HOST COPY, not the live training tree: the train step donates
+        its params buffers (donate_argnums), so handing out live
+        references would give the shadow/controller arrays that the very
+        next step deletes from under them."""
+        import jax
+
+        return {"multitask": jax.device_get(self.trainer.state.params)}
+
+
+class OnlineLoop:
+    """The closed loop: mine -> train -> shadow -> gate -> (promote).
+
+    One background ticker thread; each tick does a bounded amount of
+    work. ``report()`` is the ``/debug/shadowz`` aggregation across the
+    miner, learner, shadow and promotion controller.
+    """
+
+    def __init__(self, *, miner: LedgerMiner, learner: OnlineLearner,
+                 shadow, controller, tick_s: float | None = None,
+                 steps_per_tick: int | None = None,
+                 min_examples_to_train: int | None = None):
+        self.miner = miner
+        self.learner = learner
+        self.shadow = shadow
+        self.controller = controller
+        self.tick_s = tick_s if tick_s is not None else float(
+            os.environ.get("ONLINE_TICK_S", "2.0"))
+        self.steps_per_tick = steps_per_tick or int(
+            os.environ.get("ONLINE_STEPS_PER_TICK", "20"))
+        self.min_examples_to_train = (
+            min_examples_to_train if min_examples_to_train is not None
+            else int(os.environ.get("ONLINE_MIN_EXAMPLES", "64")))
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.ticks = 0
+        self.last_tick_ms: float | None = None
+        self._lock = threading.Lock()
+
+    def tick(self) -> dict:
+        """One loop iteration (also the test/soak entrypoint).
+
+        Order matters: the controller evaluates the CURRENT candidate
+        (with whatever evidence window it accumulated) BEFORE the
+        candidate is refreshed — and the refresh only happens when the
+        sitting candidate is absent, already serving, or has a full
+        evidence window. Refreshing every tick would reset the shadow
+        window each time and the rows-floor gate could never pass."""
+        t0 = time.monotonic()
+        mined = self.miner.poll()
+        self.learner.ingest(mined)
+        trained = False
+        if self.learner.examples_ingested >= self.min_examples_to_train:
+            self.learner.train_steps(self.steps_per_tick)
+            trained = True
+        verdict = self.controller.tick()
+        if trained:
+            min_rows = getattr(getattr(self.controller, "gates", None),
+                               "min_shadow_rows", 0)
+            serving_fp = getattr(self.controller.engine,
+                                 "params_fingerprint", None)
+            if (self.shadow.candidate_params is None
+                    or self.shadow.candidate_fp == serving_fp
+                    or self.shadow.window_rows() >= min_rows):
+                self.shadow.set_candidate(self.learner.candidate())
+        with self._lock:
+            self.ticks += 1
+            self.last_tick_ms = round((time.monotonic() - t0) * 1000.0, 3)
+        return {"mined": mined.n, "trained": trained,
+                "controller": verdict, "tick_ms": self.last_tick_ms}
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:  # noqa: CC04 — the loop must outlive a bad tick; the tick error is logged with traceback
+                logger.warning("online-loop tick failed", exc_info=True)
+            self._stop.wait(self.tick_s)
+
+    def start(self) -> "OnlineLoop":
+        self._thread = threading.Thread(
+            target=self._run, name="online-loop", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+        self.shadow.close()
+
+    def report(self) -> dict:
+        """The full ``/debug/shadowz`` payload."""
+        with self._lock:
+            loop = {"ticks": self.ticks, "tick_s": self.tick_s,
+                    "steps_per_tick": self.steps_per_tick,
+                    "last_tick_ms": self.last_tick_ms}
+        return {
+            "loop": loop,
+            "miner": dict(self.miner.stats),
+            "learner": {
+                "examples_ingested": self.learner.examples_ingested,
+                "reservoir_size": self.learner.reservoir_size,
+                "steps_total": self.learner.steps_total,
+                "last_metrics": self.learner.last_metrics,
+            },
+            "shadow": self.shadow.report(),
+            "promotion": self.controller.report(),
+        }

@@ -1,0 +1,388 @@
+"""Gated promotion with instant rollback — the loop's safety interlock.
+
+"Rethinking LLMOps for Fraud and AML" (PAPERS.md) demands that a model
+change in a fraud stack be **gated, attributable, and instantly
+reversible**. This controller is those three properties as code:
+
+- **Gated**: a candidate promotes ONLY when every gate in
+  ``train/gates.py`` passes — labeled-probe quality (floor + no
+  regression vs the last-known-good params), live shadow evidence
+  (enough rows, flip rate under the bound; serve/shadow.py), and a quiet
+  SLO plane (obs/slo.py burn alerts block promotion mid-incident).
+- **Attributable**: every promotion/rollback writes a
+  :class:`~igaming_platform_tpu.serve.ledger.PromotionRecord` through
+  the decision WAL with BOTH params fingerprints and the gate table
+  that justified it; the promoted tree is checkpointed into a params
+  vault keyed by fingerprint, so ``tools/replay.py`` re-scores decisions
+  taken across the boundary bit-exact against the params that took them.
+- **Reversible**: the swap rides the engine's existing hot-swap seam
+  (``swap_params`` — the CC07-guarded path, which also re-syncs
+  multihost followers through ``set_params_provider``), and the
+  controller keeps the last-known-good tree in hand: a failing
+  post-promotion gate rolls back within ONE evaluation tick.
+
+Operator knobs (the runbook's forced-promotion/rollback controls):
+``force_promote``, ``force_rollback``, ``pause``/``resume``, and the
+drill-only ``inject_regression`` (deliberately degrade the served fraud
+head through the same seam, to rehearse the auto-rollback path — the
+promotion-plane equivalent of a chaos plan).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any
+
+import numpy as np
+
+from igaming_platform_tpu.serve import ledger as ledger_mod
+from igaming_platform_tpu.train import gates as gates_mod
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Params vault: fingerprint-keyed checkpoints for replay across promotions
+
+
+def vault_save(vault_dir: str, params: Any) -> str:
+    """Checkpoint a serving param tree under its fingerprint; returns the
+    fingerprint. Idempotent — an existing entry is left in place."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    fp = ledger_mod.params_fingerprint(params)
+    path = os.path.join(os.path.abspath(vault_dir), fp)
+    if not os.path.isdir(path):
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(path, jax.device_get(params))
+    return fp
+
+
+def vault_load(vault_dir: str, fp: str) -> Any | None:
+    """Restore the param tree checkpointed under ``fp``, or None when the
+    vault has no such entry."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.join(os.path.abspath(vault_dir), fp)
+    if not os.path.isdir(path):
+        return None
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(path)
+
+
+# ---------------------------------------------------------------------------
+# Labeled probe: the controller's offline quality measurement
+
+
+class QualityProbe:
+    """Fixed labeled holdout (train/fraudgen.py, seeded) + a jitted
+    fraud-head forward: one cheap AUC measurement per call. The probe set
+    never changes during a controller's life, so probe AUCs across ticks
+    are comparable numbers, not resampled noise."""
+
+    def __init__(self, *, rows: int | None = None, seed: int | None = None):
+        from igaming_platform_tpu.train.fraudgen import generate_labeled
+
+        rows = rows or int(os.environ.get("PROMOTE_PROBE_ROWS", "2048"))
+        seed = seed if seed is not None else int(
+            os.environ.get("PROMOTE_PROBE_SEED", "7041"))
+        x, y, _ = generate_labeled(np.random.default_rng(seed), rows)
+        from igaming_platform_tpu.core.features import (
+            normalize,
+            standardize_for_model,
+        )
+
+        self._xn = np.asarray(standardize_for_model(normalize(x)))
+        self._y = y
+        self._fwd = None
+
+    def auc(self, params: Any) -> float:
+        """Fraud-head ROC-AUC of a serving-shaped param tree (the
+        ``{"multitask": tree}`` hot-swap input) on the probe set."""
+        import jax
+
+        from igaming_platform_tpu.models.multitask import multitask_forward
+        from igaming_platform_tpu.train.eval import roc_auc
+
+        if self._fwd is None:
+            self._fwd = jax.jit(
+                lambda p, xn: multitask_forward(p, xn)["fraud"])
+        tree = params.get("multitask") if isinstance(params, dict) else params
+        prob = np.asarray(jax.device_get(self._fwd(tree, self._xn)),
+                          np.float64)
+        return float(roc_auc(self._y, prob))
+
+
+# ---------------------------------------------------------------------------
+# The controller
+
+
+class PromotionController:
+    """Admit/rollback state machine over the serving engine's params.
+
+    ``tick()`` is the whole interface for the loop: evaluate the shadow
+    candidate against the gates and promote when they all pass; watch
+    the post-promotion gates and roll back to last-known-good when they
+    regress. Thread-safe; every transition is ledgered and vaulted.
+    """
+
+    def __init__(self, engine, shadow, *, ledger=None,
+                 gates: gates_mod.PromotionGates | None = None,
+                 probe: QualityProbe | None = None,
+                 slo_engine=None, vault_dir: str | None = None,
+                 metrics=None, history_max: int = 64):
+        backend = getattr(engine, "ml_backend", None)
+        if backend != "multitask":
+            raise ValueError(
+                "PromotionController requires the trainable multitask "
+                f"backend (engine serves ml_backend={backend!r}); online "
+                "promotion of an untrainable backend is a config error")
+        self.engine = engine
+        self.shadow = shadow
+        self.ledger = ledger
+        self.gates = gates or gates_mod.PromotionGates.from_env()
+        self.probe = probe or QualityProbe()
+        self._slo = slo_engine
+        self.vault_dir = vault_dir
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self.paused = False
+        self.history: deque = deque(maxlen=history_max)
+        self.promotions = 0
+        self.rollbacks = 0
+        self.last_gate_table: dict | None = None
+        self.last_post_check: dict | None = None
+
+        # Last-known-good: the tree serving NOW, assumed good at
+        # construction (it passed whatever gate installed it) and
+        # re-anchored after every post-promotion check that passes.
+        self._last_good_params = engine.get_params()
+        self._last_good_fp = engine.params_fingerprint
+        self._last_good_auc = self.probe.auc(self._last_good_params)
+        if vault_dir:
+            vault_save(vault_dir, self._last_good_params)
+
+    # -- gate inputs ---------------------------------------------------------
+
+    def _slo_alerting(self) -> bool:
+        slo = self._slo
+        if slo is None:
+            from igaming_platform_tpu.obs import slo as slo_mod
+
+            slo = slo_mod.get_default()
+        if slo is None:
+            return False
+        try:
+            alerts = slo.alerts_active()
+            return bool(alerts.get("fast") or alerts.get("slow"))
+        except Exception:  # noqa: CC04 — a broken SLO read must not wedge promotion; treated as quiet
+            logger.warning("promotion SLO read failed", exc_info=True)
+            return False
+
+    def gate_check(self, candidate_params: Any) -> tuple[bool, dict]:
+        """The admit gate table for a candidate (train/gates.py is the
+        single source of the bounds)."""
+        candidate_auc = self.probe.auc(candidate_params)
+        table = gates_mod.promotion_gate_table(
+            candidate_auc=candidate_auc,
+            baseline_auc=self._last_good_auc,
+            shadow_rows=self.shadow.window_rows(),
+            flip_rate=self.shadow.flip_rate(),
+            slo_alerting=self._slo_alerting(),
+            gates=self.gates,
+        )
+        ok = gates_mod.gates_pass(table)
+        if not ok and self._metrics is not None:
+            for name, row in table.items():
+                if not row["ok"]:
+                    self._metrics.promotion_gate_failures_total.inc(gate=name)
+        self.last_gate_table = table
+        return ok, table
+
+    # -- transitions ---------------------------------------------------------
+
+    def _record(self, event: str, old_fp: str, new_fp: str, reason: str,
+                table: dict | None) -> None:
+        entry = {
+            "event": event, "old_fp": old_fp, "new_fp": new_fp,
+            "reason": reason, "at_monotonic": time.monotonic(),
+            "gates": table,
+        }
+        self.history.append(entry)
+        if self.ledger is not None:
+            self.ledger.append_promotion(ledger_mod.PromotionRecord(
+                event="rollback" if event.endswith("rollback") else "promote",
+                old_fp=old_fp, new_fp=new_fp,
+                model_version=getattr(self.engine, "ml_backend", "unknown"),
+                reason=f"{event}: {reason}"[:500],
+                gates_json=json.dumps(table, separators=(",", ":"))[:4000]
+                if table else "{}",
+                ts_unix=ledger_mod.wall_clock(),
+            ))
+        if self._metrics is not None:
+            self._metrics.promotions_total.inc(event=event)
+        logger.warning("promotion controller: %s %s -> %s (%s)",
+                       event, old_fp, new_fp, reason)
+
+    def _swap(self, params: Any) -> tuple[str, str]:
+        """The ONE path served params change on: the engine's hot-swap
+        seam (which refreshes the fingerprint, the host-tier copy, and —
+        on a multihost front — the followers via set_params_provider)."""
+        old_fp = self.engine.params_fingerprint
+        if self.vault_dir:
+            vault_save(self.vault_dir, params)
+        self.engine.swap_params(params)
+        return old_fp, self.engine.params_fingerprint
+
+    def promote(self, candidate_params: Any, *, reason: str,
+                table: dict | None, event: str = "promote") -> dict:
+        with self._lock:
+            old_fp, new_fp = self._swap(candidate_params)
+            self.promotions += 1
+            self._record(event, old_fp, new_fp, reason, table)
+            # The shadow's old evidence is about the params that just
+            # became production — start a fresh window.
+            self.shadow.set_candidate(candidate_params)
+            return {"event": event, "old_fp": old_fp, "new_fp": new_fp}
+
+    def rollback(self, *, reason: str, table: dict | None = None,
+                 event: str = "rollback") -> dict:
+        with self._lock:
+            old_fp, new_fp = self._swap(self._last_good_params)
+            self.rollbacks += 1
+            self._record(event, old_fp, new_fp, reason, table)
+            self.shadow.set_candidate(self._last_good_params)
+            return {"event": event, "old_fp": old_fp, "new_fp": new_fp}
+
+    # -- operator knobs (the runbook's forced controls) ----------------------
+
+    def force_promote(self, candidate_params: Any,
+                      reason: str = "operator force") -> dict:
+        """Promote WITHOUT gate checks (recorded as such). The
+        post-promotion watch still applies — a forced-in regression is
+        auto-rolled-back on the next tick."""
+        return self.promote(candidate_params, reason=reason, table=None,
+                            event="forced_promote")
+
+    def force_rollback(self, reason: str = "operator force") -> dict:
+        return self.rollback(reason=reason, event="forced_rollback")
+
+    def pause(self) -> None:
+        self.paused = True
+
+    def resume(self) -> None:
+        self.paused = False
+
+    def inject_regression(self) -> dict:
+        """DRILL KNOB: force-promote a deliberately broken copy of the
+        serving params (fraud head negated — scores invert) to rehearse
+        the auto-rollback path end-to-end. Never call it in anger; it
+        exists so the rollback muscle is exercised, measured and
+        alert-tested before a real bad candidate needs it."""
+        import jax
+
+        params = jax.device_get(self.engine.get_params())
+        tree = params.get("multitask") if isinstance(params, dict) else params
+        poisoned = jax.tree_util.tree_map(lambda a: np.asarray(a), tree)
+        head = {k: -np.asarray(v) for k, v in poisoned["fraud_head"].items()}
+        poisoned = dict(poisoned)
+        poisoned["fraud_head"] = head
+        return self.promote({"multitask": poisoned},
+                            reason="drill: injected quality regression",
+                            table=None, event="forced_promote")
+
+    # -- the tick ------------------------------------------------------------
+
+    def _post_promotion_check(self) -> tuple[bool, dict]:
+        """Post-promotion gates over the params serving RIGHT NOW: live
+        probe quality + SLO page state. Cheap enough to run every tick."""
+        serving_auc = self.probe.auc(self.engine.get_params())
+        slo_paging = self._slo_alerting()
+        table = {
+            "post_auc_floor": {
+                "value": round(serving_auc, 4),
+                "bound": self.gates.min_post_auc,
+                "ok": serving_auc >= self.gates.min_post_auc},
+            "slo_not_paging": {
+                "value": bool(slo_paging), "bound": False,
+                "ok": (not slo_paging)
+                or not self.gates.rollback_on_slo_page},
+        }
+        self.last_post_check = table
+        return gates_mod.gates_pass(table), table
+
+    def tick(self) -> dict:
+        """One evaluation tick: admit a waiting candidate through the
+        gates, then verify the serving params still deserve to serve —
+        rolling back when they don't."""
+        if self.paused:
+            return {"action": "paused"}
+        # Post-promotion watch FIRST: a regressed serving model must not
+        # wait behind candidate evaluation.
+        ok, post_table = self._post_promotion_check()
+        degraded_in_place = False
+        if not ok:
+            if self.engine.params_fingerprint != self._last_good_fp:
+                result = self.rollback(
+                    reason="post-promotion gate failed: " + ", ".join(
+                        k for k, row in post_table.items() if not row["ok"]),
+                    table=post_table)
+                return {"action": "rollback", **result,
+                        "post_check": post_table}
+            # Even last-known-good fails the gate (a cold-start boot
+            # whose untrained params sit under the quality floor, or an
+            # SLO page with no promotion in flight): nothing to roll
+            # back TO — but candidate evaluation must CONTINUE, because
+            # promoting a passing candidate is the only way out.
+            degraded_in_place = True
+        elif self.engine.params_fingerprint != self._last_good_fp:
+            # Serving params verified good: re-anchor last-known-good
+            # (the monotonic ratchet the NEXT candidate is measured
+            # against).
+            self._last_good_params = self.engine.get_params()
+            self._last_good_fp = self.engine.params_fingerprint
+            self._last_good_auc = post_table["post_auc_floor"]["value"]
+        # Candidate evaluation: only when the shadow holds something
+        # other than what already serves.
+        candidate = self.shadow.candidate_params
+        if (candidate is None
+                or self.shadow.candidate_fp == self.engine.params_fingerprint):
+            if degraded_in_place:
+                return {"action": "degraded_no_rollback",
+                        "post_check": post_table}
+            return {"action": "idle"}
+        if self.gates.cooldown_s > 0 and self.history:
+            since = time.monotonic() - self.history[-1]["at_monotonic"]
+            if since < self.gates.cooldown_s:
+                return {"action": "cooldown",
+                        "retry_in_s": round(self.gates.cooldown_s - since, 1)}
+        ok, table = self.gate_check(candidate)
+        if not ok:
+            return {"action": "held", "gates": table}
+        result = self.promote(candidate, reason="all gates passed",
+                              table=table)
+        return {"action": "promote", **result, "gates": table}
+
+    def report(self) -> dict:
+        """The promotion half of ``/debug/shadowz``."""
+        with self._lock:
+            history = list(self.history)
+        return {
+            "serving_fp": self.engine.params_fingerprint,
+            "last_good_fp": self._last_good_fp,
+            "last_good_probe_auc": round(self._last_good_auc, 4),
+            "paused": self.paused,
+            "promotions": self.promotions,
+            "rollbacks": self.rollbacks,
+            "gates": self.gates.as_dict(),
+            "last_gate_table": self.last_gate_table,
+            "last_post_check": self.last_post_check,
+            "vault_dir": self.vault_dir,
+            "history": history,
+        }

@@ -1,0 +1,43 @@
+"""Seeded CC07 violations: served-param writes outside the hot-swap
+seam (the compliant seam function, `__init__` construction, and
+ordinary attributes below must stay quiet)."""
+
+
+class BadEngine:
+    def __init__(self, params):
+        # Construction is exempt: the tree is being born, not swapped.
+        self._params = params
+        self._params_host = None
+        self.params_fingerprint = "0" * 16
+
+    def swap_params(self, params):  # analysis: param-swap-seam
+        """The legitimate seam: fingerprint + host copy stay coherent."""
+        self._params = params
+        self._params_host = params
+        self.params_fingerprint = "f" * 16
+
+    def sneaky_refresh(self, params):
+        self._params = params  # expect: CC07
+        self.params_fingerprint = "a" * 16  # expect: CC07
+
+    def sneaky_host_only(self, params):
+        self._params_host = params  # expect: CC07
+
+
+def bad_external_rebind(engine, params):
+    engine._params = params  # expect: CC07
+
+
+def bad_tuple_rebind(engine, a, b):
+    engine._params, engine._params_host = a, b  # expect: CC07
+
+
+def good_other_attrs(engine, params):
+    # Non-served attributes and reads are fine.
+    engine._pending_params = params
+    engine.score_observer = None
+    return engine._params
+
+
+def good_through_seam(engine, params):
+    engine.swap_params(params)

@@ -1,0 +1,697 @@
+"""Online learning loop: side-record codec, WAL mining, shadow scoring,
+gated promotion, instant rollback, and replay across the boundary."""
+
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
+from igaming_platform_tpu.core.features import NUM_FEATURES
+from igaming_platform_tpu.serve import ledger as ledger_mod
+from igaming_platform_tpu.serve.ledger import (
+    DecisionLedger,
+    DecisionRecord,
+    LedgerSchemaError,
+    OutcomeRecord,
+    PromotionRecord,
+    decode_entry,
+    decode_outcome,
+    decode_promotion,
+    encode_outcome,
+    encode_promotion,
+    iter_entries,
+    iter_promotions,
+    iter_records,
+)
+from igaming_platform_tpu.serve.scorer import ScoreRequest, TPUScoringEngine
+from igaming_platform_tpu.serve.shadow import ShadowScorer
+from igaming_platform_tpu.train import gates as gates_mod
+from igaming_platform_tpu.train.online import LedgerMiner, OnlineLearner, OnlineLoop
+from igaming_platform_tpu.train.promote import (
+    PromotionController,
+    QualityProbe,
+    vault_load,
+    vault_save,
+)
+
+GOLDEN_OUTCOME = Path(__file__).parent / "golden" / "outcome_record_v1.bin"
+GOLDEN_PROMOTION = Path(__file__).parent / "golden" / "promotion_record_v1.bin"
+
+
+def _params(seed: int):
+    import jax
+
+    from igaming_platform_tpu.models.multitask import init_multitask
+
+    return {"multitask": jax.device_get(
+        init_multitask(jax.random.key(seed), trunk=(32, 32)))}
+
+
+def _engine(params, batch: int = 32, feature_store=None) -> TPUScoringEngine:
+    return TPUScoringEngine(
+        ScoringConfig(), ml_backend="multitask", params=params,
+        batcher_config=BatcherConfig(batch_size=batch, max_wait_ms=1.0),
+        feature_store=feature_store)
+
+
+def _decision(i: int, *, score: int, features=None) -> DecisionRecord:
+    feats = (features if features is not None
+             else np.full((NUM_FEATURES,), float(i), np.float32))
+    return DecisionRecord(
+        decision_id=f"d-mine-{i:07x}.0", account_id=f"acct-{i}",
+        trace_id="", model_version="multitask",
+        params_fp="00aa11bb22cc33dd", wire_mode="batch",
+        serving_state="serving", tier="device",
+        score=score, action=2 if score >= 80 else (1 if score >= 50 else 0),
+        reason_mask=0, rule_score=score,
+        ml_score_bits=int(np.float32(score / 100.0).view(np.uint32)),
+        amount=1000 + i, tx_type="deposit",
+        block_threshold=80, review_threshold=50,
+        ts_unix=1754300000.0 + i, blacklisted=False, features=feats)
+
+
+# ---------------------------------------------------------------------------
+# Side-record wire codec (golden-pinned, like decision_record_v1.bin)
+
+
+def test_outcome_golden_blob_pins_schema():
+    blob = GOLDEN_OUTCOME.read_bytes()
+    rec = decode_outcome(blob)
+    assert rec.decision_id == "d-golden0001-0000001.0"
+    assert rec.label == 0
+    assert rec.source == "dispute_cleared"
+    assert rec.ts_unix == 1754301111.5
+    assert encode_outcome(rec) == blob, "schema drift vs golden"
+    kind, rec2 = decode_entry(blob)
+    assert kind == "outcome" and rec2 == rec
+
+
+def test_promotion_golden_blob_pins_schema():
+    blob = GOLDEN_PROMOTION.read_bytes()
+    rec = decode_promotion(blob)
+    assert rec.event == "promote"
+    assert rec.old_fp == "0123456789abcdef"
+    assert rec.new_fp == "fedcba9876543210"
+    assert rec.model_version == "multitask"
+    assert rec.reason == "all gates passed"
+    assert json.loads(rec.gates_json)["candidate_auc_floor"]["ok"] is True
+    assert rec.ts_unix == 1754302222.75
+    assert encode_promotion(rec) == blob, "schema drift vs golden"
+    kind, _ = decode_entry(blob)
+    assert kind == "promotion"
+
+
+def test_unknown_entry_version_rejected_loudly():
+    blob = GOLDEN_OUTCOME.read_bytes()
+    with pytest.raises(LedgerSchemaError, match="unknown ledger entry"):
+        decode_entry(bytes([9]) + blob[1:])
+    with pytest.raises(LedgerSchemaError):
+        decode_entry(b"")
+    # decode_record still rejects v2/v3 frames (a v1-only reader must
+    # never mis-parse a side record as a decision).
+    with pytest.raises(LedgerSchemaError):
+        ledger_mod.decode_record(blob)
+
+
+def test_wal_interleaves_side_records_v1_readers_unbroken(tmp_path):
+    """Decisions + outcomes + promotions share one WAL; iter_records
+    (the v1 audit surface) skips side records without breaking, and the
+    sink drain ships ONLY decisions while its cursor crosses them."""
+    sent: list[list] = []
+
+    class _Sink:
+        def send(self, records):
+            sent.append(list(records))
+
+    ledger = DecisionLedger(str(tmp_path), sink=_Sink(), fsync_interval_ms=5)
+    try:
+        ledger.append_record(_decision(0, score=90))
+        ledger.append_outcome(OutcomeRecord(
+            decision_id="d-mine-0000000.0", label=0,
+            source="manual_review", ts_unix=1.0))
+        ledger.append_promotion(PromotionRecord(
+            event="promote", old_fp="0" * 16, new_fp="f" * 16,
+            model_version="multitask", reason="test", gates_json="{}",
+            ts_unix=2.0))
+        ledger.append_record(_decision(1, score=10))
+        assert ledger.flush(10.0)
+        assert ledger.drain_sink(10.0)
+    finally:
+        ledger.close()
+
+    kinds = [k for k, _ in iter_entries(str(tmp_path))]
+    assert kinds == ["decision", "outcome", "promotion", "decision"]
+    decisions = list(iter_records(str(tmp_path)))
+    assert [r.decision_id for r in decisions] == [
+        "d-mine-0000000.0", "d-mine-0000001.0"]
+    promos = list(iter_promotions(str(tmp_path)))
+    assert len(promos) == 1 and promos[0].new_fp == "f" * 16
+    # The sink saw only the decisions; the cursor crossed the side
+    # records (lag 0, no livelock).
+    sink_ids = [r.decision_id for batch in sent for r in batch]
+    assert sink_ids == ["d-mine-0000000.0", "d-mine-0000001.0"]
+    stats = ledger.stats()
+    assert stats["outcome_records"] == 1
+    assert stats["promotion_records"] == 1
+    assert stats["sink"]["lag"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Miner: seeded hard negatives out of a synthetic WAL
+
+
+def test_miner_extracts_seeded_hard_negatives(tmp_path):
+    ledger = DecisionLedger(str(tmp_path))
+    try:
+        # 12 high-score decisions later cleared (hard negatives), 6
+        # low-score decisions later confirmed fraud (hard positives), 10
+        # low-score legit (plain labeled), 4 never labeled.
+        for i in range(12):
+            ledger.append_record(_decision(i, score=85))
+            ledger.append_outcome(OutcomeRecord(
+                decision_id=f"d-mine-{i:07x}.0", label=0,
+                source="dispute_cleared", ts_unix=float(i)))
+        for i in range(12, 18):
+            ledger.append_record(_decision(i, score=12))
+            ledger.append_outcome(OutcomeRecord(
+                decision_id=f"d-mine-{i:07x}.0", label=1,
+                source="chargeback", ts_unix=float(i)))
+        for i in range(18, 28):
+            ledger.append_record(_decision(i, score=20))
+            ledger.append_outcome(OutcomeRecord(
+                decision_id=f"d-mine-{i:07x}.0", label=0,
+                source="kyc", ts_unix=float(i)))
+        for i in range(28, 32):
+            ledger.append_record(_decision(i, score=70))
+        assert ledger.flush(10.0)
+
+        miner = LedgerMiner(str(tmp_path))
+        mined = miner.poll()
+        assert mined.n == 28
+        assert miner.stats["hard_negatives"] == 12
+        assert miner.stats["hard_positives"] == 6
+        assert int(mined.hard.sum()) == 18
+        # Labels and features joined correctly (feature row i is all-i).
+        by_id = dict(zip(mined.decision_ids, mined.y))
+        assert by_id["d-mine-0000000.0"] == 0.0
+        assert by_id["d-mine-000000c.0"] == 1.0
+        idx = mined.decision_ids.index("d-mine-0000005.0")
+        np.testing.assert_array_equal(
+            mined.x[idx], np.full((NUM_FEATURES,), 5.0, np.float32))
+
+        # Incremental: a second poll sees nothing until new frames land.
+        assert miner.poll().n == 0
+        ledger.append_outcome(OutcomeRecord(
+            decision_id="d-mine-000001c.0", label=1,  # i=28, score 70
+            source="chargeback", ts_unix=99.0))
+        assert ledger.flush(10.0)
+        mined2 = miner.poll()
+        assert mined2.n == 1 and mined2.decision_ids == ["d-mine-000001c.0"]
+        # score 70 >= review 50 and label 1: confirmed, not hard.
+        assert not mined2.hard[0]
+    finally:
+        ledger.close()
+
+
+def test_learner_trains_on_mined_examples(tmp_path):
+    ledger = DecisionLedger(str(tmp_path))
+    try:
+        rng = np.random.default_rng(3)
+        for i in range(64):
+            ledger.append_record(_decision(
+                i, score=85, features=rng.normal(size=NUM_FEATURES)
+                .astype(np.float32)))
+            ledger.append_outcome(OutcomeRecord(
+                decision_id=f"d-mine-{i:07x}.0", label=i % 2,
+                source="manual_review", ts_unix=float(i)))
+        assert ledger.flush(10.0)
+    finally:
+        ledger.close()
+    miner = LedgerMiner(str(tmp_path))
+    learner = OnlineLearner(trunk=(16,), batch_size=64, seed=0)
+    learner.ingest(miner.poll())
+    assert learner.reservoir_size == 64
+    fp0 = ledger_mod.params_fingerprint(learner.candidate())
+    metrics = learner.train_steps(3)
+    assert learner.steps_total == 3 and "loss" in metrics
+    assert ledger_mod.params_fingerprint(learner.candidate()) != fp0
+
+
+# ---------------------------------------------------------------------------
+# Shadow scoring: bit-exact, and provably inert for production
+
+
+def test_shadow_bit_exact_and_production_untouched(monkeypatch):
+    import time as time_mod
+
+    from igaming_platform_tpu.serve.feature_store import (
+        InMemoryFeatureStore,
+        TransactionEvent,
+    )
+
+    store = InMemoryFeatureStore()
+    for i in range(48):
+        store.update(TransactionEvent(
+            account_id=f"sh-{i % 24}", amount=500 + 37 * i,
+            tx_type=("deposit", "bet", "withdraw")[i % 3],
+            ip=f"10.1.{i % 9}.{i % 7}", device_id=f"dev-{i % 5}"))
+    reqs = [ScoreRequest(f"sh-{i % 24}", amount=900 + 131 * i,
+                         tx_type=("deposit", "bet", "withdraw")[i % 3])
+            for i in range(50)]
+    # Pin the wall clock: the gather's recency/velocity features are
+    # time-derived, and the bit-exactness claim is about identical
+    # inputs, not about two different instants agreeing.
+    t_fix = time_mod.time() + 60.0
+    monkeypatch.setattr(time_mod, "time", lambda: t_fix)
+
+    p_serve, p_cand = _params(0), _params(1)
+    engine = _engine(p_serve, feature_store=store)
+    try:
+        baseline = engine.score_batch(list(reqs))
+
+        results = []
+        shadow = ShadowScorer(engine, p_cand,
+                              on_result=lambda c, p, n: results.append((c, n)))
+        engine.shadow = shadow
+        with_shadow = engine.score_batch(list(reqs))
+        assert shadow.drain(20.0)
+
+        # 1) Production responses are UNCHANGED by the shadow path.
+        for a, b in zip(baseline, with_shadow):
+            assert (a.score, a.action, a.rule_score) == (
+                b.score, b.action, b.rule_score)
+            assert np.float32(a.ml_score) == np.float32(b.ml_score)
+
+        # 2) Shadow outputs are bit-exact vs offline scoring of the same
+        # rows with the candidate params through a second engine sharing
+        # the feature store (same gather, same graph, same padding).
+        ref_engine = _engine(p_cand, feature_store=store)
+        try:
+            ref = ref_engine.score_batch(list(reqs))
+        finally:
+            ref_engine.close()
+        cand_scores = np.concatenate(
+            [c["score"] for c, _ in results])
+        cand_actions = np.concatenate(
+            [c["action"] for c, _ in results])
+        cand_ml = np.concatenate([c["ml_score"] for c, _ in results])
+        assert cand_scores.shape[0] == len(reqs)
+        np.testing.assert_array_equal(
+            cand_scores, np.array([r.score for r in ref]))
+        np.testing.assert_array_equal(
+            cand_actions,
+            np.array([{"approve": 1, "review": 2, "block": 3}[r.action]
+                      for r in ref]))
+        np.testing.assert_array_equal(
+            cand_ml.view(np.uint32),
+            np.array([np.float32(r.ml_score) for r in ref],
+                     np.float32).view(np.uint32))
+
+        # 3) Divergence accounting adds up.
+        rep = shadow.report()
+        assert rep["window"]["rows"] == len(reqs)
+        flips = sum(int(a.action != r.action)
+                    for a, r in zip(baseline, ref))
+        assert rep["window"]["action_flips"] == flips
+        assert rep["production_fp"] == engine.params_fingerprint
+        assert rep["candidate_fp"] == ledger_mod.params_fingerprint(p_cand)
+    finally:
+        engine.close()
+        if engine.shadow is not None:
+            engine.shadow.close()
+
+
+def test_shadow_failure_and_overflow_never_touch_production():
+    engine = _engine(_params(0))
+    try:
+        # A candidate that cannot score (wrong pytree) must only bump the
+        # shadow's own error counter.
+        shadow = ShadowScorer(engine, {"multitask": {"broken": np.zeros(3)}})
+        engine.shadow = shadow
+        reqs = [ScoreRequest(f"x-{i}", amount=100 + i) for i in range(8)]
+        responses = engine.score_batch(reqs)
+        assert len(responses) == 8
+        shadow.drain(10.0)
+        assert shadow.errors >= 1
+        shadow.close()
+
+        # A full queue drops (counted) instead of blocking the hot path.
+        shadow2 = ShadowScorer(engine, _params(1), queue_max_rows=4)
+        engine.shadow = shadow2
+        engine.score_batch([ScoreRequest(f"y-{i}", amount=10 + i)
+                            for i in range(32)])
+        shadow2.drain(10.0)
+        rep = shadow2.report()
+        assert rep["rows_dropped"] + rep["total"]["rows"] == 32
+        assert rep["rows_dropped"] > 0
+        shadow2.close()
+        engine.shadow = None
+    finally:
+        engine.close()
+
+
+# ---------------------------------------------------------------------------
+# Promotion controller: gates, rollback, ledger records
+
+
+class _StubProbe:
+    """Deterministic probe: fingerprints registered as good score 0.95,
+    everything else (e.g. an injected-regression tree) scores 0.2 —
+    the gate logic under test, without training time or AUC noise."""
+
+    def __init__(self):
+        self.good: set[str] = set()
+
+    def mark_good(self, params) -> None:
+        self.good.add(ledger_mod.params_fingerprint(params))
+
+    def auc(self, params) -> float:
+        fp = ledger_mod.params_fingerprint(params)
+        return 0.95 if fp in self.good else 0.2
+
+
+def _controller(engine, shadow, ledger=None, *, gates=None, slo=None,
+                vault=None, probe=None):
+    if probe is None:
+        probe = _StubProbe()
+        probe.mark_good(engine.get_params())
+    return PromotionController(
+        engine, shadow, ledger=ledger,
+        gates=gates or gates_mod.PromotionGates(
+            min_candidate_auc=0.55, max_auc_drop=0.5, min_shadow_rows=8,
+            max_flip_rate=1.0, require_slo_quiet=True, min_post_auc=0.55),
+        probe=probe, slo_engine=slo, vault_dir=vault)
+
+
+def test_quality_probe_is_deterministic_and_order_faithful():
+    """The real probe: same params -> same AUC (fixed holdout), and a
+    fraud head negated through the drill knob inverts the ranking
+    exactly (AUC + AUC' == 1) — the separation the post-promotion gate
+    relies on."""
+    probe = QualityProbe(rows=512, seed=11)
+    p = _params(0)
+    a1, a2 = probe.auc(p), probe.auc(p)
+    assert a1 == a2 and 0.0 <= a1 <= 1.0
+    tree = p["multitask"]
+    neg = dict(tree)
+    neg["fraud_head"] = {k: -np.asarray(v)
+                         for k, v in tree["fraud_head"].items()}
+    assert abs(probe.auc({"multitask": neg}) + a1 - 1.0) < 1e-9
+
+
+class _FakeSLO:
+    def __init__(self):
+        self.alerts = {"fast": False, "slow": False}
+
+    def alerts_active(self):
+        return dict(self.alerts)
+
+
+def _feed_shadow(engine, n=16):
+    reqs = [ScoreRequest(f"pr-{i}", amount=500 + i) for i in range(n)]
+    engine.score_batch(reqs)
+    engine.shadow.drain(20.0)
+
+
+def test_promotion_fires_only_when_all_gates_pass(tmp_path):
+    ledger = DecisionLedger(str(tmp_path / "wal"))
+    engine = _engine(_params(0))
+    engine.ledger = ledger
+    shadow = ShadowScorer(engine)
+    engine.shadow = shadow
+    slo = _FakeSLO()
+    try:
+        probe = _StubProbe()
+        probe.mark_good(engine.get_params())
+        candidate = _params(2)
+        probe.mark_good(candidate)
+        ctl = _controller(
+            engine, shadow, ledger, slo=slo,
+            vault=str(tmp_path / "vault"), probe=probe,
+            # rollback_on_slo_page off so the PAGE exercises the
+            # candidate-side slo_quiet gate, not the post-promotion watch.
+            gates=gates_mod.PromotionGates(
+                min_candidate_auc=0.55, max_auc_drop=0.5,
+                min_shadow_rows=8, max_flip_rate=1.0,
+                require_slo_quiet=True, min_post_auc=0.55,
+                rollback_on_slo_page=False))
+        old_fp = engine.params_fingerprint
+
+        # No candidate yet: idle.
+        assert ctl.tick()["action"] == "idle"
+
+        # A candidate failing the probe floor is held on quality alone.
+        shadow.set_candidate(_params(8))  # unknown to the probe: auc 0.2
+        _feed_shadow(engine)
+        verdict = ctl.tick()
+        assert verdict["action"] == "held"
+        assert not verdict["gates"]["candidate_auc_floor"]["ok"]
+
+        # Candidate present but NO shadow evidence: held on rows floor.
+        shadow.set_candidate(candidate)
+        verdict = ctl.tick()
+        assert verdict["action"] == "held"
+        assert not verdict["gates"]["shadow_rows_floor"]["ok"]
+        assert engine.params_fingerprint == old_fp
+
+        # Evidence accumulated but the SLO plane is paging: held.
+        _feed_shadow(engine)
+        slo.alerts["fast"] = True
+        verdict = ctl.tick()
+        assert verdict["action"] == "held"
+        assert not verdict["gates"]["slo_quiet"]["ok"]
+        assert engine.params_fingerprint == old_fp
+
+        # All gates green: promoted through the hot-swap seam, both
+        # fingerprints ledgered, vault holds the new tree.
+        slo.alerts["fast"] = False
+        verdict = ctl.tick()
+        assert verdict["action"] == "promote"
+        new_fp = ledger_mod.params_fingerprint(candidate)
+        assert engine.params_fingerprint == new_fp
+        assert verdict["old_fp"] == old_fp and verdict["new_fp"] == new_fp
+        assert ledger.flush(10.0)
+        promos = list(iter_promotions(str(tmp_path / "wal")))
+        assert [(p.event, p.old_fp, p.new_fp) for p in promos] == [
+            ("promote", old_fp, new_fp)]
+        gates_table = json.loads(promos[0].gates_json)
+        assert all(row["ok"] for row in gates_table.values())
+        assert vault_load(str(tmp_path / "vault"), new_fp) is not None
+    finally:
+        ledger.close()
+        shadow.close()
+        engine.close()
+
+
+def test_flip_rate_gate_holds_a_flippy_candidate(tmp_path):
+    engine = _engine(_params(0))
+    shadow = ShadowScorer(engine)
+    engine.shadow = shadow
+    try:
+        ctl = _controller(
+            engine, shadow,
+            gates=gates_mod.PromotionGates(
+                min_candidate_auc=0.0, max_auc_drop=1.0, min_shadow_rows=8,
+                max_flip_rate=0.0, min_post_auc=0.0), slo=_FakeSLO())
+        # An amplified-and-negated fraud head saturates the candidate's
+        # probabilities opposite to production: every row flips.
+        tree = _params(0)["multitask"]
+        flippy = dict(tree)
+        flippy["fraud_head"] = {k: -50.0 * np.asarray(v)
+                                for k, v in tree["fraud_head"].items()}
+        shadow.set_candidate({"multitask": flippy})
+        rng = np.random.default_rng(5)
+        reqs = [ScoreRequest(f"fl-{i}", amount=int(rng.integers(100, 200_000)),
+                             tx_type=("deposit", "withdraw")[i % 2])
+                for i in range(64)]
+        engine.score_batch(reqs)
+        assert shadow.drain(20.0)
+        assert shadow.flip_rate() > 0.0
+        verdict = ctl.tick()
+        assert verdict["action"] == "held"
+        assert not verdict["gates"]["shadow_flip_rate_ceiling"]["ok"]
+    finally:
+        shadow.close()
+        engine.close()
+
+
+def test_failing_post_promotion_gate_rolls_back_within_one_tick(tmp_path):
+    ledger = DecisionLedger(str(tmp_path / "wal"))
+    engine = _engine(_params(0))
+    engine.ledger = ledger
+    shadow = ShadowScorer(engine)
+    engine.shadow = shadow
+    try:
+        ctl = _controller(engine, shadow, ledger, slo=_FakeSLO(),
+                          vault=str(tmp_path / "vault"))
+        good_fp = engine.params_fingerprint
+        # Drill knob: force-promote a poisoned copy (fraud head negated).
+        ctl.inject_regression()
+        bad_fp = engine.params_fingerprint
+        assert bad_fp != good_fp
+        # ONE tick later the post-promotion probe gate fails and the
+        # controller rolls back to last-known-good.
+        verdict = ctl.tick()
+        assert verdict["action"] == "rollback"
+        assert not verdict["post_check"]["post_auc_floor"]["ok"]
+        assert engine.params_fingerprint == good_fp
+        assert ctl.rollbacks == 1
+        assert ledger.flush(10.0)
+        events = [(p.event, p.old_fp, p.new_fp)
+                  for p in iter_promotions(str(tmp_path / "wal"))]
+        assert events == [("promote", good_fp, bad_fp),
+                          ("rollback", bad_fp, good_fp)]
+        # Stable afterwards: the restored params pass the watch.
+        assert ctl.tick()["action"] in ("idle", "held")
+    finally:
+        ledger.close()
+        shadow.close()
+        engine.close()
+
+
+def test_slo_page_rolls_back_a_fresh_promotion(tmp_path):
+    engine = _engine(_params(0))
+    shadow = ShadowScorer(engine)
+    engine.shadow = shadow
+    slo = _FakeSLO()
+    try:
+        probe = _StubProbe()
+        probe.mark_good(engine.get_params())
+        candidate = _params(2)
+        probe.mark_good(candidate)
+        ctl = _controller(engine, shadow, slo=slo, probe=probe)
+        good_fp = engine.params_fingerprint
+        shadow.set_candidate(candidate)
+        _feed_shadow(engine)
+        assert ctl.tick()["action"] == "promote"
+        # The page arrives after promotion: rollback on the next tick.
+        slo.alerts["fast"] = True
+        verdict = ctl.tick()
+        assert verdict["action"] == "rollback"
+        assert engine.params_fingerprint == good_fp
+        # Paging with nothing to roll back to: degrade loudly, no spin.
+        assert ctl.tick()["action"] == "degraded_no_rollback"
+    finally:
+        shadow.close()
+        engine.close()
+
+
+# ---------------------------------------------------------------------------
+# Replay across a promotion boundary (params vault)
+
+
+def test_replay_across_promotion_boundary(tmp_path):
+    from tools.replay import replay_directory
+
+    wal = str(tmp_path / "wal")
+    vault = str(tmp_path / "wal" / "params-vault")
+    p0 = _params(0)
+    ledger = DecisionLedger(wal)
+    engine = _engine(p0)
+    engine.ledger = ledger
+    shadow = ShadowScorer(engine)
+    engine.shadow = shadow
+    try:
+        vault_save(vault, p0)  # the boot params (controller does this)
+        probe = _StubProbe()
+        probe.mark_good(p0)
+        candidate = _params(2)
+        probe.mark_good(candidate)
+        ctl = _controller(engine, shadow, ledger, slo=_FakeSLO(),
+                          vault=vault, probe=probe)
+        reqs = [ScoreRequest(f"rp-{i}", amount=700 + 13 * i,
+                             tx_type=("deposit", "bet")[i % 2])
+                for i in range(24)]
+        engine.score_batch(reqs)  # scored under p0
+        shadow.set_candidate(candidate)
+        _feed_shadow(engine)
+        assert ctl.tick()["action"] == "promote"
+        engine.score_batch(reqs)  # scored under the promoted candidate
+        assert ledger.flush(10.0)
+    finally:
+        ledger.close()
+        shadow.close()
+        engine.close()
+
+    verdict = replay_directory(wal, batch=32)
+    assert verdict["ok"], verdict
+    assert verdict["params_fingerprint_mismatch"] == 0
+    assert len(verdict["replayed_by_params_fp"]) == 2, (
+        "replay must cover BOTH sides of the promotion boundary")
+    assert verdict["promotions"] and verdict["promotions"][0]["event"] == "promote"
+
+
+# ---------------------------------------------------------------------------
+# Gates module is the single source of truth
+
+
+def test_gates_consume_committed_eval_json():
+    eval_path = Path(__file__).parent.parent / "EVAL.json"
+    models = json.loads(eval_path.read_text())["models"]
+    ordering = gates_mod.ordering_gates(models)
+    assert set(ordering) == {"trained_beats_mock", "mock_beats_rules",
+                             "gbdt_beats_mock"}
+    assert all(ordering.values())
+    table = gates_mod.eval_gates(models)
+    assert all(row["ok"] for row in table.values()), table
+    # Env overrides reach the promotion gates (single source, tunable).
+    os.environ["PROMOTE_MIN_AUC"] = "0.97"
+    try:
+        assert gates_mod.PromotionGates.from_env().min_candidate_auc == 0.97
+    finally:
+        del os.environ["PROMOTE_MIN_AUC"]
+    table = gates_mod.promotion_gate_table(
+        candidate_auc=0.92, baseline_auc=0.96, shadow_rows=1000,
+        flip_rate=0.01, slo_alerting=False,
+        gates=gates_mod.PromotionGates())
+    assert not table["no_regression_vs_baseline"]["ok"]
+    assert not gates_mod.gates_pass(table)
+
+
+# ---------------------------------------------------------------------------
+# The loop end-to-end (in-process): mine -> train -> shadow -> gate
+
+
+def test_online_loop_tick_closes_the_loop(tmp_path):
+    wal = str(tmp_path / "wal")
+    ledger = DecisionLedger(wal)
+    engine = _engine(_params(0))
+    engine.ledger = ledger
+    shadow = ShadowScorer(engine)
+    engine.shadow = shadow
+    try:
+        ctl = _controller(engine, shadow, ledger, slo=_FakeSLO(),
+                          vault=str(tmp_path / "vault"))
+        loop = OnlineLoop(
+            miner=LedgerMiner(wal),
+            learner=OnlineLearner(trunk=(16,), batch_size=64, seed=0),
+            shadow=shadow, controller=ctl,
+            tick_s=60.0, steps_per_tick=2, min_examples_to_train=8)
+
+        # Live traffic + outcome backfill through the real WAL.
+        reqs = [ScoreRequest(f"lp-{i}", amount=400 + i) for i in range(24)]
+        responses = engine.score_batch(reqs)
+        assert all(r.decision_id for r in responses)
+        for i, r in enumerate(responses):
+            ledger.append_outcome(OutcomeRecord(
+                decision_id=r.decision_id, label=i % 2,
+                source="manual_review", ts_unix=float(i)))
+        assert ledger.flush(10.0)
+
+        out = loop.tick()
+        assert out["mined"] == 24
+        assert out["trained"] is True
+        assert loop.learner.steps_total == 2
+        # The freshly-trained candidate is in the shadow now.
+        assert shadow.candidate_fp != engine.params_fingerprint
+        report = loop.report()
+        assert report["miner"]["mined_total"] == 24
+        assert report["shadow"]["candidate_fp"] == shadow.candidate_fp
+        assert report["promotion"]["serving_fp"] == engine.params_fingerprint
+    finally:
+        ledger.close()
+        shadow.close()
+        engine.close()

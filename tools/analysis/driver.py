@@ -40,6 +40,13 @@ REPO_CONFIG = {
         "igaming_platform_tpu/ops/", "igaming_platform_tpu/parallel/",
     ),
     "cc_scope": ("igaming_platform_tpu/serve/", "igaming_platform_tpu/obs/"),
+    # CC07 param-mutation discipline: anywhere a served param tree could
+    # be rebound — the serving layer, the training/promotion side, and
+    # the harnesses that assemble engines.
+    "paramswap_scope": (
+        "igaming_platform_tpu/serve/", "igaming_platform_tpu/train/",
+        "benchmarks/", "tools/", "bench.py",
+    ),
 }
 
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"

@@ -1,0 +1,118 @@
+"""CC07 — served param trees mutate ONLY through the hot-swap seam.
+
+The serving engine's params are not an ordinary attribute: the decision
+ledger fingerprints them at swap time (so every DecisionRecord is
+attributable to the tree that scored it, and ``tools/replay.py`` can
+re-score bit-exact), the host latency tier keeps a CPU-committed copy,
+and a multihost front re-syncs followers through
+``set_params_provider``. A bare rebind of ``engine._params`` (or the
+host copy, or the fingerprint) does none of that: decisions start
+landing in the WAL under a STALE fingerprint — silently unreplayable —
+while the host tier serves a different model than the device tier.
+
+The one legitimate path is the engine's ``swap_params`` (marked
+``# analysis: param-swap-seam`` on its ``def`` line); the online
+promotion controller (train/promote.py) and the training loop both go
+through it. This rule flags assignments/rebinds of the served attributes
+(``_params``, ``_params_host``, ``params_fingerprint``) anywhere in the
+param-mutation scope EXCEPT:
+
+- inside a function marked ``# analysis: param-swap-seam``;
+- ``self.<attr> = ...`` inside ``__init__`` (construction, not mutation).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.analysis.engine import FileContext, ProjectContext, rule
+
+_SERVED_ATTRS = {"_params", "_params_host", "params_fingerprint"}
+_SEAM_MARKER = re.compile(r"#\s*analysis:\s*param-swap-seam")
+
+
+def _scoped_files(project: ProjectContext) -> list[FileContext]:
+    config = project.caches.get("config", {})
+    prefixes = config.get("paramswap_scope")
+    if not prefixes:
+        return list(project.files)
+    return [f for f in project.files
+            if any(f.relpath.startswith(p) for p in prefixes)]
+
+
+def _seam_ranges(ctx: FileContext) -> list[tuple[int, int]]:
+    seam_lines = {
+        lineno
+        for lineno, line in enumerate(ctx.src.splitlines(), start=1)
+        if _SEAM_MARKER.search(line)
+    }
+    if not seam_lines:
+        return []
+    ranges = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        marker_lines = {node.lineno} | {d.lineno for d in node.decorator_list}
+        if marker_lines & seam_lines:
+            ranges.append((node.lineno, node.end_lineno or node.lineno))
+    return ranges
+
+
+def _init_self_ranges(ctx: FileContext) -> list[tuple[int, int]]:
+    """Line ranges of every ``__init__`` (construction is exempt for
+    ``self.<attr>`` targets only)."""
+    return [
+        (node.lineno, node.end_lineno or node.lineno)
+        for node in ast.walk(ctx.tree)
+        if isinstance(node, ast.FunctionDef) and node.name == "__init__"
+    ]
+
+
+def _served_targets(node: ast.AST):
+    """(attribute-node, is_self) for every served-attr assignment target
+    in an Assign/AugAssign/AnnAssign statement."""
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    else:
+        return
+    for t in targets:
+        for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
+            if isinstance(el, ast.Attribute) and el.attr in _SERVED_ATTRS:
+                is_self = isinstance(el.value, ast.Name) and el.value.id == "self"
+                yield el, is_self
+
+
+@rule("CC07", "param-mutation-discipline",
+      "A served param tree (`_params` / `_params_host` / "
+      "`params_fingerprint`) was written outside the engine's hot-swap "
+      "seam (the `# analysis: param-swap-seam` function, i.e. "
+      "`swap_params`). A bare rebind skips the ledger fingerprint "
+      "refresh (decisions become silently unreplayable under a stale "
+      "fingerprint), the host-tier CPU copy (device and host tiers "
+      "serve different models), and the multihost follower re-sync. "
+      "Route the change through `swap_params`, or mark a genuine new "
+      "seam function with `# analysis: param-swap-seam`.",
+      scope="project")
+def param_mutation_discipline(project: ProjectContext):
+    for ctx in _scoped_files(project):
+        seam = _seam_ranges(ctx)
+        inits = _init_self_ranges(ctx)
+
+        def _in(ranges: list[tuple[int, int]], lineno: int) -> bool:
+            return any(lo <= lineno <= hi for lo, hi in ranges)
+
+        for node in ast.walk(ctx.tree):
+            for attr, is_self in _served_targets(node):
+                if _in(seam, attr.lineno):
+                    continue
+                if is_self and _in(inits, attr.lineno):
+                    continue
+                yield ctx, attr.lineno, (
+                    f"write to served param attribute `.{attr.attr}` "
+                    "outside the hot-swap seam — the fingerprint, the "
+                    "host-tier copy and follower re-sync all miss it; "
+                    "call `swap_params` (the `# analysis: "
+                    "param-swap-seam` function) instead")

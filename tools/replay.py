@@ -62,31 +62,62 @@ def _resolve_params(backend: str, checkpoint: str | None):
 
 
 class _EngineCache:
-    """One warmed engine per (backend, batch) — replay groups share it."""
+    """One warmed engine per (backend, params fingerprint) — replay
+    groups share it. Fingerprints are resolved to param trees in order:
+    the pinned checkpoint/seeded convention, then the PARAMS VAULT the
+    promotion controller writes (``<ledger_dir>/params-vault/<fp>``) —
+    so decisions scored by a promoted candidate replay bit-exact against
+    the exact tree that scored them, across the promotion boundary."""
 
-    def __init__(self, batch: int, checkpoint: str | None):
+    def __init__(self, batch: int, checkpoint: str | None,
+                 vault_dir: str | None = None):
         self.batch = batch
         self.checkpoint = checkpoint
-        self._engines: dict[str, object] = {}
+        self.vault_dir = vault_dir
+        self._engines: dict[tuple[str, str], object] = {}
 
-    def get(self, backend: str):
-        eng = self._engines.get(backend)
-        if eng is None:
-            from igaming_platform_tpu.core.config import (
-                BatcherConfig,
-                ScoringConfig,
-            )
-            from igaming_platform_tpu.serve.scorer import TPUScoringEngine
+    def _build(self, backend: str, params):
+        from igaming_platform_tpu.core.config import (
+            BatcherConfig,
+            ScoringConfig,
+        )
+        from igaming_platform_tpu.serve.scorer import TPUScoringEngine
 
-            eng = TPUScoringEngine(
-                ScoringConfig(),
-                ml_backend=backend,
-                params=_resolve_params(backend, self.checkpoint),
-                batcher_config=BatcherConfig(batch_size=self.batch,
-                                             max_wait_ms=1.0),
-            )
-            self._engines[backend] = eng
-        return eng
+        return TPUScoringEngine(
+            ScoringConfig(),
+            ml_backend=backend,
+            params=params,
+            batcher_config=BatcherConfig(batch_size=self.batch,
+                                         max_wait_ms=1.0),
+        )
+
+    def get_for(self, backend: str, fp: str):
+        """Engine whose params fingerprint equals ``fp``, or None when no
+        params source (pinned convention or vault) resolves it."""
+        eng = self._engines.get((backend, fp))
+        if eng is not None:
+            return eng
+        pinned = self._build(backend, _resolve_params(backend, self.checkpoint))
+        if pinned.params_fingerprint == fp:
+            self._engines[(backend, fp)] = pinned
+            return pinned
+        pinned.close()
+        if self.vault_dir:
+            from igaming_platform_tpu.train.promote import vault_load
+
+            params = vault_load(self.vault_dir, fp)
+            if params is not None:
+                eng = self._build(backend, params)
+                if eng.params_fingerprint != fp:
+                    # A tampered/corrupt vault entry must fail loudly,
+                    # never silently re-score against the wrong model.
+                    eng.close()
+                    raise RuntimeError(
+                        f"params vault entry {fp} restored to fingerprint "
+                        f"{eng.params_fingerprint} — vault corrupt")
+                self._engines[(backend, fp)] = eng
+                return eng
+        return None
 
     def close(self) -> None:
         for eng in self._engines.values():
@@ -148,14 +179,32 @@ def _recorded_fields(r) -> dict:
 
 def replay_directory(directory: str, *, batch: int = 256,
                      checkpoint: str | None = None,
+                     vault_dir: str | None = None,
                      max_mismatch_samples: int = 10) -> dict:
     """Replay every record in a ledger directory; returns the verdict
     artifact dict (``ok`` iff zero mismatches AND zero params-fingerprint
     mismatches; index-mode records without a snapshot are counted as
-    skipped, never as passes)."""
+    skipped, never as passes).
+
+    Promotion side-records (serve/ledger.PromotionRecord) are read from
+    the same WAL: they land in the verdict as the ``promotions``
+    timeline, and the params vault they point at (default
+    ``<directory>/params-vault``) resolves every fingerprint a promotion
+    put into service — replay works ACROSS the promotion boundary, one
+    engine per (backend, fingerprint) group."""
     from igaming_platform_tpu.serve import ledger as ledger_mod
 
-    records = list(ledger_mod.iter_records(directory))
+    if vault_dir is None:
+        default_vault = os.path.join(directory, "params-vault")
+        vault_dir = default_vault if os.path.isdir(default_vault) else None
+
+    records = []
+    promotions = []
+    for kind, rec in ledger_mod.iter_entries(directory):
+        if kind == "decision":
+            records.append(rec)
+        elif kind == "promotion":
+            promotions.append(rec)
     groups: dict[tuple, list] = {}
     skipped_no_snapshot = 0
     for r in records:
@@ -168,21 +217,23 @@ def replay_directory(directory: str, *, batch: int = 256,
                r.params_fp)
         groups.setdefault(key, []).append(r)
 
-    engines = _EngineCache(batch, checkpoint)
+    engines = _EngineCache(batch, checkpoint, vault_dir=vault_dir)
     mismatches: list[dict] = []
     params_mismatch = 0
     replayed_by_tier: dict[str, int] = {}
+    replayed_by_fp: dict[str, int] = {}
     try:
         for (tier_class, backend, block, review, fp), recs in sorted(
                 groups.items()):
             if tier_class == "heuristic":
                 recomputed = _replay_heuristic(recs, (block, review))
             else:
-                engine = engines.get(backend)
-                if fp != engine.params_fingerprint:
+                engine = engines.get_for(backend, fp)
+                if engine is None:
                     params_mismatch += len(recs)
                     continue
                 engine.set_thresholds(block, review)
+                replayed_by_fp[fp] = replayed_by_fp.get(fp, 0) + len(recs)
                 recomputed = _replay_compiled(engine, recs)
             for rec, redo in zip(recs, recomputed):
                 replayed_by_tier[rec.tier] = replayed_by_tier.get(rec.tier, 0) + 1
@@ -207,8 +258,14 @@ def replay_directory(directory: str, *, batch: int = 256,
         "records_total": len(records),
         "replayed": replayed,
         "replayed_by_tier": replayed_by_tier,
+        "replayed_by_params_fp": replayed_by_fp,
         "skipped_no_snapshot": skipped_no_snapshot,
         "params_fingerprint_mismatch": params_mismatch,
+        "params_vault": vault_dir,
+        "promotions": [{
+            "event": p.event, "old_fp": p.old_fp, "new_fp": p.new_fp,
+            "reason": p.reason, "ts": round(p.ts_unix, 3),
+        } for p in promotions],
         "fields_compared": list(_COMPARE_FIELDS),
         "mismatches": len(mismatches),
         "mismatch_samples": mismatches[:max_mismatch_samples],
@@ -302,6 +359,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--checkpoint",
                         help="pinned Orbax checkpoint (default: the seeded "
                              "init convention)")
+    parser.add_argument("--params-vault",
+                        help="fingerprint-keyed params vault for replay "
+                             "across promotion boundaries (default: "
+                             "<dir>/params-vault when present)")
     parser.add_argument("--verify", action="store_true",
                         help="self-contained smoke: score under CHAOS_PLAN, "
                              "replay, diff")
@@ -311,7 +372,8 @@ def main(argv: list[str] | None = None) -> int:
         verdict = run_verify()
     elif args.dir:
         verdict = replay_directory(args.dir, batch=args.batch,
-                                   checkpoint=args.checkpoint)
+                                   checkpoint=args.checkpoint,
+                                   vault_dir=args.params_vault)
     else:
         parser.error("need --dir or --verify")
     print(json.dumps(verdict))
