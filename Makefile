@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-fast lint lint-json lint-update-baseline bench bench-all bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos soak-chaos-ledger soak-slo soak-online replay-verify fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
+.PHONY: all test test-fast lint lint-json lint-update-baseline bench bench-all bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos soak-chaos-ledger soak-slo soak-online soak-drift replay-verify fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
 
 all: native test
 
@@ -84,6 +84,15 @@ soak-slo:
 # A/B -> ONLINE_r10.json (ONLINE_SOAK_DURATION_S).
 soak-online:
 	$(PY) benchmarks/soak.py --online-chaos
+
+# Drift-observatory chaos: clean baseline -> pin reference -> injected
+# --drift-ramp must raise the input drift alert and hold promotion via
+# the drift_quiet gate -> ramp removal must clear within bound; then a
+# 3-replica fleet serves merged drift state (/debug/fleetz) through a
+# replica SIGKILL, plus the sketch-on/off overhead A/B
+# -> DRIFT_r11.json with explicit gates.
+soak-drift:
+	$(PY) benchmarks/soak.py --drift-chaos
 
 # Bit-exact decision replay smoke (tier-1-adjacent): score a seeded
 # batch under CHAOS_PLAN (ledger-append faults), replay the ledger with

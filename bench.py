@@ -330,6 +330,63 @@ def shadow_ab_numbers() -> dict:
     }
 
 
+def drift_ab_numbers() -> dict:
+    """Sketch-on vs sketch-off e2e A/B: the drift observatory
+    (obs/drift.py) promises its per-batch cost is ONE fused device-side
+    reduction with the tiny result drained off-path — two short
+    identical wire runs, one with DRIFT=0 and one with the sketches on,
+    must land within noise. The artifact records both throughputs, the
+    ratio, and the observatory's own counters (rows sketched/dropped) so
+    the on-path promise is a measured number. BENCH_DRIFT_AB_S sizes the
+    arms (0 disables)."""
+    from benchmarks.load_gen import run_grpc_load, start_inprocess_server
+
+    from igaming_platform_tpu.obs import drift as drift_mod
+
+    duration_s = float(os.environ.get("BENCH_DRIFT_AB_S", 4.0))
+    if duration_s <= 0:
+        return {}
+    rows = int(os.environ.get("BENCH_E2E_ROWS_PER_RPC", 8192))
+    batch = int(os.environ.get("BENCH_E2E_BATCH", 8192))
+    arms = {}
+    drift_block = None
+    saved = os.environ.get("DRIFT")
+    try:
+        for arm in ("off", "on"):
+            os.environ["DRIFT"] = "0" if arm == "off" else "1"
+            addr, shutdown, _engine = start_inprocess_server(batch_size=batch)
+            try:
+                load = run_grpc_load(addr, duration_s=duration_s,
+                                     rows_per_rpc=rows, concurrency=4)
+                arms[arm] = load["value"]
+                if arm == "on" and drift_mod.get_default() is not None:
+                    drift_mod.get_default().drain(5.0)
+                    drift_block = drift_mod.get_default().summary_block()
+            finally:
+                shutdown()
+    finally:
+        if saved is None:
+            os.environ.pop("DRIFT", None)
+        else:
+            os.environ["DRIFT"] = saved
+    ratio = arms["on"] / arms["off"] if arms.get("off") else None
+    cores = os.cpu_count() or 1
+    # Same honesty contract as the ledger/shadow A/Bs: on a 1-core
+    # control rig the sketch reduction and the drift worker share the
+    # scoring core, so the flat-out ratio records that bounded tax
+    # directly; on >=2 cores the worker interleaves and the arm must
+    # land within normal run-to-run noise.
+    bar = 0.85 if cores >= 2 else 0.45
+    return {
+        "drift_off_txns_per_sec": arms.get("off"),
+        "drift_on_txns_per_sec": arms.get("on"),
+        "drift_overhead_ratio": round(ratio, 4) if ratio else None,
+        "drift_overhead_within_noise": bool(ratio and ratio >= bar),
+        "drift_overhead_bar": bar,
+        "drift_block": drift_block,
+    }
+
+
 def observability_ab_numbers() -> dict:
     """Observability-overhead A/B: the SLO engine + device-runtime
     telemetry promise O(1)-per-request accounting off the hot path — two
@@ -408,6 +465,10 @@ def main() -> None:
             result.update(shadow_ab_numbers())
         except Exception as exc:  # noqa: BLE001 — the A/B arm must not lose the headline
             result["shadow_ab_error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            result.update(drift_ab_numbers())
+        except Exception as exc:  # noqa: BLE001 — the A/B arm must not lose the headline
+            result["drift_ab_error"] = f"{type(exc).__name__}: {exc}"
         headline = float(result["e2e_txns_per_sec"])
         result.update({
             "metric": "e2e_grpc_fraud_score_txns_per_sec",

@@ -51,10 +51,14 @@ from igaming_platform_tpu.proto_gen.risk.v1 import risk_pb2  # noqa: E402
 
 
 def _build_request_payloads(
-    rows_per_rpc: int, n_variants: int = 4, n_accounts: int = 512
+    rows_per_rpc: int, n_variants: int = 4, n_accounts: int = 512,
+    amount_mult: float = 1.0, amount_shift: float = 0.0,
 ) -> list[bytes]:
     """Pre-serialized ScoreBatchRequests (client-side proto cost is not the
-    thing under test; rotating variants keeps the account mix realistic)."""
+    thing under test; rotating variants keeps the account mix realistic).
+    ``amount_mult``/``amount_shift`` apply a drift-ramp phase's transform
+    to the transaction amounts — same seed, so phase k of two identical
+    runs carries byte-identical payloads (deterministic injection)."""
     rng = np.random.default_rng(7)
     tx_types = ("deposit", "bet", "withdraw")
     payloads = []
@@ -62,7 +66,8 @@ def _build_request_payloads(
         txs = [
             risk_pb2.ScoreTransactionRequest(
                 account_id=f"lg-{int(rng.integers(0, n_accounts))}",
-                amount=int(rng.integers(100, 100_000)),
+                amount=max(1, int(int(rng.integers(100, 100_000))
+                                  * amount_mult + amount_shift)),
                 transaction_type=tx_types[int(rng.integers(0, 3))],
                 ip_address=f"10.{v}.{i % 200}.{i % 251}",
                 device_id=f"dev-{int(rng.integers(0, 64))}",
@@ -74,7 +79,8 @@ def _build_request_payloads(
 
 
 def _build_index_payloads(
-    rows_per_rpc: int, n_variants: int = 4, n_accounts: int = 512
+    rows_per_rpc: int, n_variants: int = 4, n_accounts: int = 512,
+    amount_mult: float = 1.0, amount_shift: float = 0.0,
 ) -> list[bytes]:
     """Pre-serialized index-mode frames — the SAME account/amount/type mix
     as the protobuf payloads, encoded as compact columns."""
@@ -86,7 +92,9 @@ def _build_index_payloads(
     for v in range(n_variants):
         payloads.append(encode_index_batch(
             [f"lg-{int(rng.integers(0, n_accounts))}" for _ in range(rows_per_rpc)],
-            [int(rng.integers(100, 100_000)) for _ in range(rows_per_rpc)],
+            [max(1, int(int(rng.integers(100, 100_000))
+                        * amount_mult + amount_shift))
+             for _ in range(rows_per_rpc)],
             [tx_types[int(rng.integers(0, 3))] for _ in range(rows_per_rpc)],
             ips=[f"10.{v}.{i % 200}.{i % 251}" for i in range(rows_per_rpc)],
             devices=[f"dev-{int(rng.integers(0, 64))}" for i in range(rows_per_rpc)],
@@ -324,21 +332,55 @@ def run_grpc_load(
     warmup_rpcs: int = 3,
     wire_mode: str = "row",
     fleet_addrs: list[str] | None = None,
+    drift_ramp=None,
+    drift_phases: int = 8,
 ) -> dict:
     """Drive ScoreBatch at ``addr`` from ``concurrency`` client threads for
     ``duration_s``; returns sustained txns/s + RPC latency percentiles.
     ``wire_mode='index'`` ships index-mode frames (HBM feature cache).
     ``fleet_addrs`` switches to fleet mode: each worker drives its
     account-affine replica through the client-side picker, failing over
-    to the next ring owner on UNAVAILABLE."""
+    to the next ring owner on UNAVAILABLE.
+
+    ``drift_ramp`` (a train/fraudgen.DriftRamp or its spec string)
+    injects a DETERMINISTIC mean/scale drift into the transaction
+    amounts: the run is cut into ``drift_phases`` payload sets, each
+    pre-built with the ramp's transform at that phase's run fraction
+    (same seed -> byte-identical payloads run-to-run), and the artifact
+    records the injected schedule verbatim (``drift_block``)."""
+    phase_payload_sets: list[list[bytes]] | None = None
+    drift_block = None
+    if drift_ramp is not None:
+        from igaming_platform_tpu.train.fraudgen import DriftRamp
+
+        if fleet_addrs:
+            raise ValueError("--drift-ramp does not combine with fleet "
+                             "mode (inject per-replica drift via the "
+                             "soak harness instead)")
+        ramp = (DriftRamp.parse(drift_ramp) if isinstance(drift_ramp, str)
+                else drift_ramp)
+        builder = (_build_index_payloads if wire_mode == "index"
+                   else _build_request_payloads)
+        phase_payload_sets = []
+        for ph in range(drift_phases):
+            mult, shift = ramp.factors((ph + 0.5) / drift_phases)
+            phase_payload_sets.append(
+                builder(rows_per_rpc, amount_mult=mult, amount_shift=shift))
+        payloads = phase_payload_sets[0]
+        drift_block = {
+            "spec": ramp.spec_string(),
+            "phases": drift_phases,
+            "applied_to": ["tx_amount"],
+            "schedule": ramp.schedule_block(drift_phases),
+        }
     fleet_payloads: dict[str, list[bytes]] = {}
     if fleet_addrs:
         fleet_payloads, _picker = _build_fleet_payloads(
             fleet_addrs, rows_per_rpc, wire_mode)
         payloads = next(iter(fleet_payloads.values()))
-    elif wire_mode == "index":
+    elif drift_ramp is None and wire_mode == "index":
         payloads = _build_index_payloads(rows_per_rpc)
-    else:
+    elif drift_ramp is None:
         payloads = _build_request_payloads(rows_per_rpc)
 
     stop_at = [0.0]
@@ -404,6 +446,13 @@ def run_grpc_load(
             time.sleep(0.001)
         i = k
         while time.perf_counter() < stop_at[0]:
+            if phase_payload_sets is not None:
+                # Drift-ramp phase by run fraction: deterministic given
+                # the wall window (the schedule lands in the artifact).
+                frac = 1.0 - (stop_at[0] - time.perf_counter()) / duration_s
+                worker_payloads = phase_payload_sets[
+                    min(int(max(0.0, frac) * drift_phases),
+                        drift_phases - 1)]
             _, metadata = _client_traceparent()
             t0 = time.perf_counter()
             try:
@@ -475,6 +524,7 @@ def run_grpc_load(
         "pushback_honored": retry_stats.pushback_honored,
         "failovers": retry_stats.failovers,
         **({"fleet_replicas": len(fleet_addrs)} if fleet_addrs else {}),
+        **({"drift_block": drift_block} if drift_block else {}),
         "rpc_p50_ms": round(float(np.percentile(lat, 50)), 3) if n_rpcs else None,
         "rpc_p99_ms": round(float(np.percentile(lat, 99)), 3) if n_rpcs else None,
         "wall_s": round(wall, 3),
@@ -566,6 +616,7 @@ def main() -> None:
     wire_mode = os.environ.get("LOAD_WIRE_MODE", "row")
     addr = None
     fleet_addrs: list[str] | None = None
+    drift_ramp = os.environ.get("LOAD_DRIFT_RAMP") or None
     for arg in sys.argv[1:]:
         if arg.startswith("--wire-mode="):
             wire_mode = arg.split("=", 1)[1]
@@ -573,6 +624,13 @@ def main() -> None:
             raise SystemExit("use --wire-mode=row|index")
         elif arg.startswith("--fleet="):
             fleet_addrs = [a for a in arg.split("=", 1)[1].split(",") if a]
+        elif arg.startswith("--drift-ramp="):
+            # Seedable injected drift, e.g. --drift-ramp=mult=8:start=0.4
+            # (spec grammar: train/fraudgen.DriftRamp.parse).
+            drift_ramp = arg.split("=", 1)[1]
+        elif arg == "--drift-ramp":
+            raise SystemExit(
+                "use --drift-ramp=mult=M[:shift=S:start=F:end=F]")
         else:
             addr = arg
     if wire_mode not in ("row", "index"):
@@ -593,6 +651,7 @@ def main() -> None:
             concurrency=int(os.environ.get("LOAD_CONCURRENCY", 4)),
             wire_mode=wire_mode,
             fleet_addrs=fleet_addrs,
+            drift_ramp=drift_ramp,
         )
         pipeline = getattr(engine, "pipeline", None)
         if pipeline is not None:
@@ -613,6 +672,14 @@ def main() -> None:
 
             if slo_mod.get_default() is not None:
                 load["slo_block"] = slo_mod.get_default().summary_block()
+            # Drift-observatory summary for the in-process arm
+            # (obs/drift.py): rows sketched/dropped, alert state, and —
+            # with a pinned reference — the headline PSIs.
+            from igaming_platform_tpu.obs import drift as drift_mod
+
+            if drift_mod.get_default() is not None:
+                drift_mod.get_default().drain(2.0)
+                load["drift_summary"] = drift_mod.get_default().summary_block()
         print(json.dumps(load), flush=True)
         probe = run_single_txn_probe(addr)
         print(json.dumps(probe), flush=True)

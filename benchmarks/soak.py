@@ -1363,6 +1363,494 @@ def main_ledger_chaos() -> None:
         sys.exit(1)
 
 
+def main_drift_chaos() -> None:
+    """Drift-observatory chaos soak (``--drift-chaos``) -> DRIFT_r11.json:
+    the streaming drift plane (obs/drift.py) proven end-to-end on one
+    production server process under live load, three arms plus a fleet
+    phase:
+
+    1. **clean baseline** — known-clean traffic warms the rolling
+       window; the harness pins it as the reference
+       (POST /debug/driftz pin_reference) and the observatory must stay
+       QUIET through a further clean window (no false alert);
+    2. **injected ramp** — a deterministic ``DriftRamp``
+       (train/fraudgen.py; the same knob ``load_gen --drift-ramp``
+       exposes) multiplies transaction amounts 1 -> DRIFT_SOAK_MULT;
+       the ``input`` drift alert must RAISE within the alert bound, and
+       a pending promotion must be HELD by the ``drift_quiet`` gate
+       (the gate table, drift_quiet ok=false, lands in the artifact);
+    3. **ramp removal** — amounts return to baseline; the alert must
+       CLEAR within the rolling window plus slack.
+
+    Fleet phase: a 3-replica rig (benchmarks/fleet.py) behind the L7
+    router's aggregation plane — ``/debug/fleetz`` must serve MERGED
+    per-feature drift state (bucket-wise sketch sum, loud on mixed
+    edges), keep answering fast through a replica SIGKILL, and
+    stale-stamp the dead replica.
+
+    The outcome backfill rides the fixed POST /debug/outcomes (accepted
+    vs unknown decision-id counts land in the artifact), and bench.py's
+    sketch-on/off A/B runs in-harness so the hot-path cost is a number.
+    Gates (exit 1 on miss) cover all of the above.
+    """
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    import grpc
+
+    from igaming_platform_tpu.proto_gen.risk.v1 import risk_pb2
+    from fleet import ReplicaFleet, ReplicaProc
+    from igaming_platform_tpu.serve.router import ScoringRouter, serve_router
+    from igaming_platform_tpu.train.fraudgen import DriftRamp
+
+    window_s = float(os.environ.get("DRIFT_WINDOW_S", "8"))
+    ref_warm_s = float(os.environ.get("DRIFT_SOAK_REF_WARM_S", "12"))
+    clean_s = float(os.environ.get("DRIFT_SOAK_CLEAN_S", "10"))
+    ramp_s = float(os.environ.get("DRIFT_SOAK_RAMP_S", "24"))
+    clear_s = float(os.environ.get("DRIFT_SOAK_CLEAR_S", "20"))
+    mult = float(os.environ.get("DRIFT_SOAK_MULT", "8"))
+    ramp_up_s = float(os.environ.get("DRIFT_SOAK_RAMP_UP_S", "5"))
+    alert_bound_s = float(os.environ.get(
+        "DRIFT_SOAK_ALERT_BOUND_S", str(window_s + 6.0)))
+    clear_bound_s = float(os.environ.get(
+        "DRIFT_SOAK_CLEAR_BOUND_S", str(window_s + 8.0)))
+    outcome_rate = float(os.environ.get("ONLINE_OUTCOME_RATE", "0.6"))
+
+    # The injected schedule, recorded verbatim (run fraction is relative
+    # to the ramp window; deterministic given the wall timeline).
+    ramp = DriftRamp(features=("tx_amount",), scale_mult=mult,
+                     start_frac=0.0, end_frac=max(1e-6, ramp_up_s / ramp_s))
+
+    ledger_dir = tempfile.mkdtemp(prefix="soak-drift-")
+    replica = ReplicaProc("drift-0", batch_size=128, env_extra={
+        "LEDGER_DIR": ledger_dir,
+        "LEDGER_FSYNC_MS": "10",
+        "RISK_REVIEW_THRESHOLD": os.environ.get("RISK_REVIEW_THRESHOLD", "30"),
+        # Online loop (PR 9 rig bounds — see --online-chaos): candidates
+        # churn every tick so a gate table exists to HOLD during drift.
+        "ONLINE_LOOP": "1",
+        "ONLINE_TICK_S": os.environ.get("ONLINE_TICK_S", "1.0"),
+        "ONLINE_STEPS_PER_TICK": os.environ.get("ONLINE_STEPS_PER_TICK", "25"),
+        "ONLINE_MIN_EXAMPLES": os.environ.get("ONLINE_MIN_EXAMPLES", "48"),
+        "ONLINE_TRUNK": os.environ.get("ONLINE_TRUNK", "32,32"),
+        "ONLINE_BATCH": os.environ.get("ONLINE_BATCH", "256"),
+        "ONLINE_MINED_FRAC": os.environ.get("ONLINE_MINED_FRAC", "0.3"),
+        "PROMOTE_MIN_AUC": os.environ.get("PROMOTE_MIN_AUC", "0.8"),
+        "PROMOTE_MIN_POST_AUC": os.environ.get("PROMOTE_MIN_POST_AUC", "0.7"),
+        "PROMOTE_MIN_SHADOW_ROWS": "64",
+        "PROMOTE_MAX_FLIP_RATE": os.environ.get("PROMOTE_MAX_FLIP_RATE", "1.0"),
+        "PROMOTE_COOLDOWN_S": "0",
+        "PROMOTE_PROBE_ROWS": "1024",
+        # Drift plane: short window so the alert clock fits the soak.
+        "DRIFT_WINDOW_S": str(window_s),
+        "DRIFT_BUCKET_S": "1",
+        "DRIFT_MIN_ROWS": os.environ.get("DRIFT_MIN_ROWS", "300"),
+        # Calibration stays advisory on this short rig (binomial noise
+        # on a few hundred outcomes must not confound the input-drift
+        # clean gate); the unit suite pins the calibration alert path.
+        "DRIFT_CAL_ALERT": os.environ.get("DRIFT_CAL_ALERT", "0.35"),
+        "DRIFT_CAL_MIN_OUTCOMES": os.environ.get(
+            "DRIFT_CAL_MIN_OUTCOMES", "400"),
+    })
+    replica.spawn()
+
+    t0 = time.perf_counter()
+    total_s = ref_warm_s + clean_s + ramp_s + clear_s
+    stop_at = t0 + total_s
+    lock = threading.Lock()
+    events: list[tuple[float, bool]] = []
+    errors: list[str] = []
+    outcome_q: deque = deque()
+    backfill = {"accepted": 0, "unknown": 0, "submitted": 0, "posts": 0,
+                "bad_request_rejected": False}
+    # Ramp state the workers read: (active_since | None).
+    ramp_box: list[float | None] = [None]
+
+    def amp_now() -> float:
+        with lock:
+            since = ramp_box[0]
+        if since is None:
+            return 1.0
+        frac = min((time.perf_counter() - since) / ramp_s, 1.0)
+        m, _shift = ramp.factors(frac)
+        return m
+
+    def _note(ok: bool, exc=None) -> None:
+        with lock:
+            events.append((time.perf_counter(), ok))
+            if not ok and exc is not None:
+                errors.append(repr(exc)[:120])
+
+    def _http_json(path: str, payload: dict | None = None,
+                   timeout: float = 5.0):
+        url = f"http://{replica.http_addr}{path}"
+        if payload is None:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                return json.loads(resp.read())
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    def score_worker(wid: int) -> None:
+        wrng = np.random.default_rng(300 + wid)
+        ch = grpc.insecure_channel(replica.addr)
+        call = ch.unary_unary(
+            "/risk.v1.RiskService/ScoreTransaction",
+            request_serializer=risk_pb2.ScoreTransactionRequest.SerializeToString,
+            response_deserializer=risk_pb2.ScoreTransactionResponse.FromString)
+        i = 0
+        while time.perf_counter() < stop_at:
+            big = wrng.random() < 0.4
+            base = int(wrng.integers(60_000, 250_000) if big
+                       else wrng.integers(100, 9_000))
+            amount = max(1, int(base * amp_now()))
+            req = risk_pb2.ScoreTransactionRequest(
+                account_id=f"dr-{wid}-{i % 96}", amount=amount,
+                transaction_type="withdraw" if big else
+                ("deposit", "bet")[i % 2])
+            try:
+                _resp, rpc = call.with_call(req, timeout=10)
+                _note(True)
+                md = dict(rpc.trailing_metadata() or ())
+                did = md.get("risk-decision-id", "")
+                if did and wrng.random() < outcome_rate:
+                    label = int(wrng.random() < (0.75 if big else 0.05))
+                    with lock:
+                        outcome_q.append((did, label))
+            except grpc.RpcError as exc:
+                if exc.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    time.sleep(0.02)
+                else:
+                    _note(False, exc)
+                    time.sleep(0.05)
+            i += 1
+            time.sleep(0.004)
+        ch.close()
+
+    def outcome_poster() -> None:
+        """Backfill via the FIXED /debug/outcomes: accepted/unknown
+        counts accumulate into the artifact (the join-health evidence
+        the old silent-200 endpoint could not give)."""
+        while time.perf_counter() < stop_at:
+            batch = []
+            with lock:
+                while outcome_q and len(batch) < 64:
+                    did, label = outcome_q.popleft()
+                    batch.append({"decision_id": did, "label": label,
+                                  "source": ("chargeback" if label
+                                             else "dispute_cleared")})
+            if batch:
+                try:
+                    resp = _http_json("/debug/outcomes", {"outcomes": batch})
+                    with lock:
+                        backfill["accepted"] += resp.get("accepted", 0)
+                        backfill["unknown"] += resp.get("unknown", 0)
+                        backfill["submitted"] += resp.get("submitted", 0)
+                        backfill["posts"] += 1
+                except Exception:  # noqa: BLE001 — retried next round
+                    with lock:
+                        for row in batch:
+                            outcome_q.append((row["decision_id"],
+                                              row["label"]))
+                    time.sleep(0.5)
+            time.sleep(0.25)
+
+    workers = [threading.Thread(target=score_worker, args=(w,))
+               for w in range(3)]
+    workers.append(threading.Thread(target=outcome_poster))
+    for t in workers:
+        t.start()
+
+    # Malformed-body probe: the old endpoint answered 200 to garbage.
+    try:
+        req = urllib.request.Request(
+            f"http://{replica.http_addr}/debug/outcomes",
+            data=json.dumps({"outcomes": [{"label": 1}]}).encode(),
+            method="POST")
+        urllib.request.urlopen(req, timeout=5)
+    except urllib.error.HTTPError as exc:
+        backfill["bad_request_rejected"] = exc.code == 400
+
+    marks: dict = {
+        "pinned_at_s": None, "clean_input_alerts_seen": 0,
+        "clean_alerts_by_kind": {}, "clean_polls": 0,
+        "ramp_start_s": None, "input_alert_s": None,
+        "held_table": None, "held_at_s": None, "alerts_at_hold": None,
+        "ramp_end_s": None, "alert_clear_s": None,
+        "promotions_preramp": 0,
+    }
+
+    def _driftz() -> dict | None:
+        try:
+            return _http_json("/debug/driftz", timeout=3.0)
+        except Exception:  # noqa: BLE001 — polled measurement
+            return None
+
+    # -- phase 0: warm the window, pin the reference -------------------------
+    time.sleep(max(0.0, t0 + ref_warm_s - time.perf_counter()))
+    pin_resp = None
+    for _attempt in range(10):
+        try:
+            pin_resp = _http_json("/debug/driftz",
+                                  {"action": "pin_reference",
+                                   "source": "drift-soak-clean-warmup"})
+            marks["pinned_at_s"] = round(time.perf_counter() - t0, 3)
+            break
+        except urllib.error.HTTPError:
+            time.sleep(1.0)  # window still too thin; traffic is filling it
+    # -- arm 1: clean observation (no false alert) ---------------------------
+    clean_end = time.perf_counter() + clean_s
+    while time.perf_counter() < clean_end:
+        snap = _driftz()
+        if snap:
+            marks["clean_polls"] += 1
+            # The false-positive gate is on INPUT drift: the online
+            # loop's own promotions legitimately shift the SCORE
+            # distribution vs the pre-promotion reference (the output
+            # sketches catching a deliberate model change — recorded by
+            # kind, not a false positive).
+            if snap["alerts"].get("input"):
+                marks["clean_input_alerts_seen"] += 1
+            for kind, active in snap["alerts"].items():
+                if active:
+                    marks["clean_alerts_by_kind"][kind] = (
+                        marks["clean_alerts_by_kind"].get(kind, 0) + 1)
+        time.sleep(0.5)
+    try:
+        shadowz = _http_json("/debug/shadowz", timeout=5.0)
+        marks["promotions_preramp"] = shadowz["promotion"]["promotions"]
+    except Exception:  # noqa: BLE001 — artifact field only
+        pass
+
+    # -- arm 2: injected ramp must RAISE + HOLD promotion --------------------
+    with lock:
+        ramp_box[0] = time.perf_counter()
+    marks["ramp_start_s"] = round(time.perf_counter() - t0, 3)
+    ramp_end = time.perf_counter() + ramp_s
+    while time.perf_counter() < ramp_end:
+        snap = _driftz()
+        now_s = time.perf_counter() - t0
+        if snap and snap["alerts"].get("input") and marks["input_alert_s"] is None:
+            marks["input_alert_s"] = round(now_s, 3)
+        if marks["input_alert_s"] is not None and marks["held_table"] is None:
+            # Force a controller tick so the gate table is computed NOW,
+            # against the currently-alerting drift plane.
+            try:
+                _http_json("/debug/promotion", {"action": "tick"}, timeout=15.0)
+                shadowz = _http_json("/debug/shadowz", timeout=5.0)
+                alerts_now = (_driftz() or {}).get("alerts") or {}
+                table = shadowz["promotion"].get("last_gate_table") or {}
+                row = table.get("drift_quiet")
+                # The held evidence must be taken WHILE the injected
+                # input alert is active — a hold from a coincident
+                # score/calibration alert would be weaker evidence.
+                if row and not row["ok"] and alerts_now.get("input"):
+                    marks["held_table"] = table
+                    marks["held_at_s"] = round(time.perf_counter() - t0, 3)
+                    marks["alerts_at_hold"] = alerts_now
+            except Exception:  # noqa: BLE001 — re-tried next poll
+                pass
+        time.sleep(0.5)
+
+    # -- arm 3: ramp removal must CLEAR --------------------------------------
+    with lock:
+        ramp_box[0] = None
+    marks["ramp_end_s"] = round(time.perf_counter() - t0, 3)
+    clear_end = time.perf_counter() + clear_s
+    while time.perf_counter() < clear_end:
+        snap = _driftz()
+        if (snap and not snap["alerts"].get("input")
+                and marks["alert_clear_s"] is None
+                and marks["input_alert_s"] is not None):
+            marks["alert_clear_s"] = round(time.perf_counter() - t0, 3)
+            break
+        time.sleep(0.5)
+
+    final_driftz = _driftz() or {}
+    final_driftz.pop("reference_state", None)  # bulky; meta block stays
+    final_window_vec = (final_driftz.get("window") or {}).pop("vec", None)
+    del final_window_vec  # artifact carries summaries, not raw vectors
+    for t in workers:
+        t.join()
+    replica.terminate()
+
+    # -- fleet phase: merged drift state stays live through a kill -----------
+    fleet_marks: dict = {"polls": 0, "poll_errors": 0, "max_poll_ms": 0.0,
+                         "rows": 0, "merge_errors": None,
+                         "casualty_stale": False}
+    fleet = ReplicaFleet(3, batch_size=256, env_extra={
+        "DRIFT_WINDOW_S": "20", "DRIFT_BUCKET_S": "2"}).start()
+    router = None
+    server = None
+    try:
+        router = ScoringRouter(fleet.router_spec(), health_interval_s=0.2,
+                               failure_threshold=2, forward_timeout_s=20.0)
+        server, _health, port = serve_router(router, 0, http_port=0)
+        fleetz_addr = f"localhost:{router.http_port}"
+        casualty_rid = fleet.replicas[2].rid
+
+        payload = risk_pb2.ScoreBatchRequest(transactions=[
+            risk_pb2.ScoreTransactionRequest(
+                account_id=f"fd-{i % 256}", amount=1000 + i,
+                transaction_type=("deposit", "bet", "withdraw")[i % 3])
+            for i in range(256)
+        ]).SerializeToString()
+        ch = grpc.insecure_channel(f"localhost:{port}")
+        call = ch.unary_unary("/risk.v1.RiskService/ScoreBatch",
+                              request_serializer=lambda b: b,
+                              response_deserializer=lambda b: b)
+        drive_end = time.perf_counter() + float(
+            os.environ.get("DRIFT_SOAK_FLEET_DRIVE_S", "8"))
+        while time.perf_counter() < drive_end:
+            try:
+                call(payload, timeout=20)
+            except grpc.RpcError as exc:
+                errors.append(f"fleet: {exc.code().name}")
+            time.sleep(0.02)
+        fleet.replicas[2].kill()
+        time.sleep(4.0)  # scrape ticker marks the corpse stale
+
+        def http_json(addr_: str, path: str, timeout: float = 5.0):
+            with urllib.request.urlopen(
+                    f"http://{addr_}{path}", timeout=timeout) as resp:
+                return json.loads(resp.read())
+
+        fleetz = None
+        for _ in range(10):
+            tq0 = time.perf_counter()
+            try:
+                fleetz = http_json(fleetz_addr, "/debug/fleetz", 5.0)
+                fleet_marks["polls"] += 1
+                fleet_marks["max_poll_ms"] = max(
+                    fleet_marks["max_poll_ms"],
+                    round((time.perf_counter() - tq0) * 1000.0, 3))
+            except Exception:  # noqa: BLE001 — a failed poll IS the measurement
+                fleet_marks["poll_errors"] += 1
+            time.sleep(0.3)
+        if fleetz:
+            fd = fleetz.get("fleet_drift") or {}
+            fleet_marks["rows"] = fd.get("rows", 0)
+            fleet_marks["merge_errors"] = fd.get("merge_errors")
+            fleet_marks["replica_rows"] = fd.get("replicas")
+            casualty = next((r for r in fleetz.get("replicas", ())
+                             if r["replica"] == casualty_rid), None)
+            fleet_marks["casualty_stale"] = bool(
+                casualty and casualty.get("stale"))
+        ch.close()
+    finally:
+        try:
+            if router is not None:
+                router.close()
+            if server is not None:
+                server.stop(2)
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+        fleet.stop()
+
+    # -- sketch-overhead A/B (bench.py arm, in-harness) ----------------------
+    os.environ.setdefault("BENCH_E2E_BATCH", "1024")
+    os.environ.setdefault("BENCH_E2E_ROWS_PER_RPC", "1024")
+    from bench import drift_ab_numbers
+
+    try:
+        drift_ab = drift_ab_numbers()
+    except Exception as exc:  # noqa: BLE001 — A/B failure fails its gate below, not the artifact
+        drift_ab = {"error": f"{type(exc).__name__}: {exc}"}
+
+    from load_gen import availability_block
+
+    availability = availability_block(events, t0, stop_at)
+    alert_latency = (round(marks["input_alert_s"] - marks["ramp_start_s"], 3)
+                     if marks["input_alert_s"] is not None else None)
+    clear_latency = (round(marks["alert_clear_s"] - marks["ramp_end_s"], 3)
+                     if marks["alert_clear_s"] is not None else None)
+    result = {
+        "metric": "drift_chaos_soak",
+        "scenario": ("clean warmup -> pin reference -> input-quiet clean "
+                     "window (the online loop's own promotions may shift "
+                     "the SCORE distribution vs the pre-promotion "
+                     "reference — caught by the output sketches, "
+                     "recorded by kind) -> injected amount drift ramp "
+                     "raises the input alert and drift_quiet holds "
+                     "promotion while it is active -> ramp removal "
+                     "clears within bound; then a 3-replica fleet "
+                     "serves merged drift state through a SIGKILL"),
+        "host_cpu_cores": os.cpu_count() or 1,
+        "timeline_s": {"ref_warm": ref_warm_s, "clean": clean_s,
+                       "ramp": ramp_s, "clear": clear_s},
+        "injected": {
+            "spec": ramp.spec_string(),
+            "mult": mult,
+            "ramp_up_s": ramp_up_s,
+            "applied_to": ["tx_amount"],
+            "schedule": ramp.schedule_block(8),
+        },
+        "marks": marks,
+        "alert_latency_s": alert_latency,
+        "alert_bound_s": alert_bound_s,
+        "clear_latency_s": clear_latency,
+        "clear_bound_s": clear_bound_s,
+        "pin_response": pin_resp,
+        "availability": availability,
+        "errors_total": len(errors),
+        "error_samples": errors[:5],
+        "outcome_backfill": backfill,
+        "driftz_final": {
+            "alerts": final_driftz.get("alerts"),
+            "alert_events": final_driftz.get("alert_events"),
+            "stats": final_driftz.get("stats"),
+            "input": {
+                k: (final_driftz.get("input") or {}).get(k)
+                for k in ("max_feature_psi", "top_features", "score_psi",
+                          "action_psi")},
+            "calibration": {
+                k: ((final_driftz.get("calibration") or {}).get(k))
+                for k in ("window_outcomes", "error")},
+        },
+        "fleet": fleet_marks,
+        "drift_ab": drift_ab,
+        "ledger_dir": ledger_dir,
+    }
+    gates = {
+        "reference_pinned": marks["pinned_at_s"] is not None,
+        "clean_window_input_quiet": (
+            marks["clean_polls"] > 0
+            and marks["clean_input_alerts_seen"] == 0),
+        "drift_alert_raised_within_bound": (
+            alert_latency is not None and alert_latency <= alert_bound_s),
+        "promotion_held_by_drift_quiet": bool(
+            marks["held_table"]
+            and not marks["held_table"]["drift_quiet"]["ok"]),
+        "alert_cleared_within_bound": (
+            clear_latency is not None and clear_latency <= clear_bound_s),
+        "zero_scoring_errors": len(errors) == 0,
+        "outcome_backfill_observable": bool(
+            backfill["posts"] > 0 and backfill["accepted"] > 0
+            and backfill["bad_request_rejected"]),
+        "fleetz_drift_merged_through_kill": bool(
+            fleet_marks["polls"] > 0 and fleet_marks["poll_errors"] == 0
+            and fleet_marks["max_poll_ms"] < 2000.0
+            and fleet_marks["rows"] > 0
+            and not fleet_marks["merge_errors"]
+            and fleet_marks["casualty_stale"]),
+        "drift_overhead_within_noise": bool(
+            drift_ab.get("drift_overhead_within_noise")),
+    }
+    result["gates"] = gates
+    out_path = os.environ.get(
+        "DRIFT_ARTIFACT",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "DRIFT_r11.json"))
+    with open(out_path, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps(result))
+    print(json.dumps({"gates": gates}), file=sys.stderr, flush=True)
+    if not all(gates.values()):
+        sys.exit(1)
+
+
 def main_online_chaos() -> None:
     """Online-learning chaos soak (``--online-chaos``) -> ONLINE_r10.json:
     the closed loop (ROADMAP item 4) demonstrated END-TO-END on one
@@ -1729,7 +2217,11 @@ def main_online_chaos() -> None:
 
 
 if __name__ == "__main__":
-    if "--online-chaos" in sys.argv or os.environ.get("SOAK_ONLINE_CHAOS") == "1":
+    if "--drift-chaos" in sys.argv or os.environ.get("SOAK_DRIFT_CHAOS") == "1":
+        # The drift soak provisions its own replica processes (CPU
+        # control rig).
+        main_drift_chaos()
+    elif "--online-chaos" in sys.argv or os.environ.get("SOAK_ONLINE_CHAOS") == "1":
         # The online-learning soak provisions its own replica process
         # (CPU control rig).
         main_online_chaos()

@@ -179,6 +179,7 @@ class _ReplicaState:
         self.histograms: dict[str, dict[tuple, HistogramSnapshot]] = {}
         self.supervisorz: dict | None = None
         self.sloz: dict | None = None
+        self.driftz: dict | None = None
         self.flight: list[dict] = []
         self.last_good_monotonic: float | None = None
         self.consecutive_failures = 0
@@ -247,11 +248,12 @@ class FleetView:
             histograms = parse_histograms(metrics_text)
             # Debug surfaces are best-effort per-endpoint: a replica
             # without a supervisor (404) still contributes histograms.
-            supervisorz = sloz = None
+            supervisorz = sloz = driftz = None
             flight: list[dict] = []
             for path, setter in (
                 ("/debug/supervisorz", "supervisorz"),
                 ("/debug/sloz", "sloz"),
+                ("/debug/driftz", "driftz"),
                 ("/debug/flightz", "flight"),
             ):
                 try:
@@ -262,6 +264,8 @@ class FleetView:
                     supervisorz = payload
                 elif setter == "sloz":
                     sloz = payload
+                elif setter == "driftz":
+                    driftz = payload if isinstance(payload, dict) else None
                 else:
                     flight = payload if isinstance(payload, list) else []
         except Exception as exc:  # noqa: BLE001 — a dead/hung replica must not kill the ticker
@@ -276,6 +280,7 @@ class FleetView:
             state.histograms = histograms
             state.supervisorz = supervisorz
             state.sloz = sloz
+            state.driftz = driftz
             state.flight = flight
             state.last_good_monotonic = time.monotonic()
             state.consecutive_failures = 0
@@ -356,6 +361,7 @@ class FleetView:
             states: list[dict] = []
             per_replica_hists: list[tuple[str, dict]] = []
             flights: list[tuple[str, list[dict]]] = []
+            driftzs: list[tuple[str, dict | None]] = []
             merge_errors: list[str] = []
             for st in replicas:
                 age = (None if st.last_good_monotonic is None
@@ -386,6 +392,7 @@ class FleetView:
                 })
                 per_replica_hists.append((st.rid, st.histograms))
                 flights.append((st.rid, st.flight))
+                driftzs.append((st.rid, st.driftz))
         # Merge OUTSIDE the lock (pure compute over snapshotted refs).
         stages: dict[str, HistogramSnapshot] = {}
         for rid, hists in per_replica_hists:
@@ -410,11 +417,24 @@ class FleetView:
                 "count": snap.count,
                 "exemplar_trace_id": ex[0] if ex else None,
             }
+        # Drift-state merge (obs/drift.py): the per-replica window
+        # sketches sum bucket-wise into one fleet view; mixed histogram
+        # edges are rejected loudly into merge_errors — the same
+        # discipline as the stage-histogram merge above.
+        from igaming_platform_tpu.obs import drift as drift_mod
+
+        try:
+            fleet_drift = drift_mod.fleet_drift_block(driftzs)
+            merge_errors.extend(
+                f"drift/{err}" for err in fleet_drift.get("merge_errors", ()))
+        except Exception as exc:  # noqa: BLE001 — the drift rollup must not take down the fleet page
+            fleet_drift = {"error": repr(exc)[:200]}
         return {
             "generated_unix_s": round(time.time(), 3),
             "stale_after_s": self.stale_after_s,
             "replicas": states,
             "fleet_stage_latency_ms": stage_block,
+            "fleet_drift": fleet_drift,
             "histogram_merge_errors": merge_errors,
             "slowest_traces": self._slowest_traces(flights),
             "ring": self._ring(),

@@ -610,6 +610,69 @@ class ServiceMetrics:
             "rows/flip-rate, SLO-quiet) — a persistently failing gate "
             "is the drift dashboard's first stop",
         )
+        # Streaming drift & data-quality observatory (obs/drift.py):
+        # on-path feature/score sketches compared against a pinned
+        # reference, score calibration against mined outcomes, and the
+        # raise/clear drift alerts the drift_quiet promotion gate reads.
+        self.drift_rows_total = self.registry.counter(
+            f"{service}_drift_rows_total",
+            "Scored rows handled by the drift observatory by {outcome}: "
+            "sketched = folded into the rolling window by the drift "
+            "worker, dropped = the bounded sketch queue was full "
+            "(scoring is never blocked), skipped = unsketchable rows "
+            "(int8-compressed wire, heuristic tier)",
+        )
+        self.drift_window_rows = self.registry.gauge(
+            f"{service}_drift_window_rows",
+            "Rows currently inside the drift engine's rolling window "
+            "(evaluation needs DRIFT_MIN_ROWS before it trusts PSI)",
+        )
+        self.drift_psi = self.registry.gauge(
+            f"{service}_drift_psi",
+            "Per-feature Population Stability Index of the rolling "
+            "window vs the pinned reference by {feature} (bounded: the "
+            "30-name feature schema); > DRIFT_PSI_ALERT raises the "
+            "input drift alert",
+        )
+        self.drift_ks = self.registry.gauge(
+            f"{service}_drift_ks",
+            "Per-feature Kolmogorov-Smirnov statistic (binned, exact to "
+            "bucket resolution) of the rolling window vs the pinned "
+            "reference by {feature}",
+        )
+        self.drift_output_psi = self.registry.gauge(
+            f"{service}_drift_output_psi",
+            "PSI of the model OUTPUT distributions vs the pinned "
+            "reference by {dist} (score = the 0-100 risk-score "
+            "histogram, action = approve/review/block counts) — output "
+            "shift with quiet inputs is concept drift",
+        )
+        self.drift_calibration_error = self.registry.gauge(
+            f"{service}_drift_calibration_error",
+            "Weighted |observed - reference| fraud rate across score "
+            "bins over the calibration window (outcomes mined from the "
+            "decision WAL); > DRIFT_CAL_ALERT raises the calibration "
+            "drift alert",
+        )
+        self.drift_shadow_divergence = self.registry.gauge(
+            f"{service}_drift_shadow_divergence",
+            "Mean |candidate - production| score delta of shadow-scored "
+            "rows over the drift window — candidate divergence trended "
+            "next to input drift so a drifting candidate is visible "
+            "before any promotion gate runs",
+        )
+        self.drift_alert = self.registry.gauge(
+            f"{service}_drift_alert",
+            "Drift alert state by {kind} (input / score / calibration): "
+            "1 while the kind's divergence is at/above its raise "
+            "threshold (hysteresis clears at half) — any active kind "
+            "holds promotion via the drift_quiet gate",
+        )
+        self.drift_alerts_total = self.registry.counter(
+            f"{service}_drift_alerts_total",
+            "Drift alert RAISE transitions by {kind} — one per "
+            "incident, not one per drifted batch",
+        )
         self.spans_dropped_total = self.registry.counter(
             f"{service}_spans_dropped_total",
             "Host spans evicted from the bounded span ring before export "

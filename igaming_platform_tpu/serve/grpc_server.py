@@ -29,6 +29,7 @@ import grpc
 
 from igaming_platform_tpu.core.enums import ReasonCode
 from igaming_platform_tpu.obs import flight as _flight
+from igaming_platform_tpu.obs import drift as _drift
 from igaming_platform_tpu.obs import runtime_telemetry as _runtime_telemetry
 from igaming_platform_tpu.obs import slo as _slo
 from igaming_platform_tpu.obs import tracing
@@ -416,6 +417,19 @@ class RiskGrpcService:
             _slo.install(_slo.SLOEngine(metrics=self.metrics))
         else:
             _slo.uninstall()
+        # Drift observatory (obs/drift.py, DRIFT=0 opts out): on-path
+        # feature/score sketches vs a pinned reference, calibration vs
+        # mined outcomes, and the drift_quiet promotion gate's alert
+        # state. Same ownership contract as the SLO plane; the engine
+        # compiles + warms its sketch kernels at bind time.
+        self.drift = None
+        if os.environ.get("DRIFT", "1") != "0" and hasattr(engine,
+                                                           "bind_drift"):
+            self.drift = _drift.install(_drift.DriftEngine(
+                metrics=self.metrics))
+            engine.bind_drift(self.drift)
+        else:
+            _drift.uninstall()
         self.telemetry = None
         if os.environ.get("RUNTIME_TELEMETRY", "1") != "0":
             self.telemetry = _runtime_telemetry.install(self.metrics)

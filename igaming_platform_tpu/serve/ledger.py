@@ -797,6 +797,18 @@ class DecisionLedger:
         self._writing = False  # writer mid-batch (flush must wait it out)
         self._stopping = False
 
+        # Recently-issued decision-batch prefixes (guarded by _cv):
+        # prefix -> row count, bounded FIFO. This is what lets
+        # POST /debug/outcomes answer "is this decision id one this
+        # process issued" without scanning the WAL — unknown ids are
+        # still appended (the WAL may hold pre-restart decisions) but
+        # counted separately so a backfill harness can see dropped joins.
+        from collections import OrderedDict as _OrderedDict
+
+        self._recent_prefixes: "_OrderedDict[str, int]" = _OrderedDict()
+        self._recent_prefix_max = int(
+            os.environ.get("LEDGER_RECENT_PREFIXES", "65536"))
+
         # Stats (guarded by _cv).
         self.records_appended = 0
         self.records_dropped = 0
@@ -898,11 +910,38 @@ class DecisionLedger:
             else:
                 self._pending.append(batch)
                 self._pending_rows += batch.n
+                prefix = getattr(batch, "prefix", None)
+                if prefix:
+                    self._note_prefix(prefix, batch.n)
                 dropped = False
             self._cv.notify()
         if dropped and self._metrics is not None:
             self._metrics.ledger_dropped_total.inc(batch.n, reason="queue_full")
         return not dropped
+
+    def _note_prefix(self, prefix: str, n: int) -> None:
+        """Caller holds _cv. Bounded FIFO of issued batch prefixes."""
+        self._recent_prefixes[prefix] = n
+        self._recent_prefixes.move_to_end(prefix)
+        while len(self._recent_prefixes) > self._recent_prefix_max:
+            self._recent_prefixes.popitem(last=False)
+
+    def knows_decision(self, decision_id: str) -> bool:
+        """True when ``decision_id`` belongs to a batch this process
+        issued recently (row index inside the batch's row count). False
+        for foreign/mistyped ids AND for pre-restart ids — callers treat
+        unknown as "join at risk", not "reject"."""
+        prefix, _, row = decision_id.rpartition(".")
+        with self._cv:
+            if decision_id in self._recent_prefixes:
+                return True
+            n = self._recent_prefixes.get(prefix) if prefix else None
+        if n is None:
+            return False
+        try:
+            return 0 <= int(row) < n
+        except ValueError:
+            return False
 
     def append_record(self, record: DecisionRecord) -> bool:
         """Single-record convenience (tests / tools); same guarantees."""
@@ -941,7 +980,16 @@ class DecisionLedger:
             def to_records(self):
                 return self._recs
 
-        return self.append_columns(_Ready(records))  # type: ignore[arg-type]
+        ok = self.append_columns(_Ready(records))  # type: ignore[arg-type]
+        if ok:
+            # Pre-built DECISION records register their full ids for the
+            # knows_decision check (outcome/promotion side-records carry
+            # decision_id too but are not decisions — never registered).
+            with self._cv:
+                for rec in records:
+                    if isinstance(rec, DecisionRecord) and rec.decision_id:
+                        self._note_prefix(rec.decision_id, 1)
+        return ok
 
     def append_outcome(self, record: OutcomeRecord) -> bool:
         """Label backfill (the v2 side-record): durably append a

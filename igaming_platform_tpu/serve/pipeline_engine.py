@@ -316,7 +316,8 @@ class HostPipeline:
             xp, _ = pad_batch(chunk, shape, out=xp_buf)
             bl_buf = self._arena.acquire((shape,), np.bool_)
             blp, _ = pad_batch(blc, shape, out=bl_buf)
-        out = engine._launch_padded(xp, blp, use_host, snap=job.snap)
+        out = engine._launch_padded(xp, blp, use_host, snap=job.snap,
+                                    n_valid=n)
         return out, xp_buf, bl_buf
 
     def _stage_loop(self) -> None:

@@ -175,6 +175,16 @@ class TPUScoringEngine:
         # params score the live stream off the note_decisions seam with
         # zero effect on responses.
         self.shadow = None
+        # Drift observatory (obs/drift.py): bound via bind_drift by the
+        # serving layer; None keeps every launch a single attribute
+        # check. When bound, each dispatch adds ONE fused device-side
+        # sketch reduction over the already-resident batch (the donated
+        # echo / the HBM cache rows) — the tiny result vector drains to
+        # the drift worker thread, never a host sync on this path.
+        self.drift = None
+        self._drift_sketch_fn = None
+        self._drift_cached_fn = None
+        self._drift_lock = threading.Lock()
         self.params_fingerprint = ledger_mod.params_fingerprint(params)
         self.features = feature_store or InMemoryFeatureStore()
         bcfg = batcher_config or BatcherConfig()
@@ -435,6 +445,97 @@ class TPUScoringEngine:
         if self._host_pipeline is not None:
             self._host_pipeline.bind_metrics(metrics)
 
+    # -- drift observatory (obs/drift.py) ------------------------------------
+
+    def bind_drift(self, drift_engine) -> None:
+        """Attach a DriftEngine and build + AOT-warm the jitted sketch
+        reductions for every ladder shape, so the first live request
+        never pays the compile. The sketch consumes the batch echo the
+        packed step already returns (device-resident — zero extra H2D)
+        and its D2H read happens on the drift worker, keeping the hot
+        path free of added syncs."""
+        if drift_engine is None:
+            self.drift = None
+            return
+        from igaming_platform_tpu.obs import drift as drift_mod
+
+        sk = jax.jit(drift_mod.sketch_kernel)
+        # Warm with the dtypes the launch paths actually ship: the wire
+        # dtype on the device path (f32 default, bf16 opt-in — int8 is
+        # skipped at note time, its quantized domain sketches garbage)
+        # plus f32 for the host latency tier.
+        dtypes = {np.dtype(np.float32)}
+        if self._wire_dtype is not np.int8:
+            dtypes.add(np.dtype(self._wire_dtype))
+        for shape in self._shapes:
+            packed = np.zeros((5, shape), dtype=np.int32)
+            for dt in dtypes:
+                x = np.zeros((shape, NUM_FEATURES), dtype=dt)
+                jax.device_get(sk(x, packed, np.int32(0)))
+        self._drift_sketch_fn = sk
+        self.drift = drift_engine
+        if self.cache is not None:
+            self._ensure_drift_cached_fn()
+
+    def _ensure_drift_cached_fn(self):
+        """Build (once) the index-mode sketch step — the cache rows live
+        in HBM, so the sketch re-gathers them on device (the same
+        composition as the cached score step) and reduces in place."""
+        if self._drift_cached_fn is not None or self.cache is None:
+            return self._drift_cached_fn
+        with self._drift_lock:
+            if self._drift_cached_fn is None:
+                from igaming_platform_tpu.obs import drift as drift_mod
+
+                fn = jax.jit(drift_mod.cached_sketch_kernel)
+                # AOT-warm every ladder shape against the live table.
+                for shape in self._shapes:
+                    idxs = np.zeros((shape,), dtype=np.int32)
+                    amounts = np.zeros((shape,), dtype=np.float32)
+                    types = np.full((shape,), 4, dtype=np.int32)
+                    packed = np.zeros((5, shape), dtype=np.int32)
+                    jax.device_get(fn(
+                        self.cache.table, idxs, amounts, types, packed,
+                        np.int32(0)))
+                self._drift_cached_fn = fn
+        return self._drift_cached_fn
+
+    def _note_drift(self, echo, packed, n: int) -> None:
+        """Dispatch the fused sketch reduction over a just-launched
+        batch (``echo`` is the donated-batch echo output — device
+        resident by construction) and hand the result vector to the
+        drift engine's bounded queue. Never raises, never blocks, never
+        adds a host sync: failures count in the engine's own report."""
+        drift = self.drift
+        if drift is None or n <= 0:
+            return
+        try:
+            if echo.dtype == np.int8:
+                # int8 wire compression: the echo carries the QUANTIZED
+                # domain; sketching it would monitor codec artifacts,
+                # not traffic. Counted, not silently missing.
+                drift.note_skipped(n, "compressed")
+                return
+            drift.submit(self._drift_sketch_fn(echo, packed, np.int32(n)), n)
+        except Exception:  # noqa: CC04 — drift observability must never fail scoring; the engine counts its errors
+            drift.note_error()
+
+    def _note_drift_cached(self, idxsp, amtp, typp, packed, n: int) -> None:
+        """Index-mode twin of ``_note_drift``: sketch from the
+        device-resident feature table rows (host never materializes
+        them)."""
+        drift = self.drift
+        if drift is None or n <= 0:
+            return
+        try:
+            fn = self._ensure_drift_cached_fn()
+            if fn is None:
+                return
+            drift.submit(fn(self.cache.table, idxsp, amtp, typp, packed,
+                            np.int32(n)), n)
+        except Exception:  # noqa: CC04 — drift observability must never fail scoring; the engine counts its errors
+            drift.note_error()
+
     def _ensure_pipeline(self):
         """Build (once) the staged host pipeline; None when disabled."""
         if not self._pipeline_enabled:
@@ -451,22 +552,28 @@ class TPUScoringEngine:
         return self._host_pipeline
 
     def _launch_padded(self, xp: np.ndarray, blp: np.ndarray, use_host: bool,
-                       snap: tuple | None = None):
+                       snap: tuple | None = None,
+                       n_valid: int | None = None):
         """Dispatch one already-padded staging batch (pipeline dispatch
         worker). The caller owns the staging buffers and must keep them
         alive until readback — jax may alias host memory zero-copy on
         the CPU backend. ``snap`` (params_snapshot) pins the params a
-        multi-chunk job scores with across a concurrent hot-swap."""
+        multi-chunk job scores with across a concurrent hot-swap;
+        ``n_valid`` (rows before padding) masks the drift sketch."""
         if snap is None:
             snap = self.params_snapshot()
+        if n_valid is None:
+            n_valid = xp.shape[0]
         params = snap[1] if use_host else snap[0]
         thresholds = self._thresholds_host if use_host else self._thresholds
         if use_host:
             _device_dispatch("packed_step_host", xp.shape, xp.dtype)
-            out, _ = self._fn_host(params, xp, blp, thresholds)
+            out, echo = self._fn_host(params, xp, blp, thresholds)
+            self._note_drift(echo, out, n_valid)
             return out
         _device_dispatch("packed_step", xp.shape, xp.dtype)
-        out, _ = self._packed_fn(params, xp, blp, thresholds)
+        out, echo = self._packed_fn(params, xp, blp, thresholds)
+        self._note_drift(echo, out, n_valid)
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
         return out
@@ -616,6 +723,10 @@ class TPUScoringEngine:
                     bl, self._thresholds)
                 jax.device_get(out)
             self.cache = cache
+        if self.drift is not None:
+            # A drift engine bound before the cache existed: compile +
+            # warm the index-mode sketch now, off the live request path.
+            self._ensure_drift_cached_fn()
         return cache
 
     def _launch_cached(self, idxs: np.ndarray, amounts: np.ndarray,
@@ -638,6 +749,10 @@ class TPUScoringEngine:
         out = self._cached_fn(
             params, self.cache.table, self.cache.flags,
             idxsp, amtp, typp, blp, self._thresholds)
+        # Index-mode drift sketch: re-gather the scored rows from the
+        # HBM table and reduce on device — the rows never exist on the
+        # host, and neither does any new sync (obs/drift.py).
+        self._note_drift_cached(idxsp, amtp, typp, out, n)
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
         return out, n
@@ -838,14 +953,18 @@ class TPUScoringEngine:
         thresholds = self._thresholds_host if use_host else self._thresholds
         if use_host:
             _device_dispatch("packed_step_host", xp.shape, xp.dtype)
-            out, _ = self._fn_host(params, xp, blp, thresholds)
+            out, echo = self._fn_host(params, xp, blp, thresholds)
+            self._note_drift(echo, out, n)
             return out, n
         # The echo (the donated staging slot, recycled in place) is
         # dropped here: this lockstep path pads into fresh arrays. The
         # pipelined path (serve/pipeline_engine.py) holds its arena
-        # buffers until readback instead.
+        # buffers until readback instead. With a drift engine bound, the
+        # echo first feeds ONE extra fused sketch reduction — device to
+        # device, drained off-path (obs/drift.py).
         _device_dispatch("packed_step", xp.shape, xp.dtype)
-        out, _ = self._packed_fn(params, xp, blp, thresholds)
+        out, echo = self._packed_fn(params, xp, blp, thresholds)
+        self._note_drift(echo, out, n)
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
         return out, n

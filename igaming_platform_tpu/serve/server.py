@@ -239,9 +239,11 @@ class RiskServer:
         # anomaly->profile trigger (the /debug/profilez capture path,
         # artifacts keyed by the anomalous trace id, cooldown enforced
         # by the telemetry side).
+        from igaming_platform_tpu.obs import drift as drift_mod
         from igaming_platform_tpu.obs import slo as slo_mod
 
         self.slo = slo_mod.get_default()
+        self.drift = drift_mod.get_default()
         self.telemetry = service.telemetry
         if self.telemetry is not None:
             self.telemetry.bind_profile_trigger(self._anomaly_profile_trigger)
@@ -313,6 +315,15 @@ class RiskServer:
 
             shadow = ShadowScorer(self.engine, metrics=self.metrics)
             inner_engine.shadow = shadow
+            # Drift observatory join (obs/drift.py): candidate-vs-prod
+            # divergence trends through the same rolling windows as
+            # input drift, so a drifting candidate is on the drift
+            # dashboard before any promotion gate evaluates it.
+            from igaming_platform_tpu.obs import drift as _drift_mod
+
+            drift_engine = _drift_mod.get_default()
+            if drift_engine is not None:
+                shadow.on_result = drift_engine.note_shadow_result
             controller = PromotionController(
                 self.engine, shadow, ledger=self.ledger,
                 vault_dir=os.path.join(ledger_dir, "params-vault"),
@@ -529,6 +540,19 @@ class RiskServer:
                         self._send(404, '{"error":"slo engine disabled"}')
                         return
                     self._send(200, json.dumps(slo_engine.snapshot()))
+                elif self.path == "/debug/driftz":
+                    # Drift & data-quality observatory: rolling-window
+                    # sketches vs the pinned reference (PSI/KS per
+                    # feature), score calibration, shadow divergence,
+                    # and the raise/clear alert timeline (runbook:
+                    # docs/operations.md "Drift & data quality").
+                    from igaming_platform_tpu.obs import drift as _drift_mod
+
+                    drift_engine = _drift_mod.get_default()
+                    if drift_engine is None:
+                        self._send(404, '{"error":"drift observatory disabled"}')
+                        return
+                    self._send(200, json.dumps(drift_engine.snapshot()))
                 elif self.path == "/debug/telemetryz":
                     # Device-runtime telemetry: compile events, dispatch
                     # counts, step-time EWMAs, anomaly + auto-profile log.
@@ -652,11 +676,54 @@ class RiskServer:
                         self._send(400, json.dumps({"error": str(exc)}))
                         return
                     self._send(200, json.dumps(ctl.report()))
+                elif self.path == "/debug/driftz":
+                    # Reference management (runbook): {"action":
+                    # "pin_reference"} pins the current rolling window,
+                    # {"action": "load"|"save", "path": ...} round-trips
+                    # a checkpointed reference (tools/driftref.py mints
+                    # one offline from a ledger segment).
+                    from igaming_platform_tpu.obs import drift as _drift_mod
+
+                    drift_engine = _drift_mod.get_default()
+                    if drift_engine is None:
+                        self._send(404, '{"error":"drift observatory disabled"}')
+                        return
+                    action = str(payload.get("action", ""))
+                    try:
+                        if action == "pin_reference":
+                            min_rows = payload.get("min_rows")
+                            ref = drift_engine.pin_reference(
+                                source=str(payload.get(
+                                    "source", "pinned-via-driftz")),
+                                min_rows=(int(min_rows)
+                                          if min_rows is not None else None))
+                        elif action == "load":
+                            ref = drift_engine.load_reference(
+                                str(payload["path"]))
+                        elif action == "save":
+                            ref = drift_engine.reference
+                            if ref is None:
+                                raise ValueError("no reference pinned")
+                            ref.save(str(payload["path"]))
+                        else:
+                            raise ValueError(
+                                f"unknown driftz action {action!r} (use "
+                                "pin_reference|load|save)")
+                    except (KeyError, ValueError, OSError) as exc:  # noqa: CC04 — surfaced to the caller as a 400 body, not swallowed
+                        self._send(400, json.dumps({"error": str(exc)}))
+                        return
+                    self._send(200, json.dumps({
+                        "ok": True, "reference": ref.meta(),
+                        "alerts": drift_engine.alerts_active()}))
                 elif self.path == "/debug/outcomes":
                     # Label backfill (the v2 ledger side-record): the
                     # operational entry for ground-truth outcomes —
                     # chargebacks, manual-review verdicts, cleared
                     # disputes — joined to decisions by decision_id.
+                    # Malformed bodies are a 400, not a silent 200, and
+                    # the response splits accepted vs UNKNOWN decision
+                    # ids so a backfill harness can tell dropped joins
+                    # from delivered ones.
                     led = getattr(server_ref, "ledger", None)
                     if led is None:
                         self._send(404, '{"error":"ledger disabled"}')
@@ -665,14 +732,33 @@ class RiskServer:
                         ledger as _ledger_mod,
                     )
 
+                    if not isinstance(payload, dict):
+                        self._send(400, '{"error":"body must be a JSON object"}')
+                        return
                     rows = payload.get("outcomes")
                     if rows is None:
                         rows = [payload]
-                    accepted = 0
+                    if not isinstance(rows, list):
+                        self._send(400, '{"error":"outcomes must be a list"}')
+                        return
                     for row in rows:
-                        did = str(row.get("decision_id", ""))
-                        if not did:
-                            continue
+                        if (not isinstance(row, dict)
+                                or not str(row.get("decision_id", ""))):
+                            self._send(400, json.dumps({
+                                "error": "each outcome needs a non-empty "
+                                         "decision_id",
+                                "bad_row": repr(row)[:120]}))
+                            return
+                    accepted = 0
+                    unknown = 0
+                    for row in rows:
+                        did = str(row["decision_id"])
+                        if not led.knows_decision(did):
+                            # Still appended (the WAL may hold the
+                            # decision from before a restart; the miner
+                            # joins at-least-once) — but counted, so the
+                            # caller sees the join risk.
+                            unknown += 1
                         if led.append_outcome(_ledger_mod.OutcomeRecord(
                                 decision_id=did,
                                 label=1 if row.get("label") else 0,
@@ -680,6 +766,7 @@ class RiskServer:
                                 ts_unix=_ledger_mod.wall_clock())):
                             accepted += 1
                     self._send(200, json.dumps({"accepted": accepted,
+                                                "unknown": unknown,
                                                 "submitted": len(rows)}))
                 elif self.path == "/debug/score":
                     resp = server_ref.engine.score(ScoreRequest(

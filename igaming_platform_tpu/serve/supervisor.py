@@ -922,6 +922,12 @@ class SupervisedScoringEngine:
         # So does the shadow scorer — the online loop keeps accumulating
         # candidate evidence against the rebuilt engine's stream.
         new.shadow = getattr(old, "shadow", None)
+        # And the drift observatory: its rolling windows + pinned
+        # reference outlive the engine; the rebuilt engine re-jits its
+        # sketch kernels through the same bind seam.
+        drift = getattr(old, "drift", None)
+        if drift is not None and hasattr(new, "bind_drift"):
+            new.bind_drift(drift)
         old_b = getattr(old, "_batcher", None)
         new_b = getattr(new, "_batcher", None)
         if old_b is not None and new_b is not None:

@@ -25,6 +25,8 @@ Returns (x [n,30] raw features, y [n] binary label, kind [n] archetype).
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+
 import numpy as np
 
 from igaming_platform_tpu.core.features import F, NUM_FEATURES, derive_tx_avg
@@ -141,6 +143,107 @@ def _plant_bonus_abuse(rng: np.random.Generator, x: np.ndarray) -> None:
     x[:, F.ACCOUNT_AGE_DAYS] = rng.integers(0, 60, n)
     x[:, F.DISPOSABLE_EMAIL] = (rng.random(n) < 0.4).astype(np.float32)
     x[:, F.UNIQUE_DEVICES_24H] = rng.integers(1, 5, n)
+
+
+# ---------------------------------------------------------------------------
+# Injectable, deterministic drift (the drift observatory's test signal)
+
+
+@dataclass(frozen=True)
+class DriftRamp:
+    """A seedable mean/scale shift on a chosen feature subset, ramped
+    over a run — the deterministic drift injector the soak harness and
+    load generator share (obs/drift.py is the detector under test).
+
+    At run fraction ``frac`` the ramp progress is 0 before
+    ``start_frac``, 1 after ``end_frac``, linear between; a drifted
+    value is ``v * mult(progress) + shift(progress)`` where ``mult``
+    interpolates 1 -> ``scale_mult`` and ``shift`` 0 -> ``mean_shift``.
+    Spec strings are colon-separated k=v pairs (the CHAOS_PLAN idiom):
+    ``mult=8:start=0.4:end=0.6:features=tx_amount+tx_sum_1h``.
+    """
+
+    features: tuple[str, ...] = ("tx_amount",)
+    scale_mult: float = 1.0
+    mean_shift: float = 0.0
+    start_frac: float = 0.0
+    end_frac: float = 1.0
+
+    def __post_init__(self):
+        names = {f.name.lower() for f in F}
+        bad = [f for f in self.features if f not in names]
+        if bad:
+            raise ValueError(f"unknown drift features {bad} (schema: "
+                             "core/features.py F)")
+        if not (0.0 <= self.start_frac <= 1.0 and self.end_frac >= self.start_frac):
+            raise ValueError("need 0 <= start_frac <= end_frac")
+
+    @classmethod
+    def parse(cls, spec: str) -> "DriftRamp":
+        kv: dict[str, str] = {}
+        for part in spec.split(":"):
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(f"bad drift-ramp token {part!r} "
+                                 "(want k=v[:k=v...])")
+            k, v = part.split("=", 1)
+            kv[k.strip()] = v.strip()
+        return cls(
+            features=tuple(
+                f for f in kv.get("features", "tx_amount").split("+") if f),
+            scale_mult=float(kv.get("mult", "1.0")),
+            mean_shift=float(kv.get("shift", "0.0")),
+            start_frac=float(kv.get("start", "0.0")),
+            end_frac=float(kv.get("end", "1.0")),
+        )
+
+    def spec_string(self) -> str:
+        return (f"features={'+'.join(self.features)}:mult={self.scale_mult}"
+                f":shift={self.mean_shift}:start={self.start_frac}"
+                f":end={self.end_frac}")
+
+    def feature_indices(self) -> list[int]:
+        return [int(F[name.upper()]) for name in self.features]
+
+    def progress(self, frac: float) -> float:
+        if self.end_frac <= self.start_frac:
+            return 1.0 if frac >= self.start_frac else 0.0
+        return float(np.clip(
+            (frac - self.start_frac) / (self.end_frac - self.start_frac),
+            0.0, 1.0))
+
+    def factors(self, frac: float) -> tuple[float, float]:
+        """(mult, shift) at run fraction ``frac``."""
+        p = self.progress(frac)
+        return 1.0 + p * (self.scale_mult - 1.0), p * self.mean_shift
+
+    def schedule_block(self, phases: int = 8) -> list[dict]:
+        """The injected schedule, recorded verbatim in artifacts so a
+        drift run is reproducible from its JSON alone."""
+        out = []
+        for ph in range(phases):
+            frac = (ph + 0.5) / phases
+            mult, shift = self.factors(frac)
+            out.append({"phase": ph, "frac": round(frac, 4),
+                        "progress": round(self.progress(frac), 4),
+                        "mult": round(mult, 4), "shift": round(shift, 4)})
+        return out
+
+
+def apply_drift_ramp(x: np.ndarray, ramp: DriftRamp, frac: float) -> np.ndarray:
+    """Return a drifted COPY of ``x`` ([..., 30] raw features) at run
+    fraction ``frac`` — only the ramp's feature subset moves. Derived
+    features (TX_AVG_1H) are re-derived when their inputs drifted, so
+    the injected rows stay internally consistent."""
+    mult, shift = ramp.factors(frac)
+    out = np.array(x, dtype=np.float32, copy=True)
+    idxs = ramp.feature_indices()
+    for i in idxs:
+        out[..., i] = out[..., i] * mult + shift
+    if int(F.TX_SUM_1H) in idxs or int(F.TX_COUNT_1H) in idxs:
+        derive_tx_avg(out)
+    return out
 
 
 def generate_labeled(

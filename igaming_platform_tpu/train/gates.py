@@ -94,6 +94,11 @@ class PromotionGates:
     # serving path is already in trouble; a param swap mid-incident
     # destroys attribution).
     require_slo_quiet: bool = True
+    # Drift plane (obs/drift.py): no promotion while input, score or
+    # calibration drift is alerting — a candidate trained on drifted
+    # data can pass every latency and probe gate and still be the wrong
+    # model to promote; drift evidence must settle first.
+    require_drift_quiet: bool = True
     # Post-promotion watch: the live probe AUC floor below which the
     # controller rolls back to last-known-good within one tick.
     min_post_auc: float = 0.85
@@ -120,6 +125,8 @@ class PromotionGates:
             max_flip_rate=_f("PROMOTE_MAX_FLIP_RATE", cls.max_flip_rate),
             require_slo_quiet=os.environ.get(
                 "PROMOTE_REQUIRE_SLO_QUIET", "1") != "0",
+            require_drift_quiet=os.environ.get(
+                "PROMOTE_REQUIRE_DRIFT_QUIET", "1") != "0",
             min_post_auc=_f("PROMOTE_MIN_POST_AUC", cls.min_post_auc),
             rollback_on_slo_page=os.environ.get(
                 "PROMOTE_ROLLBACK_ON_SLO_PAGE", "1") != "0",
@@ -138,6 +145,7 @@ def promotion_gate_table(
     flip_rate: float,
     slo_alerting: bool,
     gates: PromotionGates,
+    drift_alerting: bool = False,
 ) -> dict:
     """The admit gate table: gate name -> {ok, value, bound}. Promotion
     fires only when every row's ``ok`` is True; the table itself is what
@@ -160,6 +168,9 @@ def promotion_gate_table(
         "slo_quiet": {
             "value": bool(slo_alerting), "bound": False,
             "ok": (not slo_alerting) or not gates.require_slo_quiet},
+        "drift_quiet": {
+            "value": bool(drift_alerting), "bound": False,
+            "ok": (not drift_alerting) or not gates.require_drift_quiet},
     }
     return table
 

@@ -48,6 +48,10 @@ class MinedExamples:
     hard: np.ndarray  # [n] bool — hard negative OR hard positive
     decision_ids: list = field(default_factory=list)
     counts: dict = field(default_factory=dict)
+    # The decision's served score per example — the drift observatory's
+    # calibration feed ((score, outcome) pairs, obs/drift.py).
+    scores: np.ndarray = field(
+        default_factory=lambda: np.empty((0,), np.float32))
 
     @property
     def n(self) -> int:
@@ -104,6 +108,7 @@ class LedgerMiner:
         ys: list[float] = []
         hard: list[bool] = []
         ids: list[str] = []
+        scs: list[float] = []
         s = self.stats
         for payload in self._new_frames():
             s["frames_scanned"] += 1
@@ -140,6 +145,7 @@ class LedgerMiner:
                 ys.append(label)
                 hard.append(is_hard_neg or is_hard_pos)
                 ids.append(rec.decision_id)
+                scs.append(float(score))
                 s["mined_total"] += 1
                 if is_hard_neg:
                     s["hard_negatives"] += 1
@@ -153,7 +159,8 @@ class LedgerMiner:
             x=x, y=np.asarray(ys, np.float32),
             hard=np.asarray(hard, bool), decision_ids=ids,
             counts={"hard_negatives": s["hard_negatives"],
-                    "hard_positives": s["hard_positives"]})
+                    "hard_positives": s["hard_positives"]},
+            scores=np.asarray(scs, np.float32))
         if self._metrics is not None and mined.n:
             self._metrics.online_mined_total.inc(
                 mined.n - int(mined.hard.sum()), kind="labeled")
@@ -305,6 +312,16 @@ class OnlineLoop:
         window each time and the rows-floor gate could never pass."""
         t0 = time.monotonic()
         mined = self.miner.poll()
+        if mined.n:
+            # Calibration feed (obs/drift.py): every (served score,
+            # ground-truth outcome) pair the miner joined folds into the
+            # drift observatory's calibration window — the signal behind
+            # the calibration drift alert and the drift_quiet gate.
+            from igaming_platform_tpu.obs import drift as drift_mod
+
+            drift = drift_mod.get_default()
+            if drift is not None:
+                drift.note_outcomes(mined.scores, mined.y)
         self.learner.ingest(mined)
         trained = False
         if self.learner.examples_ingested >= self.min_examples_to_train:

@@ -185,6 +185,21 @@ class PromotionController:
             logger.warning("promotion SLO read failed", exc_info=True)
             return False
 
+    def _drift_alerting(self) -> bool:
+        """Any active drift alert (input/score/calibration) from the
+        process-default drift observatory — the drift_quiet gate's
+        input. No observatory (DRIFT=0 deployments) reads as quiet."""
+        from igaming_platform_tpu.obs import drift as drift_mod
+
+        drift = drift_mod.get_default()
+        if drift is None:
+            return False
+        try:
+            return any(drift.alerts_active().values())
+        except Exception:  # noqa: CC04 — a broken drift read must not wedge promotion; treated as quiet
+            logger.warning("promotion drift read failed", exc_info=True)
+            return False
+
     def gate_check(self, candidate_params: Any) -> tuple[bool, dict]:
         """The admit gate table for a candidate (train/gates.py is the
         single source of the bounds)."""
@@ -196,6 +211,7 @@ class PromotionController:
             flip_rate=self.shadow.flip_rate(),
             slo_alerting=self._slo_alerting(),
             gates=self.gates,
+            drift_alerting=self._drift_alerting(),
         )
         ok = gates_mod.gates_pass(table)
         if not ok and self._metrics is not None:

@@ -1,0 +1,444 @@
+"""Drift & data-quality observatory (obs/drift.py): kernel parity with
+the numpy oracle, PSI/KS math, raise/clear alert hysteresis, reference
+round-trips, calibration drift, the drift_quiet promotion gate, the
+deterministic DriftRamp injector, exposition validity + bounded label
+cardinality for every risk_drift_* series, and on-path sketching through
+every scoring path (direct batch, batcher, wire, index mode)."""
+
+import re
+
+import numpy as np
+import pytest
+
+from igaming_platform_tpu.core.features import F, FEATURE_NAMES, NUM_FEATURES
+from igaming_platform_tpu.obs import drift as dm
+from igaming_platform_tpu.obs.metrics import ServiceMetrics
+from igaming_platform_tpu.train import gates as gates_mod
+from igaming_platform_tpu.train.fraudgen import (
+    DriftRamp,
+    apply_drift_ramp,
+    generate_labeled,
+)
+
+
+def _random_batch(seed: int, n: int):
+    rng = np.random.default_rng(seed)
+    x, _y, _k = generate_labeled(rng, n)
+    scores = rng.integers(0, 101, n).astype(np.int64)
+    actions = rng.integers(1, 4, n).astype(np.int64)
+    return x.astype(np.float32), scores, actions
+
+
+# ---------------------------------------------------------------------------
+# Kernel + math
+
+
+def test_sketch_kernel_matches_numpy_oracle_including_pad_mask():
+    import jax
+
+    x, scores, actions = _random_batch(0, 41)
+    shape = 64
+    xp = np.zeros((shape, NUM_FEATURES), np.float32)
+    xp[:41] = x
+    packed = np.zeros((5, shape), np.int32)
+    packed[0, :41] = scores
+    packed[1, :41] = actions
+    # Pad rows carry garbage that MUST be masked out.
+    xp[41:] = 1e9
+    packed[0, 41:] = 100
+    vec = np.asarray(jax.jit(dm.sketch_kernel)(xp, packed, np.int32(41)),
+                     np.float64)
+    ref = dm.np_sketch(x, scores, actions)
+    assert vec[dm.OFF_ROWS] == ref[dm.OFF_ROWS] == 41
+    # Histograms are exact counts; moments agree to f32 accumulation.
+    assert np.array_equal(vec[dm.OFF_FHIST:], ref[dm.OFF_FHIST:])
+    np.testing.assert_allclose(
+        vec[dm.OFF_SUM:dm.OFF_FHIST], ref[dm.OFF_SUM:dm.OFF_FHIST],
+        rtol=1e-4)
+
+
+def test_cached_sketch_kernel_matches_row_kernel():
+    import jax
+
+    rng = np.random.default_rng(3)
+    table = rng.gamma(2.0, 100.0, (32, NUM_FEATURES)).astype(np.float32)
+    idxs = rng.integers(0, 32, 16).astype(np.int32)
+    amounts = rng.gamma(2.0, 5000.0, 16).astype(np.float32)
+    types = rng.integers(0, 3, 16).astype(np.int32)
+    packed = np.zeros((5, 16), np.int32)
+    packed[0] = rng.integers(0, 101, 16)
+    packed[1] = rng.integers(1, 4, 16)
+    cached = np.asarray(jax.jit(dm.cached_sketch_kernel)(
+        table, idxs, amounts, types, packed, np.int32(16)), np.float64)
+    # Row twin: compose the same rows on the host.
+    x = table[idxs].copy()
+    x[:, int(F.TX_AMOUNT)] = amounts
+    x[:, int(F.TX_TYPE_DEPOSIT)] = (types == 0)
+    x[:, int(F.TX_TYPE_WITHDRAW)] = (types == 1)
+    x[:, int(F.TX_TYPE_BET)] = (types == 2)
+    row = np.asarray(jax.jit(dm.sketch_kernel)(x, packed, np.int32(16)),
+                     np.float64)
+    assert np.array_equal(cached[dm.OFF_FHIST:], row[dm.OFF_FHIST:])
+
+
+def test_psi_and_ks_basic_properties():
+    same = np.array([10, 20, 30, 40], np.float64)
+    assert dm.psi(same, same) == pytest.approx(0.0, abs=1e-9)
+    assert dm.ks_stat(same, same) == pytest.approx(0.0, abs=1e-12)
+    disjoint = np.array([100, 0, 0, 0], np.float64)
+    other = np.array([0, 0, 0, 100], np.float64)
+    assert dm.psi(disjoint, other) > 1.0
+    assert dm.ks_stat(disjoint, other) == pytest.approx(1.0)
+    assert dm.ks_stat(disjoint, np.zeros(4)) == 0.0  # empty side: no claim
+
+
+def test_sketch_merge_is_exact_sum():
+    vecs = [dm.np_sketch(*_random_batch(s, 50)) for s in (1, 2, 3)]
+    merged = dm.merge_drift_windows(
+        [{"edges_fp": dm.edges_fingerprint(), "vec": v} for v in vecs])
+    assert merged["rows"] == 150
+    np.testing.assert_allclose(merged["vec"], np.sum(vecs, axis=0))
+
+
+def test_sketch_merge_rejects_mixed_edges_loudly():
+    vec = dm.np_sketch(*_random_batch(1, 10))
+    ok = {"edges_fp": dm.edges_fingerprint(), "vec": vec}
+    bad = {"edges_fp": "deadbeefdeadbeef", "vec": vec}
+    with pytest.raises(ValueError, match="edge fingerprint mismatch"):
+        dm.merge_drift_windows([ok, bad])
+    with pytest.raises(ValueError, match="sketch length"):
+        dm.merge_drift_windows([{"edges_fp": ok["edges_fp"],
+                                 "vec": vec[:-3]}])
+
+
+# ---------------------------------------------------------------------------
+# Engine: windows, alerts, reference, calibration
+
+
+def _fed_engine(clock, cfg=None):
+    eng = dm.DriftEngine(
+        cfg or dm.DriftConfig(window_s=10, bucket_s=1, min_rows=50,
+                              cal_window_s=60, cal_min_outcomes=40),
+        clock=clock)
+    return eng
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_alert_raises_on_drift_and_clears_with_hysteresis():
+    clock = _Clock()
+    eng = _fed_engine(clock)
+    try:
+        clean = dm.np_sketch(*_random_batch(7, 400))
+        eng.submit(clean, 400)
+        assert eng.drain(5)
+        ref = eng.pin_reference(source="test", min_rows=100)
+        assert ref.rows == 400
+        # Clean traffic vs its own reference: quiet.
+        eng.submit(dm.np_sketch(*_random_batch(8, 400)), 400)
+        assert eng.drain(5)
+        eng.evaluate()
+        assert eng.alerts_active() == {"input": False, "score": False,
+                                       "calibration": False}
+        # Shifted traffic: input alert must raise.
+        x, s, a = _random_batch(9, 400)
+        x[:, int(F.TX_AMOUNT)] *= 16.0
+        clock.t += 2
+        eng.submit(dm.np_sketch(x, s, a), 400)
+        assert eng.drain(5)
+        eng.evaluate()
+        assert eng.alerts_active()["input"] is True
+        events = [e for e in eng.snapshot()["alert_events"]
+                  if e["kind"] == "input"]
+        assert events and events[0]["event"] == "raised"
+        # Window rolls past the drifted bucket -> clears.
+        clock.t += 11
+        eng.submit(dm.np_sketch(*_random_batch(10, 400)), 400)
+        assert eng.drain(5)
+        eng.evaluate()
+        assert eng.alerts_active()["input"] is False
+        kinds = [(e["kind"], e["event"])
+                 for e in eng.snapshot()["alert_events"]]
+        assert ("input", "cleared") in kinds
+    finally:
+        eng.close()
+
+
+def test_reference_round_trip_and_edge_guard(tmp_path):
+    vec = dm.np_sketch(*_random_batch(4, 300))
+    ref = dm.DriftReference.from_sketch(vec, source="unit")
+    path = str(tmp_path / "ref.json")
+    ref.save(path)
+    loaded = dm.DriftReference.load(path)
+    assert loaded.fingerprint() == ref.fingerprint()
+    assert dm.psi_table(vec, loaded)["max_feature_psi"] == pytest.approx(
+        0.0, abs=1e-9)
+    # A reference minted under different edges must refuse to load.
+    payload = ref.to_json()
+    payload["edges_fp"] = "0" * 16
+    with pytest.raises(ValueError, match="edge fingerprint"):
+        dm.DriftReference.from_json(payload)
+
+
+def test_calibration_drift_alert():
+    clock = _Clock()
+    eng = _fed_engine(clock)
+    try:
+        rng = np.random.default_rng(5)
+        scores = rng.integers(0, 101, 600)
+        # Reference-era outcomes: fraud rate grows with score.
+        labels = (rng.random(600) < scores / 120.0).astype(np.float64)
+        eng.note_outcomes(scores, labels)
+        eng.submit(dm.np_sketch(*_random_batch(6, 200)), 200)
+        assert eng.drain(5)
+        eng.pin_reference(source="cal-test", min_rows=100)
+        assert eng.reference.calibration is not None
+        # Live outcomes matching the curve: quiet.
+        clock.t += 2
+        labels2 = (rng.random(600) < scores / 120.0).astype(np.float64)
+        eng.note_outcomes(scores, labels2)
+        eng.evaluate()
+        assert eng.alerts_active()["calibration"] is False
+        # The model's scores stop meaning anything: rates invert.
+        clock.t += 61  # old outcome buckets roll out of the cal window
+        labels3 = (rng.random(600) < (1.0 - scores / 120.0)).astype(np.float64)
+        eng.note_outcomes(scores, labels3)
+        eng.evaluate()
+        assert eng.alerts_active()["calibration"] is True
+    finally:
+        eng.close()
+
+
+def test_shadow_divergence_trend():
+    clock = _Clock()
+    eng = _fed_engine(clock)
+    try:
+        prod = {"action": np.array([1, 1, 2, 3]),
+                "score": np.array([10, 20, 55, 90])}
+        cand = {"action": np.array([1, 2, 2, 1]),
+                "score": np.array([12, 52, 55, 20])}
+        eng.note_shadow_result(cand, prod, 4)
+        snap = eng.snapshot()
+        assert snap["shadow"]["window_rows"] == 4
+        assert snap["shadow"]["flip_rate"] == pytest.approx(0.5)
+        assert snap["shadow"]["score_delta_mean"] == pytest.approx(
+            (2 + 32 + 0 + 70) / 4)
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# drift_quiet promotion gate
+
+
+def test_drift_quiet_gate_holds_promotion():
+    gates = gates_mod.PromotionGates()
+    common = dict(candidate_auc=0.99, baseline_auc=0.95, shadow_rows=1000,
+                  flip_rate=0.01, slo_alerting=False, gates=gates)
+    quiet = gates_mod.promotion_gate_table(drift_alerting=False, **common)
+    assert quiet["drift_quiet"]["ok"] is True
+    assert gates_mod.gates_pass(quiet)
+    alerting = gates_mod.promotion_gate_table(drift_alerting=True, **common)
+    assert alerting["drift_quiet"]["ok"] is False
+    assert not gates_mod.gates_pass(alerting)
+    # The env override disables the hold (recorded in the table).
+    relaxed = gates_mod.PromotionGates(require_drift_quiet=False)
+    table = gates_mod.promotion_gate_table(
+        drift_alerting=True, **{**common, "gates": relaxed})
+    assert table["drift_quiet"]["ok"] is True
+
+
+def test_controller_reads_default_drift_engine(monkeypatch):
+    from igaming_platform_tpu.train.promote import PromotionController
+
+    clock = _Clock()
+    eng = _fed_engine(clock)
+    try:
+        dm.install(eng)
+        checker = PromotionController.__new__(PromotionController)
+        assert checker._drift_alerting() is False
+        with eng._cv:
+            eng._alerts["input"] = True
+        assert checker._drift_alerting() is True
+    finally:
+        dm.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# DriftRamp (the deterministic injector)
+
+
+def test_drift_ramp_parse_factors_and_schedule():
+    ramp = DriftRamp.parse("mult=8:shift=100:start=0.25:end=0.75")
+    assert ramp.factors(0.0) == (1.0, 0.0)
+    assert ramp.factors(0.5) == (4.5, 50.0)
+    assert ramp.factors(1.0) == (8.0, 100.0)
+    again = DriftRamp.parse(ramp.spec_string())
+    assert again == ramp
+    sched = ramp.schedule_block(4)
+    assert [row["mult"] for row in sched] == [1.0, 2.75, 6.25, 8.0]
+    with pytest.raises(ValueError, match="unknown drift features"):
+        DriftRamp(features=("not_a_feature",))
+
+
+def test_apply_drift_ramp_moves_only_chosen_features_deterministically():
+    x, _s, _a = _random_batch(11, 64)
+    ramp = DriftRamp(features=("tx_amount", "unique_devices_24h"),
+                     scale_mult=4.0)
+    d1 = apply_drift_ramp(x, ramp, 1.0)
+    d2 = apply_drift_ramp(x, ramp, 1.0)
+    np.testing.assert_array_equal(d1, d2)  # deterministic
+    np.testing.assert_allclose(d1[:, int(F.TX_AMOUNT)],
+                               x[:, int(F.TX_AMOUNT)] * 4.0, rtol=1e-6)
+    untouched = [i for i in range(NUM_FEATURES)
+                 if i not in (int(F.TX_AMOUNT), int(F.UNIQUE_DEVICES_24H))]
+    np.testing.assert_array_equal(d1[:, untouched], x[:, untouched])
+    # TX_SUM drift re-derives the dependent average (no impossible rows).
+    ramp2 = DriftRamp(features=("tx_sum_1h",), scale_mult=3.0)
+    d3 = apply_drift_ramp(x, ramp2, 1.0)
+    nz = x[:, int(F.TX_COUNT_1H)] > 0
+    np.testing.assert_allclose(
+        d3[nz, int(F.TX_AVG_1H)],
+        d3[nz, int(F.TX_SUM_1H)] / np.maximum(d3[nz, int(F.TX_COUNT_1H)], 1),
+        rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Metrics: exposition validity + bounded label cardinality
+# (the tests/test_metrics_exposition.py pattern extended to risk_drift_*)
+
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
+    r' -?[0-9eE+.infa]+'
+    r'( # \{trace_id="[0-9a-f]+"\} -?[0-9eE+.]+ [0-9.]+)?$')
+_COMMENT_RE = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+$")
+
+
+def _validate_exposition(text: str) -> None:
+    types_seen: set[str] = set()
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            assert _COMMENT_RE.match(line), f"bad comment line: {line!r}"
+            kind, name = line.split(" ")[1], line.split(" ")[2]
+            if kind == "TYPE":
+                assert name not in types_seen, f"duplicate # TYPE {name}"
+                types_seen.add(name)
+        else:
+            assert _SAMPLE_RE.match(line), f"unparseable sample line: {line!r}"
+
+
+def test_drift_series_exposition_valid_and_labels_bounded():
+    metrics = ServiceMetrics("risk")
+    clock = _Clock()
+    eng = dm.DriftEngine(
+        dm.DriftConfig(window_s=10, bucket_s=1, min_rows=50,
+                       cal_window_s=60, cal_min_outcomes=10),
+        metrics=metrics, clock=clock)
+    try:
+        eng.submit(dm.np_sketch(*_random_batch(20, 300)), 300)
+        assert eng.drain(5)
+        eng.pin_reference(source="expo", min_rows=100)
+        x, s, a = _random_batch(21, 300)
+        x[:, int(F.TX_AMOUNT)] *= 16
+        eng.submit(dm.np_sketch(x, s, a), 300)
+        eng.note_skipped(7)
+        eng.note_outcomes(s, (s > 50).astype(np.float64))
+        assert eng.drain(5)
+        eng.evaluate()
+        text = metrics.registry.render_text()
+        _validate_exposition(text)
+        for family in ("risk_drift_rows_total", "risk_drift_psi",
+                       "risk_drift_ks", "risk_drift_output_psi",
+                       "risk_drift_alert", "risk_drift_alerts_total",
+                       "risk_drift_window_rows",
+                       "risk_drift_calibration_error"):
+            assert f"# TYPE {family}" in text, f"{family} not rendered"
+        # Label cardinality is BOUNDED (analyzer rule MX05's contract):
+        # feature labels come from the 30-name schema, kinds/outcomes
+        # from fixed enumerations — never an id-shaped value.
+        feat_labels = set(re.findall(
+            r'risk_drift_(?:psi|ks)\{feature="([^"]+)"\}', text))
+        assert feat_labels and feat_labels <= set(FEATURE_NAMES)
+        kind_labels = set(re.findall(
+            r'risk_drift_alerts?\{kind="([^"]+)"\}', text))
+        assert kind_labels <= {"input", "score", "calibration"}
+        outcome_labels = set(re.findall(
+            r'risk_drift_rows_total\{outcome="([^"]+)"\}', text))
+        assert outcome_labels <= {"sketched", "dropped", "skipped"}
+        dist_labels = set(re.findall(
+            r'risk_drift_output_psi\{dist="([^"]+)"\}', text))
+        assert dist_labels == {"score", "action"}
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# On-path integration: every scoring path sketches, bounded and non-blocking
+
+
+def test_engine_sketches_every_scoring_path():
+    from igaming_platform_tpu.core.config import BatcherConfig
+    from igaming_platform_tpu.serve.scorer import (
+        ScoreRequest,
+        TPUScoringEngine,
+    )
+
+    engine = TPUScoringEngine(
+        batcher_config=BatcherConfig(batch_size=16, max_wait_ms=1.0))
+    drift = dm.DriftEngine(dm.DriftConfig(window_s=300, bucket_s=5,
+                                          min_rows=8))
+    try:
+        engine.bind_drift(drift)
+        engine.score_batch([ScoreRequest(account_id=f"a{i}", amount=1000 + i)
+                            for i in range(10)])
+        engine.score(ScoreRequest(account_id="b0", amount=500))
+        engine.score_batch_wire(
+            [f"c{i}" for i in range(20)], [100] * 20, ["deposit"] * 20)
+        engine.score_columns_cached(
+            [f"c{i}" for i in range(7)], [250.0] * 7, ["bet"] * 7)
+        assert drift.drain(10)
+        assert drift.rows_sketched == 10 + 1 + 20 + 7
+        snap = drift.snapshot()
+        assert snap["window"]["rows"] == 38
+        assert sum(snap["window"]["score_hist"]) == 38
+        # The sketch means track the actual traffic (tx_amount below).
+        expect = (sum(1000 + i for i in range(10)) + 500
+                  + 20 * 100 + 7 * 250) / 38
+        assert snap["window"]["feat_mean"][int(F.TX_AMOUNT)] == pytest.approx(
+            expect, rel=1e-3)
+    finally:
+        engine.close()
+        drift.close()
+
+
+def test_full_sketch_queue_drops_without_blocking_scoring():
+    from igaming_platform_tpu.core.config import BatcherConfig
+    from igaming_platform_tpu.serve.scorer import TPUScoringEngine
+
+    engine = TPUScoringEngine(
+        batcher_config=BatcherConfig(batch_size=16, max_wait_ms=1.0))
+    drift = dm.DriftEngine(dm.DriftConfig(window_s=300, bucket_s=5,
+                                          min_rows=8, queue_max=1))
+    try:
+        # Wedge the worker so the bounded queue fills.
+        with drift._cv:
+            drift._stopping = False
+            drift._pending.append((np.zeros(dm.SKETCH_LEN), 0, 0.0))
+            drift._pending.append((np.zeros(dm.SKETCH_LEN), 0, 0.0))
+        engine.bind_drift(drift)
+        out = engine.score_batch_wire(
+            [f"q{i}" for i in range(30)], [100] * 30, ["bet"] * 30)
+        assert out  # scoring answered normally
+        assert drift.rows_dropped >= 0  # drops counted, never raised
+    finally:
+        engine.close()
+        drift.close()

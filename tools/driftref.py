@@ -1,0 +1,182 @@
+"""Mint a pinned drift reference from a decision-ledger segment.
+
+The drift observatory (obs/drift.py) compares live traffic against a
+*pinned reference snapshot* — the distributions "normal" looked like.
+This tool builds that snapshot OFFLINE from the same durable bytes the
+auditor reads: it walks a ledger directory (serve/ledger.py WAL
+segments), folds every decision's feature snapshot + score/action into
+the fixed-edge sketch (the numpy twin of the on-path kernel, bit-same
+binning), joins v2 outcome side-records into the calibration curve, and
+writes a reference JSON the server loads at boot (``DRIFT_REF=path``)
+or at runtime (``POST /debug/driftz {"action": "load", "path": ...}``).
+
+Usage:
+    python -m tools.driftref --ledger LEDGER_DIR --out drift-ref.json
+    python -m tools.driftref --synthetic --rows 20000 --seed 7 --out ref.json
+    python -m tools.driftref --verify          # self-contained smoke
+
+``--synthetic`` mints from the labeled generator (train/fraudgen.py)
+scored through the stock mock ensemble — the bring-up path when no
+ledger history exists yet. ``--max-rows`` bounds a mint from a huge WAL
+(the newest rows win: recent traffic is the better "normal").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+from igaming_platform_tpu.obs import drift as drift_mod
+from igaming_platform_tpu.serve import ledger as ledger_mod
+
+
+def sketch_from_ledger(directory: str, max_rows: int = 500_000,
+                       pending_max: int = 262_144) -> tuple[np.ndarray, np.ndarray, dict]:
+    """(sketch vec, calibration [N_SCORE_BINS, 2], stats) from every
+    decision frame in a ledger directory. Snapshot-less records (index
+    mode) contribute score/action mass only via their decision row —
+    they are SKIPPED here (no feature vector to bin) and counted."""
+    xs: list[np.ndarray] = []
+    scores: list[int] = []
+    actions: list[int] = []
+    # decision_id -> score, bounded, awaiting an outcome join.
+    pending: dict[str, int] = {}
+    cal = np.zeros((drift_mod.N_SCORE_BINS, 2), np.float64)
+    stats = {"decisions": 0, "snapshotless": 0, "outcomes": 0,
+             "outcomes_joined": 0, "frames": 0, "undecodable": 0}
+    for _seq, path in ledger_mod.ledger_segments(directory):
+        for payload, _end in ledger_mod.iter_segment_frames(path):
+            stats["frames"] += 1
+            try:
+                kind, rec = ledger_mod.decode_entry(payload)
+            except ledger_mod.LedgerSchemaError:
+                stats["undecodable"] += 1
+                continue
+            if kind == "decision":
+                stats["decisions"] += 1
+                if len(pending) < pending_max:
+                    pending[rec.decision_id] = int(rec.score)
+                if rec.features is None:
+                    stats["snapshotless"] += 1
+                    continue
+                xs.append(np.asarray(rec.features, np.float32))
+                scores.append(int(rec.score))
+                actions.append(int(rec.action))
+                if len(xs) > max_rows:
+                    # Newest rows win: recent traffic is the "normal"
+                    # a drift comparison should anchor on.
+                    xs = xs[-max_rows:]
+                    scores = scores[-max_rows:]
+                    actions = actions[-max_rows:]
+            elif kind == "outcome":
+                stats["outcomes"] += 1
+                score = pending.get(rec.decision_id)
+                if score is None:
+                    continue
+                stats["outcomes_joined"] += 1
+                sbin = min(max(score // drift_mod.SCORE_BIN_WIDTH, 0),
+                           drift_mod.N_SCORE_BINS - 1)
+                cal[sbin, 0] += 1
+                cal[sbin, 1] += float(rec.label)
+    if not xs:
+        raise SystemExit(
+            f"no snapshot-carrying decisions under {directory!r} — an "
+            "index-mode-only ledger cannot mint a feature reference "
+            "(mint --synthetic, or capture a row-mode window first)")
+    vec = drift_mod.np_sketch(
+        np.stack(xs), np.asarray(scores, np.int64),
+        np.asarray(actions, np.int64))
+    return vec, cal, stats
+
+
+def sketch_from_synthetic(rows: int, seed: int) -> tuple[np.ndarray, np.ndarray, dict]:
+    """Mint from the labeled generator scored through the stock mock
+    ensemble (the same graph composition serving boots with) — scores
+    and actions are real model outputs, not placeholders."""
+    import jax
+
+    from igaming_platform_tpu.core.config import ScoringConfig
+    from igaming_platform_tpu.models.ensemble import make_score_fn
+    from igaming_platform_tpu.train.fraudgen import generate_labeled
+
+    x, y, _kind = generate_labeled(np.random.default_rng(seed), rows)
+    cfg = ScoringConfig()
+    fn = jax.jit(make_score_fn(cfg, "mock"))
+    thresholds = np.array([cfg.block_threshold, cfg.review_threshold],
+                          np.int32)
+    bl = np.zeros((x.shape[0],), bool)
+    out = jax.device_get(fn(None, x, bl, thresholds))
+    scores = np.asarray(out["score"], np.int64)
+    actions = np.asarray(out["action"], np.int64)
+    vec = drift_mod.np_sketch(x, scores, actions)
+    cal = np.zeros((drift_mod.N_SCORE_BINS, 2), np.float64)
+    sbin = np.clip(scores // drift_mod.SCORE_BIN_WIDTH, 0,
+                   drift_mod.N_SCORE_BINS - 1)
+    cal[:, 0] = np.bincount(sbin, minlength=drift_mod.N_SCORE_BINS)
+    cal[:, 1] = np.bincount(sbin, weights=np.asarray(y, np.float64),
+                            minlength=drift_mod.N_SCORE_BINS)
+    return vec, cal, {"rows": rows, "seed": seed, "source": "synthetic"}
+
+
+def verify() -> int:
+    """Self-contained smoke: mint a small synthetic reference, round-trip
+    it through save/load, and assert the self-PSI is ~0."""
+    import tempfile
+
+    vec, cal, _stats = sketch_from_synthetic(rows=2048, seed=11)
+    ref = drift_mod.DriftReference.from_sketch(
+        vec, source="driftref --verify", calibration=cal)
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as fh:
+        path = fh.name
+    ref.save(path)
+    loaded = drift_mod.DriftReference.load(path)
+    assert loaded.fingerprint() == ref.fingerprint(), "round-trip fingerprint"
+    table = drift_mod.psi_table(vec, loaded)
+    assert table["max_feature_psi"] < 1e-6, table["max_feature_psi"]
+    assert table["score_psi"] < 1e-6, table["score_psi"]
+    print(json.dumps({"ok": True, "reference": ref.meta(),
+                      "self_psi": table["max_feature_psi"]}))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--ledger", help="decision-ledger directory to mint from")
+    ap.add_argument("--synthetic", action="store_true",
+                    help="mint from the labeled synthetic generator")
+    ap.add_argument("--rows", type=int, default=20_000,
+                    help="synthetic rows (with --synthetic)")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--max-rows", type=int, default=500_000,
+                    help="newest-N cap when minting from a large ledger")
+    ap.add_argument("--out", default="drift-ref.json")
+    ap.add_argument("--verify", action="store_true",
+                    help="self-contained smoke (mint+round-trip+self-PSI)")
+    args = ap.parse_args(argv)
+
+    if args.verify:
+        return verify()
+    if args.synthetic:
+        vec, cal, stats = sketch_from_synthetic(args.rows, args.seed)
+        source = f"synthetic:rows={args.rows}:seed={args.seed}"
+    elif args.ledger:
+        vec, cal, stats = sketch_from_ledger(args.ledger, args.max_rows)
+        source = f"ledger:{args.ledger}"
+    else:
+        ap.error("need --ledger DIR, --synthetic, or --verify")
+        return 2
+    if cal[:, 0].sum() <= 0:
+        cal = None
+    ref = drift_mod.DriftReference.from_sketch(
+        vec, source=source, calibration=cal)
+    ref.save(args.out)
+    print(json.dumps({"ok": True, "out": args.out, "reference": ref.meta(),
+                      "stats": stats}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
