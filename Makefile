@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-fast lint lint-json lint-update-baseline bench bench-all bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos soak-chaos-ledger soak-slo soak-online soak-drift replay-verify fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
+.PHONY: all test test-fast lint lint-json lint-update-baseline bench bench-all bench-paced bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos soak-chaos-ledger soak-slo soak-online soak-drift soak-deadline replay-verify fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
 
 all: native test
 
@@ -35,6 +35,23 @@ bench:
 # The full benchmark matrix (five BASELINE configs + wallet pipeline).
 bench-all:
 	$(PY) benchmarks/run_all.py
+
+# Paced-arrival latency gate (deadline scheduler, PR 11): open-loop
+# Poisson ScoreTransaction load at BENCH_PACED_RATE (default 2000 rps on
+# the 1-core control rig) with risk-deadline-ms on every request,
+# against a production replica process. Exits non-zero unless e2e RPC
+# p99 < SLO_OBJECTIVE_MS AND zero requests were scored after their
+# deadline. The same arm runs inside `make soak-deadline`.
+BENCH_PACED_RATE ?= 2000
+bench-paced:
+	BENCH_PACED_RATE=$(BENCH_PACED_RATE) $(PY) benchmarks/soak.py --deadline --paced-only
+
+# Deadline-scheduler soak: paced arm + flat-out no-regression A/B +
+# burn->shed closed-loop drill (injected latency -> fast burn alert ->
+# bulk sheds with pushback -> interactive recovers -> bulk resumes) +
+# bit-exact ledger replay across the paced+shed run -> DEADLINE_r12.json.
+soak-deadline:
+	$(PY) benchmarks/soak.py --deadline
 
 # Replica scaling curve: K wallet replica OS processes over one shared
 # PG-wire database (REPLICA_KS, REPLICA_CYCLES; POSTGRES_URL for live PG).

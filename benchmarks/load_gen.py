@@ -532,6 +532,203 @@ def run_grpc_load(
     }
 
 
+def run_paced_load(
+    addr: str,
+    *,
+    rate_rps: float,
+    duration_s: float = 10.0,
+    deadline_ms: float = 50.0,
+    warmup_rpcs: int = 20,
+    seed: int = 11,
+    late_threshold_ms: float = 1.0,
+    channels: int = 2,
+) -> dict:
+    """Open-loop paced ScoreTransaction load — the arrival process the
+    closed-loop flat-out mode cannot produce.
+
+    Closed-loop workers wait for each response before sending the next
+    request, so a slow server *slows the offered load* and p99 flatters
+    itself (coordinated omission). Here arrivals are a seeded Poisson
+    process at ``rate_rps``: each RPC has a SCHEDULED send time fixed
+    before the run, sends are non-blocking (gRPC futures), and latency
+    is measured from the *scheduled* time — a request the sender issued
+    late (because Python fell behind) still charges its full
+    user-visible wait. Late sends are counted, not hidden
+    (``pacing_block.late_sends``): if the generator cannot hold the
+    target rate, the artifact says so instead of reporting a rate it
+    didn't offer.
+
+    Every request carries ``risk-deadline-ms: deadline_ms`` — the
+    deadline scheduler's admission contract — and the artifact counts
+    ``scored_after_deadline``: OK responses that arrived after their
+    budget (the server should have shed them; the DEADLINE_r12 gate
+    pins this at zero).
+    """
+    rng = np.random.default_rng(seed)
+    n_sends = max(1, int(rate_rps * duration_s))
+    # Poisson arrivals: exponential gaps, fixed before the run starts.
+    gaps = rng.exponential(1.0 / rate_rps, size=n_sends)
+    offsets = np.cumsum(gaps)
+
+    n_senders = max(1, min(8, int(rate_rps // 250) or 1))
+    channels = max(channels, n_senders)
+    chs = [grpc.insecure_channel(addr) for _ in range(max(1, channels))]
+    calls = [
+        ch.unary_unary(
+            "/risk.v1.RiskService/ScoreTransaction",
+            request_serializer=risk_pb2.ScoreTransactionRequest.SerializeToString,
+            response_deserializer=risk_pb2.ScoreTransactionResponse.FromString,
+        )
+        for ch in chs
+    ]
+    payloads = [
+        risk_pb2.ScoreTransactionRequest(
+            account_id=f"lg-{int(rng.integers(0, 512))}",
+            amount=int(rng.integers(100, 100_000)),
+            transaction_type=("deposit", "bet", "withdraw")[i % 3],
+            device_id=f"dev-{i % 64}",
+        )
+        for i in range(256)
+    ]
+    for i in range(warmup_rpcs):
+        try:
+            calls[0](payloads[i % len(payloads)], timeout=30)
+        except grpc.RpcError:
+            pass
+
+    lock = threading.Lock()
+    # (latency_from_scheduled_ms, latency_from_send_ms, ok, code)
+    done_rows: list[tuple[float, float, bool, str]] = []
+    outstanding = [0]
+    drained = threading.Event()
+
+    def _complete(sched_t: float, send_t: float, fut) -> None:
+        t1 = time.perf_counter()
+        code = "OK"
+        ok = True
+        try:
+            fut.result()
+        except grpc.RpcError as exc:
+            ok = False
+            try:
+                code = exc.code().name
+            except Exception:  # noqa: BLE001 — a dead channel may not carry a code
+                code = "UNKNOWN"
+        with lock:
+            done_rows.append(((t1 - sched_t) * 1000.0,
+                              (t1 - send_t) * 1000.0, ok, code))
+            outstanding[0] -= 1
+            if outstanding[0] == 0:
+                drained.set()
+
+    late_lock = threading.Lock()
+    late_sends = [0]
+    late_by_ms: list[float] = []
+    # Sharded senders: one Python thread cannot pace >~700 sends/s (the
+    # per-send ~1 ms of proto+grpc work becomes the bottleneck and the
+    # measured "latency" is client backlog, not the server). Each sender
+    # owns every K-th arrival — a thinned Poisson process is still
+    # Poisson, and the superposition offered to the server is the
+    # original schedule.
+    t_start = time.perf_counter()
+
+    def sender(k: int) -> None:
+        call = calls[k % len(calls)]
+        for i in range(k, n_sends, n_senders):
+            sched_t = t_start + float(offsets[i])
+            now = time.perf_counter()
+            if sched_t > now:
+                time.sleep(sched_t - now)
+                now = time.perf_counter()
+            behind_ms = (now - sched_t) * 1000.0
+            if behind_ms > late_threshold_ms:
+                with late_lock:
+                    late_sends[0] += 1
+                    late_by_ms.append(behind_ms)
+            _, tp = _client_traceparent()
+            md = tp + (("risk-deadline-ms", str(int(deadline_ms))),)
+            with lock:
+                outstanding[0] += 1
+                drained.clear()
+            fut = call.future(
+                payloads[i % len(payloads)], timeout=30, metadata=md)
+            fut.add_done_callback(
+                lambda f, s=sched_t, t=now: _complete(s, t, f))
+
+    threads = [threading.Thread(target=sender, args=(k,))
+               for k in range(n_senders)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    drained.wait(timeout=30.0)
+    wall = time.perf_counter() - t_start
+    for ch in chs:
+        ch.close()
+
+    with lock:
+        rows = list(done_rows)
+    ok_rows = [r for r in rows if r[2]]
+    lat_sched = np.array([r[0] for r in ok_rows])
+    codes: dict[str, int] = {}
+    for _ls, _li, ok, code in rows:
+        if not ok:
+            codes[code] = codes.get(code, 0) + 1
+    # OK responses that arrived past the budget measured from SEND.
+    # Observational, not the contract: ``risk-deadline-ms`` is a
+    # duration anchored at each hop's ADMISSION, so this count includes
+    # transport and pre-admission gRPC queueing the server cannot see.
+    # The contract's "zero scored dead" gate reads the server's
+    # structural evidence (/debug/deadlinez ``dead_dispatched`` — rows
+    # dispatched with a spent budget — plus the response-time shed that
+    # converts late results into DEADLINE_EXCEEDED).
+    ok_past_deadline = sum(1 for r in ok_rows if r[1] > deadline_ms)
+    sheds = codes.get("DEADLINE_EXCEEDED", 0) + codes.get(
+        "RESOURCE_EXHAUSTED", 0)
+    errors = sum(n for c, n in codes.items()
+                 if c not in ("DEADLINE_EXCEEDED", "RESOURCE_EXHAUSTED"))
+    return {
+        "metric": "e2e_grpc_paced_single_txn_p99_ms",
+        "value": (round(float(np.percentile(lat_sched, 99)), 3)
+                  if lat_sched.size else None),
+        "unit": "ms",
+        "mode": "open_loop_paced",
+        "deadline_ms": deadline_ms,
+        "duration_s": duration_s,
+        "rpcs_sent": n_sends,
+        "rpcs_completed": len(rows),
+        "ok": len(ok_rows),
+        "sheds": sheds,
+        "errors": errors,
+        "errors_by_code": dict(sorted(codes.items())),
+        "ok_past_deadline_send_anchored": ok_past_deadline,
+        "rpc_p50_ms": (round(float(np.percentile(lat_sched, 50)), 3)
+                       if lat_sched.size else None),
+        "rpc_p99_ms": (round(float(np.percentile(lat_sched, 99)), 3)
+                       if lat_sched.size else None),
+        "rpc_max_ms": (round(float(lat_sched.max()), 3)
+                       if lat_sched.size else None),
+        "pacing_block": {
+            "target_rps": rate_rps,
+            "offered_rps": round(n_sends / wall, 1) if wall > 0 else None,
+            "achieved_rps": (round(len(ok_rows) / duration_s, 1)
+                             if duration_s > 0 else None),
+            "late_sends": late_sends[0],
+            "late_send_p99_ms": (
+                round(float(np.percentile(np.array(late_by_ms), 99)), 3)
+                if late_by_ms else 0.0),
+            "senders": n_senders,
+            "arrivals": "poisson",
+            "seed": seed,
+            # Latencies are measured from the SCHEDULED arrival, so a
+            # backlogged sender cannot flatter p99 (coordinated
+            # omission).
+            "latency_origin": "scheduled_arrival",
+        },
+        "wall_s": round(wall, 3),
+    }
+
+
 def run_single_txn_probe(addr: str, n: int = 150) -> dict:
     """Sequential ScoreTransaction probes — the per-request latency a
     single caller sees through the continuous batcher."""
@@ -617,6 +814,8 @@ def main() -> None:
     addr = None
     fleet_addrs: list[str] | None = None
     drift_ramp = os.environ.get("LOAD_DRIFT_RAMP") or None
+    pace_rps: float | None = None
+    pace_gates = False
     for arg in sys.argv[1:]:
         if arg.startswith("--wire-mode="):
             wire_mode = arg.split("=", 1)[1]
@@ -624,6 +823,16 @@ def main() -> None:
             raise SystemExit("use --wire-mode=row|index")
         elif arg.startswith("--fleet="):
             fleet_addrs = [a for a in arg.split("=", 1)[1].split(",") if a]
+        elif arg.startswith("--pace="):
+            # Open-loop paced-arrival mode (Poisson arrivals at RATE
+            # rps, late-send accounting): run_paced_load.
+            pace_rps = float(arg.split("=", 1)[1])
+        elif arg == "--pace":
+            raise SystemExit("use --pace=RATE_RPS")
+        elif arg == "--pace-gates":
+            # make bench-paced: exit non-zero unless p99 < the SLO bound
+            # and zero requests were scored after their deadline.
+            pace_gates = True
         elif arg.startswith("--drift-ramp="):
             # Seedable injected drift, e.g. --drift-ramp=mult=8:start=0.4
             # (spec grammar: train/fraudgen.DriftRamp.parse).
@@ -643,6 +852,38 @@ def main() -> None:
         addr, shutdown, engine = start_inprocess_server(
             batch_size=int(os.environ.get("LOAD_BATCH", 4096)),
         )
+    if pace_rps is not None:
+        try:
+            paced = run_paced_load(
+                addr,
+                rate_rps=pace_rps,
+                duration_s=float(os.environ.get("LOAD_PACE_DURATION_S", 10.0)),
+                deadline_ms=float(os.environ.get(
+                    "LOAD_PACE_DEADLINE_MS",
+                    os.environ.get("SLO_OBJECTIVE_MS", "50"))),
+            )
+            if engine is not None:
+                # In-process run: the server-side "zero scored dead"
+                # evidence rides the artifact directly.
+                paced["scored_dead"] = engine._batcher.dead_dispatched
+            print(json.dumps(paced), flush=True)
+            if pace_gates:
+                bound = float(os.environ.get(
+                    "SLO_OBJECTIVE_MS", "50"))
+                p99 = paced.get("rpc_p99_ms")
+                if p99 is None or p99 >= bound:
+                    raise SystemExit(
+                        f"bench-paced gate FAILED: p99 {p99} ms >= "
+                        f"{bound} ms bound")
+                if paced.get("scored_dead", 0) != 0:
+                    raise SystemExit(
+                        "bench-paced gate FAILED: "
+                        f"{paced['scored_dead']} requests "
+                        "scored after their deadline")
+        finally:
+            if shutdown is not None:
+                shutdown()
+        return
     try:
         load = run_grpc_load(
             addr,

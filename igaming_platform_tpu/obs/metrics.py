@@ -326,6 +326,42 @@ class ServiceMetrics:
             "assembly — the batching-window share of single-txn latency",
             buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250),
         )
+        # Deadline scheduler (serve/deadline.py + serve/batcher.py): the
+        # admission→dispatch deadline plane. Labels are bounded
+        # enumerations per MX05: lane ∈ {interactive, bulk, background},
+        # stage ∈ {admission, dispatch, router}.
+        self.deadline_remaining_ms = self.registry.histogram(
+            f"{service}_deadline_remaining_ms",
+            "Remaining per-request deadline budget (ms) at the moment its "
+            "batch dispatched — the headroom the scheduler left the device "
+            "step + readback + encode; mass near 0 means admitted requests "
+            "are barely making it",
+            buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000),
+        )
+        self.deadline_expired_total = self.registry.counter(
+            f"{service}_deadline_expired_total",
+            "Requests shed because their deadline budget was already spent, "
+            "by {stage}: admission = rejected at the RPC edge before any "
+            "work, dispatch = expired while queued in the scheduler (shed "
+            "at batch assembly, never scored dead), router = rejected at "
+            "the L7 router hop — all DEADLINE_EXCEEDED + retry-pushback, "
+            "counted as sheds, never SLO budget burn",
+        )
+        self.lane_depth = self.registry.gauge(
+            f"{service}_lane_depth",
+            "Queued requests per scheduler priority {lane} (interactive "
+            "ScoreTransaction > bulk ScoreBatch > background jobs) at the "
+            "last submit/assembly — the per-lane view of "
+            "batcher_queue_depth",
+        )
+        self.batch_size_chosen = self.registry.histogram(
+            f"{service}_batch_size_chosen",
+            "Padded batch shape the deadline scheduler planned per tick "
+            "against the tightest admitted deadline and the online "
+            "step-time model — small tiers under tight budgets, the "
+            "throughput shape when there is slack",
+            buckets=(1, 8, 32, 64, 128, 256, 512, 1024, 2048, 4096),
+        )
         # Pipelined host engine (serve/pipeline_engine.py): stage-worker
         # health for the wire batch paths.
         self.pipeline_inflight = self.registry.gauge(

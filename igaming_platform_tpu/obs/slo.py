@@ -171,12 +171,18 @@ class SLOEngine:
             return
         code = str(span.attributes.get("code", "OK"))
         state = span.attributes.get("serving_state") or self._state()
+        # Deliberate sheds never burn budget, whatever their status code:
+        # an expired-at-admission DEADLINE_EXCEEDED (serve/deadline.py)
+        # is admission control doing its job — the root span carries the
+        # `shed` attribute so it is distinguishable from the server
+        # actually blowing a caller's deadline (which DOES burn).
+        shed = bool(span.attributes.get("shed"))
         self.observe(
             span.duration_ms,
             stages=span.stage_totals,
             state=state,
             trace_id=span.trace_id,
-            errored=code in _BUDGET_BURNING_CODES,
+            errored=(code in _BUDGET_BURNING_CODES) and not shed,
         )
 
     def _state(self) -> str:

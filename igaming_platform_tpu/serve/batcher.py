@@ -1,41 +1,54 @@
-"""Continuous batcher — fixed-shape device batches from a bursty stream.
+"""Continuous batcher v2 — deadline-scheduled device batches.
 
 The reference scores one `[1, 30]` tensor per request through CGo
 (onnx_model.go:208-255); its "batch" API is a sequential loop (:311-326).
 Here concurrent Score requests coalesce into ONE fixed-shape [B, 30] device
-batch per step (SURVEY.md §1 "continuous batcher"):
+batch per step (SURVEY.md §1 "continuous batcher") — and since PR 11 the
+queue in front of the device is a deadline scheduler (serve/deadline.py),
+not a FIFO:
 
-- requests enqueue with a Future; the launcher thread drains up to B rows
-  or flushes after ``max_wait_ms`` — the batching-window/tail-latency
-  trade-off of SURVEY.md §7 hard part (c);
-- batches are always padded to the single compiled shape (padding beats
+- requests enqueue with a Future, a priority *lane* and an optional
+  per-request :class:`~igaming_platform_tpu.serve.deadline.Deadline`;
+  dispatch order is earliest-deadline-first within a lane with strict
+  cross-lane aging (interactive > bulk > background);
+- each tick plans its batch shape and flush window against the tightest
+  admitted deadline using the online step-time model
+  (obs/perfmodel.OnlineStepModel) — a near-due queue flushes a small
+  compiled tier immediately instead of waiting out a fixed window;
+- requests whose deadline expires while queued are shed with
+  :class:`DeadlineExpired` at assembly, never scored dead;
+- batches are always padded to a compiled shape (padding beats
   recompilation; pad rows are masked out on distribution);
 - with a two-phase (dispatch/collect) runner, device launches and
   device→host readback run on SEPARATE threads with a bounded in-flight
-  window, so batch k+1 computes while batch k's results are still in
-  flight — on interconnects where D2H readback has real latency this is
-  the difference between serialized round-trips and wire-rate streaming.
+  window, and a batch whose collect stalls past the model's predicted
+  step time is HEDGED: re-dispatched and raced, first result wins
+  (dispatch is pure on the gathered features, so the loser is
+  discard-safe and bit-exact).
+
+Clock discipline: every deadline/timeout computation on the
+admission→dispatch path is ``time.monotonic()`` — wall clock steps
+backwards under NTP (analyzer rule MX06 pins this for all of serve/).
 """
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
-from concurrent.futures import Future
-from dataclasses import dataclass
+from concurrent.futures import FIRST_COMPLETED, Future, ThreadPoolExecutor, wait
+from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Any, Callable, Sequence
 
 import numpy as np
 
 from igaming_platform_tpu.core.config import BatcherConfig
-
-
-@dataclass(slots=True)
-class _WorkItem:
-    payload: Any
-    future: Future
-    enqueued_at: float = 0.0
-
+from igaming_platform_tpu.serve.deadline import (
+    LANE_INTERACTIVE,
+    Deadline,
+    DeadlineScheduler,
+    plan_tick,
+)
 
 _SENTINEL = object()
 
@@ -129,7 +142,7 @@ class CollectorPipeline:
 
 
 class ContinuousBatcher:
-    """Generic request coalescer.
+    """Generic request coalescer over the deadline scheduler.
 
     Two runner styles:
 
@@ -140,6 +153,11 @@ class ContinuousBatcher:
       ``collect(handle) -> list[result]`` finalizes it. Dispatch runs on
       the launcher thread, collect on a collector thread, with at most
       ``cfg.pipeline_depth`` batches in flight.
+
+    ``shapes``/``step_model`` opt the batcher into deadline planning: the
+    compiled shape ladder the tick planner may choose from and the online
+    step-time model it predicts with (both wired by TPUScoringEngine).
+    Without them the batcher behaves exactly like the fixed-knob v1.
     """
 
     def __init__(
@@ -149,6 +167,9 @@ class ContinuousBatcher:
         *,
         dispatch: Callable[[list], Any] | None = None,
         collect: Callable[[Any], Sequence] | None = None,
+        shapes: Sequence[int] | None = None,
+        step_model=None,
+        lane_gate=None,
     ):
         if runner is None and (dispatch is None or collect is None):
             raise ValueError("need either runner or dispatch+collect")
@@ -156,7 +177,12 @@ class ContinuousBatcher:
         self._runner = runner
         self._dispatch = dispatch
         self._collect = collect
-        self._queue: queue.Queue[_WorkItem] = queue.Queue(self.cfg.max_queue)
+        self.scheduler = DeadlineScheduler(max_queue=self.cfg.max_queue)
+        self.step_model = step_model
+        self.lane_gate = lane_gate
+        self._shapes = tuple(sorted(set(
+            int(s) for s in (shapes or ()) if 0 < int(s) <= self.cfg.batch_size
+        ))) or (self.cfg.batch_size,)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, name="continuous-batcher", daemon=True)
         self._pipeline = (
@@ -169,15 +195,34 @@ class ContinuousBatcher:
             if dispatch is not None
             else None
         )
+        # Hedged re-dispatch of a stalled pipeline window (two-phase
+        # only): collect runs on a small worker pool so a stall past the
+        # step model's threshold can launch a second dispatch and race
+        # it. BATCH_HEDGE=0 opts out; inert until the model has evidence.
+        self._hedge_enabled = (
+            dispatch is not None and os.environ.get("BATCH_HEDGE", "1") != "0")
+        self._hedge_mult = float(os.environ.get("BATCH_HEDGE_MULT", "4"))
+        self._hedge_pool: ThreadPoolExecutor | None = None
         self._started = False
         self.batches_run = 0
         self.rows_scored = 0
         self.batches_replayed = 0
-        # Observability hook, set by the serving layer: called once per
-        # assembled batch with (per-request queue waits in ms, queue depth
-        # left behind) — feeds the time-in-queue histogram and queue-depth
-        # gauge. Best-effort: a failing hook must never fail a batch.
-        self.on_batch = None  # callable(waits_ms: list[float], depth: int)
+        self.batches_hedged = 0
+        self.expired_shed = 0
+        # Rows that entered a dispatch with their deadline already spent
+        # — structurally zero (the assembly shed runs right before
+        # dispatch); counted anyway as the DEADLINE artifact's
+        # "zero scored dead" evidence rather than an assumption.
+        self.dead_dispatched = 0
+        # Observability hooks, set by the serving layer. Best-effort: a
+        # failing hook must never fail a batch.
+        # on_batch(per-request queue waits ms, queue depth left behind)
+        self.on_batch = None
+        # on_plan(chosen padded shape) — risk_batch_size_chosen
+        self.on_plan = None
+        # on_dispatch_deadlines(remaining_ms list) — the
+        # risk_deadline_remaining_ms histogram at dispatch
+        self.on_dispatch_deadlines = None
 
     def start(self) -> "ContinuousBatcher":
         if not self._started:
@@ -187,6 +232,7 @@ class ContinuousBatcher:
 
     def stop(self) -> None:
         self._stop.set()
+        self.scheduler.close()
         if self._started:
             self._thread.join(timeout=5)
             if self._thread.is_alive() and self._pipeline is not None:
@@ -203,63 +249,76 @@ class ContinuousBatcher:
         # futures during the drain.
         if self._pipeline is not None:
             self._pipeline.close(raise_errors=False)
+        if self._hedge_pool is not None:
+            self._hedge_pool.shutdown(wait=False)
 
-    def submit(self, payload: Any) -> Future:
-        fut: Future = Future()
-        self._queue.put(_WorkItem(payload, fut, _now()))
-        return fut
+    def submit(self, payload: Any, deadline: Deadline | None = None,
+               lane: str = LANE_INTERACTIVE) -> Future:
+        """Enqueue one request. ``deadline=None`` means "no deadline":
+        the item orders FIFO-ish behind its lane's EDF traffic and is
+        never shed (library callers; the gRPC layer always passes one)."""
+        return self.scheduler.submit(payload, deadline=deadline, lane=lane)
 
-    def score_sync(self, payload: Any, timeout: float = 30.0):
-        return self.submit(payload).result(timeout=timeout)
+    def score_sync(self, payload: Any, timeout: float = 30.0,
+                   deadline: Deadline | None = None,
+                   lane: str = LANE_INTERACTIVE):
+        return self.submit(payload, deadline=deadline, lane=lane).result(
+            timeout=timeout)
 
     # -- internals -----------------------------------------------------------
 
     def _loop(self) -> None:
-        wait_s = self.cfg.max_wait_ms / 1000.0
         while not self._stop.is_set():
-            try:
-                first = self._queue.get(timeout=0.05)
-            except queue.Empty:
+            first = self.scheduler.poll(timeout=0.05)
+            if first is None:
                 continue
+            now = _now()
+            plan = plan_tick(
+                shapes=self._shapes,
+                tightest_ms=self._tightest_ms(first, now),
+                max_wait_ms=self.cfg.max_wait_ms,
+                step_model=self.step_model,
+            )
             items = [first]
-            deadline = _now() + wait_s
-            while len(items) < self.cfg.batch_size:
-                remaining = deadline - _now()
+            flush_at = now + plan.window_s
+            while len(items) < plan.max_rows:
+                remaining = flush_at - _now()
                 if remaining <= 0:
                     break
-                try:
-                    items.append(self._queue.get(timeout=remaining))
-                except queue.Empty:
+                nxt = self.scheduler.poll(timeout=remaining)
+                if nxt is None:
                     break
+                items.append(nxt)
             # Opportunistically drain whatever already arrived.
-            while len(items) < self.cfg.batch_size:
-                try:
-                    items.append(self._queue.get_nowait())
-                except queue.Empty:
-                    break
+            if len(items) < plan.max_rows:
+                items.extend(self.scheduler.drain(plan.max_rows - len(items)))
 
-            if self.on_batch is not None:
-                try:
-                    assembled = _now()
-                    self.on_batch(
-                        [(assembled - it.enqueued_at) * 1000.0 for it in items],
-                        self._queue.qsize(),
-                    )
-                except Exception:  # noqa: BLE001 — metrics must not fail batches
-                    pass
+            # Admission→dispatch expiry check: a request whose budget ran
+            # out while the window was open is shed, never scored dead.
+            items = self._shed_expired(items)
+            if not items:
+                continue
+
+            self._note_assembly(items, plan)
 
             if self._dispatch is not None:
                 try:
-                    handle = self._dispatch([it.payload for it in items])
+                    t_dispatch = _now()
+                    if self.lane_gate is not None:
+                        with self.lane_gate.interactive():
+                            handle = self._dispatch([it.payload for it in items])
+                    else:
+                        handle = self._dispatch([it.payload for it in items])
                     # Blocks when pipeline_depth batches are already in
                     # flight — natural backpressure on the launcher.
-                    self._pipeline.put((items, handle))
+                    self._pipeline.put((items, handle, t_dispatch))
                 except Exception as exc:  # noqa: BLE001 — propagate to callers
                     for it in items:
                         if not it.future.done():
                             it.future.set_exception(exc)
             else:
                 results, exc = None, None
+                t0 = _now()
                 for attempt in range(1 + max(0, self.cfg.device_retries)):
                     try:
                         results = self._runner([it.payload for it in items])
@@ -269,6 +328,7 @@ class ContinuousBatcher:
                         break
                     except Exception as e:  # noqa: BLE001 — retry then propagate
                         exc = e
+                self._observe_step(len(items), (_now() - t0) * 1000.0)
                 if exc is not None:
                     for it in items:
                         if not it.future.done():
@@ -279,14 +339,122 @@ class ContinuousBatcher:
             self.batches_run += 1
             self.rows_scored += len(items)
 
+    def _tightest_ms(self, first, now: float) -> float | None:
+        """Tightest remaining budget across the popped head + queue."""
+        tightest = self.scheduler.tightest_remaining_ms(now)
+        if first.deadline is not None:
+            rem = first.deadline.remaining_ms(now)
+            tightest = rem if tightest is None else min(tightest, rem)
+        return tightest
+
+    def _shed_expired(self, items: list) -> list:
+        from igaming_platform_tpu.serve.deadline import DeadlineExpired
+
+        now = _now()
+        live = [it for it in items
+                if it.deadline is None or not it.deadline.expired(now)]
+        if len(live) == len(items):
+            return items
+        for it in items:
+            if it.deadline is not None and it.deadline.expired(now):
+                self.expired_shed += 1
+                if not it.future.done():
+                    it.future.set_exception(DeadlineExpired(
+                        "deadline expired during batch assembly "
+                        f"(lane={it.lane})", stage="dispatch"))
+                self.scheduler._note_expired(1, "dispatch", it.lane)
+        return live
+
+    def _note_assembly(self, items: list, plan) -> None:
+        assembled = _now()
+        # Refresh the per-lane depth gauge at assembly too — submits
+        # alone would leave it stale at the last enqueue's depth after
+        # the queue drains.
+        if self.scheduler.on_depth is not None:
+            for lane, depth in self.scheduler.depths().items():
+                self.scheduler._note_depth(lane, depth)
+        self.dead_dispatched += sum(
+            1 for it in items
+            if it.deadline is not None
+            and it.deadline.remaining_ms(assembled) <= 0.0)
+        if self.on_batch is not None:
+            try:
+                self.on_batch(
+                    [(assembled - it.enqueued_at) * 1000.0 for it in items],
+                    self.scheduler.qsize(),
+                )
+            except Exception:  # noqa: BLE001 — metrics must not fail batches
+                pass
+        if self.on_plan is not None:
+            try:
+                self.on_plan(plan.shape)
+            except Exception:  # noqa: BLE001 — metrics must not fail batches
+                pass
+        if self.on_dispatch_deadlines is not None:
+            try:
+                self.on_dispatch_deadlines([
+                    it.deadline.remaining_ms(assembled)
+                    for it in items if it.deadline is not None])
+            except Exception:  # noqa: BLE001 — metrics must not fail batches
+                pass
+
+    def _observe_step(self, n_rows: int, ms: float) -> None:
+        if self.step_model is not None:
+            self.step_model.observe(self._padded_shape(n_rows), ms)
+
+    def _padded_shape(self, n_rows: int) -> int:
+        for s in self._shapes:
+            if n_rows <= s:
+                return s
+        return self._shapes[-1]
+
     def _discard_batch(self, item) -> None:
         """Poisoned-pipeline drain: fail the batch's futures instead of
         abandoning them."""
-        items, _ = item
+        items, _handle, _t = item
         exc = self._pipeline._errors[0] if self._pipeline._errors else RuntimeError("batcher pipeline failed")
         for it in items:
             if not it.future.done():
                 it.future.set_exception(exc)
+
+    # -- collect side (two-phase) --------------------------------------------
+
+    def _collect_hedged(self, items: list, handle):
+        """Blocking collect with a stall hedge: if the step model has
+        evidence and the collect overruns the stall threshold, the batch
+        re-dispatches and the two handles race — scoring is pure on the
+        gathered features, so either result is bit-exact and the loser
+        is discarded. One hedge per batch; without model evidence (or
+        BATCH_HEDGE=0) this is a plain blocking collect."""
+        threshold_ms = None
+        if self._hedge_enabled and self.step_model is not None:
+            threshold_ms = self.step_model.stall_threshold_ms(
+                self._padded_shape(len(items)), mult=self._hedge_mult)
+        if threshold_ms is None:
+            return self._collect(handle)
+        if self._hedge_pool is None:
+            self._hedge_pool = ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="batcher-hedge")
+        primary = self._hedge_pool.submit(self._collect, handle)
+        try:
+            return primary.result(timeout=threshold_ms / 1000.0)
+        except FutureTimeout:
+            pass  # stalled window: hedge below
+        except TimeoutError:  # 3.11+ alias — keep both spellings live
+            pass
+        self.batches_hedged += 1
+        secondary = self._hedge_pool.submit(
+            lambda: self._collect(self._dispatch([it.payload for it in items])))
+        pending = {primary, secondary}
+        last_exc: BaseException | None = None
+        while pending:
+            done, pending = wait(pending, return_when=FIRST_COMPLETED)
+            for fut in done:
+                exc = fut.exception()
+                if exc is None:
+                    return fut.result()
+                last_exc = exc
+        raise last_exc  # both the stalled window and the hedge failed
 
     def _finalize_batch(self, item) -> None:
         """Collector-side: blocking readback, then resolve futures. Never
@@ -299,12 +467,12 @@ class ContinuousBatcher:
         its requests (SURVEY.md §5). Replay is safe: scoring is pure on
         the gathered features; the feature write-back happens elsewhere.
         """
-        items, handle = item
-        exc: Exception | None = None
+        items, handle, t_dispatch = item
+        exc: BaseException | None = None
         results = None
         try:
-            results = self._collect(handle)
-        except Exception as first:  # noqa: BLE001
+            results = self._collect_hedged(items, handle)
+        except BaseException as first:  # noqa: BLE001
             exc = first
             for _ in range(max(0, self.cfg.device_retries)):
                 try:
@@ -315,6 +483,7 @@ class ContinuousBatcher:
                     break
                 except Exception as nxt:  # noqa: BLE001
                     exc = nxt
+        self._observe_step(len(items), (_now() - t_dispatch) * 1000.0)
         if exc is not None:
             for it in items:
                 if not it.future.done():

@@ -35,6 +35,13 @@ from igaming_platform_tpu.obs import slo as _slo
 from igaming_platform_tpu.obs import tracing
 from igaming_platform_tpu.obs.metrics import ServiceMetrics
 from igaming_platform_tpu.obs.tracing import span
+from igaming_platform_tpu.serve import deadline as _deadline
+from igaming_platform_tpu.serve.deadline import (
+    LANE_BACKGROUND,
+    BurnShedGate,
+    DeadlineExpired,
+    QueueFullError,
+)
 from igaming_platform_tpu.serve.reflection import reflection_handler
 from igaming_platform_tpu.serve.supervisor import (
     RETRY_PUSHBACK_MS,
@@ -78,11 +85,16 @@ class RpcAbort(Exception):
     ``grpc-retry-pushback-ms`` hint on supervisor sheds) is attached
     before the abort."""
 
-    def __init__(self, code, details: str, trailing: tuple = ()):
+    def __init__(self, code, details: str, trailing: tuple = (),
+                 shed: bool = False):
         super().__init__(details)
         self.code = code
         self.details = details
         self.trailing = tuple(trailing)
+        # Deliberate backpressure (deadline/burn/admission sheds): the
+        # root span carries a `shed` attribute so the SLO engine never
+        # burns error budget for admission control doing its job.
+        self.shed = shed
 
 
 def _pushback_trailing() -> tuple:
@@ -275,9 +287,33 @@ def _rpc(metrics: ServiceMetrics, method: str, fn: Callable):
             except RpcAbort as abort:
                 metrics.observe_rpc(method, start, code=abort.code.name)
                 s.attributes["code"] = abort.code.name
+                if abort.shed:
+                    s.attributes["shed"] = 1
                 if abort.trailing and context is not None:
                     context.set_trailing_metadata(abort.trailing)
                 context.abort(abort.code, abort.details)
+            except DeadlineExpired as exc:
+                # A request whose budget ran out while queued in the
+                # scheduler (serve/deadline.py): shed with the caller's
+                # own status — DEADLINE_EXCEEDED — plus the standard
+                # pushback hint. A shed, never an error: the scheduler
+                # already counted it (risk_deadline_expired_total) and
+                # the `shed` attribute keeps it out of the SLO budget.
+                metrics.observe_rpc(method, start, code="DEADLINE_EXCEEDED")
+                s.attributes["code"] = "DEADLINE_EXCEEDED"
+                s.attributes["shed"] = 1
+                if context is not None:
+                    context.set_trailing_metadata(_pushback_trailing())
+                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(exc))
+            except QueueFullError as exc:
+                # Scheduler admission queue at capacity: loud bounded
+                # backpressure, the bulk-gate discipline.
+                metrics.observe_rpc(method, start, code="RESOURCE_EXHAUSTED")
+                s.attributes["code"] = "RESOURCE_EXHAUSTED"
+                s.attributes["shed"] = 1
+                if context is not None:
+                    context.set_trailing_metadata(_pushback_trailing())
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc))
             except (DeviceWedgedError, ServingUnavailable) as exc:
                 # Supervisor sheds (wedged device window, BROWNOUT): LOUD
                 # UNAVAILABLE with the standard retry-pushback hint so
@@ -436,9 +472,45 @@ class RiskGrpcService:
             self.telemetry.bind_engine(engine)
         else:
             _runtime_telemetry.uninstall()
+        # Deadline passthrough is duck-typed: production engines
+        # (TPUScoringEngine, SupervisedScoringEngine) take deadline=,
+        # but the engine seam is a plain callable contract and test
+        # doubles/legacy engines may not — detect once, never TypeError
+        # a live RPC over it.
+        import inspect
+
+        try:
+            sig = inspect.signature(engine.score)
+            self._score_takes_deadline = (
+                "deadline" in sig.parameters
+                or any(p.kind == p.VAR_KEYWORD
+                       for p in sig.parameters.values()))
+        except (TypeError, ValueError):
+            self._score_takes_deadline = True
+        # Closed loop on the SLO plane (serve/deadline.py): while the
+        # fast-window burn alert is active, bulk ScoreBatch admissions
+        # shed with BULK_SHED + pushback so the interactive lane's p99
+        # recovers; bulk resumes the moment the alert clears. Reads the
+        # SLOEngine installed above lazily — SLO=0 leaves it inert.
+        self.burn_gate = BurnShedGate()
         batcher = getattr(engine, "_batcher", None)
         if batcher is not None:
             batcher.on_batch = self._observe_batcher_batch
+            # Deadline-plane metrics (all labels bounded per MX05):
+            # expiry sheds by stage, per-lane queue depth, the planned
+            # batch shape per tick, and each dispatched request's
+            # remaining budget.
+            batcher.on_plan = self.metrics.batch_size_chosen.observe
+            batcher.on_dispatch_deadlines = (
+                self.metrics.deadline_remaining_ms.observe_many)
+            sched = getattr(batcher, "scheduler", None)
+            if sched is not None:
+                sched.on_expired = (
+                    lambda n, stage, lane:
+                    self.metrics.deadline_expired_total.inc(n, stage=stage))
+                sched.on_depth = (
+                    lambda lane, depth:
+                    self.metrics.lane_depth.set(depth, lane=lane))
 
     def _observe_batcher_batch(self, waits_ms: list, depth: int) -> None:
         """Batcher hook: time-in-queue histogram + queue-depth gauge, and
@@ -506,13 +578,46 @@ class RiskGrpcService:
             session_id=req.session_id,
         )
 
+    def _admit_deadline(self, context, stage: str = "admission"):
+        """Parse the request's deadline (risk-deadline-ms metadata > gRPC
+        context deadline > DEADLINE_DEFAULT_MS) and shed an
+        already-expired request up front — scoring a row its caller will
+        never receive only steals capacity from live ones."""
+        ddl = _deadline.from_grpc(context)
+        if ddl.expired():
+            self.metrics.deadline_expired_total.inc(stage=stage)
+            raise RpcAbort(
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                "DEADLINE_SHED: request budget "
+                f"({ddl.budget_ms:.0f} ms, source={ddl.source}) already "
+                "spent at admission",
+                trailing=_pushback_trailing(), shed=True)
+        return ddl
+
     def ScoreTransaction(self, request, context):
         # Per-account scoring cap; the batch path (ScoreBatch / event
         # replay) is internal and exempt.
         if not self._rate_limiter.allow(request.account_id):
             raise RpcAbort(grpc.StatusCode.RESOURCE_EXHAUSTED,
                            "RATE_LIMITED: per-account scoring rate limit exceeded")
-        resp = self.engine.score(self._request_from_proto(request))
+        ddl = self._admit_deadline(context)
+        # Arms the burn->shed loop: bulk only sheds while there is
+        # interactive traffic to protect (serve/deadline.BurnShedGate).
+        self.burn_gate.note_interactive()
+        kwargs = {"deadline": ddl} if self._score_takes_deadline else {}
+        resp = self.engine.score(self._request_from_proto(request), **kwargs)
+        if ddl.source != "default" and ddl.expired():
+            # The caller set an EXPLICIT deadline and it passed while we
+            # scored: per the deadline contract the caller has given up —
+            # answer DEADLINE_EXCEEDED (a shed), not a stale OK. Requests
+            # without an explicit deadline keep their answer: the default
+            # budget shapes scheduling, not the response contract.
+            self.metrics.deadline_expired_total.inc(stage="response")
+            raise RpcAbort(
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                "DEADLINE_SHED: scored result ready after the request's "
+                f"budget ({ddl.budget_ms:.0f} ms) expired",
+                trailing=_pushback_trailing(), shed=True)
         self.metrics.score_distribution.observe(resp.score)
         self.metrics.txns_scored_total.inc()
         trailing: list[tuple[str, str]] = []
@@ -541,13 +646,29 @@ class RiskGrpcService:
 
     def ScoreBatch(self, request, context):
         # Admission control (overload shedding): see __init__. A caller
-        # whose deadline is already nearly spent is rejected up front —
-        # running a batch it will never receive only steals capacity.
-        remaining = context.time_remaining() if context is not None else None
-        if remaining is not None and remaining < 0.05:
+        # whose deadline is already spent is rejected up front — running
+        # a batch it will never receive only steals capacity. The bulk
+        # lane keeps a small slack floor: a batch with under 50 ms left
+        # cannot finish decode+score+encode, so it sheds as bulk
+        # backpressure even though not strictly expired yet.
+        ddl = self._admit_deadline(context)
+        if ddl.source != "default" and ddl.remaining_ms() < 50.0:
             self.metrics.bulk_shed_total.inc()
             raise RpcAbort(grpc.StatusCode.RESOURCE_EXHAUSTED,
-                           "BULK_SHED: deadline nearly exhausted before start")
+                           "BULK_SHED: deadline nearly exhausted before start",
+                           trailing=_pushback_trailing(), shed=True)
+        # Closed loop on the SLO plane: while the fast window burns,
+        # bulk admissions shed with pushback (interactive traffic is
+        # what the error budget protects; bulk callers retry with
+        # backoff and resume the moment the alert clears).
+        if self.burn_gate.shedding():
+            self.burn_gate.note_shed()
+            self.metrics.bulk_shed_total.inc()
+            raise RpcAbort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                "BULK_SHED: error budget burning (fast-window SLO alert "
+                "active); bulk lane shedding until it clears",
+                trailing=_pushback_trailing(), shed=True)
         # The admission wait is a lifecycle stage: under overload it is
         # real queueing the RPC span would otherwise carry unattributed.
         with span("score.admission"):
@@ -658,11 +779,20 @@ class RiskGrpcService:
                 return np.asarray(row, dtype=np.float32).reshape(1, NUM_LTV_FEATURES)
         return np.zeros((1, NUM_LTV_FEATURES), dtype=np.float32)
 
+    def _background_dispatch_turn(self) -> None:
+        """LTV/background device work rides the BACKGROUND lane of the
+        dispatch gate: it yields briefly to a launching interactive
+        batch (bounded by the lane's aging budget — never starved)."""
+        gate = getattr(self.engine, "lane_gate", None)
+        if gate is not None:
+            gate.acquire(LANE_BACKGROUND)
+
     def PredictLTV(self, request, context):
         from google.protobuf.timestamp_pb2 import Timestamp
 
         from igaming_platform_tpu.models.ltv import ACTIONS, predict_batch_jit
 
+        self._background_dispatch_turn()
         out = predict_batch_jit(self._ltv_row(request.account_id))
         ts = Timestamp()
         ts.GetCurrentTime()
@@ -681,6 +811,7 @@ class RiskGrpcService:
     def GetPlayerSegment(self, request, context):
         from igaming_platform_tpu.models.ltv import ACTIONS, predict_batch_jit
 
+        self._background_dispatch_turn()
         out = predict_batch_jit(self._ltv_row(request.account_id))
         return risk_pb2.GetPlayerSegmentResponse(
             account_id=request.account_id,

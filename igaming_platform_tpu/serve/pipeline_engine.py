@@ -350,7 +350,8 @@ class HostPipeline:
                 self.batches += 1
             # Blocks at `depth` batches in flight: the device stays <=
             # depth steps ahead of readback (bounded memory, ping-pong).
-            self._inflight_q.put((job, idx, lo, hi - lo, out, xp_buf, bl_buf))
+            self._inflight_q.put(
+                (job, idx, lo, hi - lo, out, xp_buf, bl_buf, t0))
 
     # -- readback worker -----------------------------------------------------
 
@@ -361,7 +362,7 @@ class HostPipeline:
             item = self._inflight_q.get()
             if item is _SENTINEL:
                 return
-            job, idx, lo, n, out, xp_buf, bl_buf = item
+            job, idx, lo, n, out, xp_buf, bl_buf, t_dispatch = item
             t0 = time.monotonic()
             try:
                 with span("score.readback", parent=job.parent, batch=n):
@@ -373,6 +374,13 @@ class HostPipeline:
                 continue
             self._note_inflight(-1)
             self._note_busy("readback", time.monotonic() - t0)
+            # Bulk chunks feed the same online step model the deadline
+            # scheduler plans against — the throughput shapes get real
+            # evidence even when interactive traffic never pads to them.
+            model = getattr(self._engine, "step_model", None)
+            if model is not None:
+                model.observe(self._engine._pick_shape(n),
+                              (time.monotonic() - t_dispatch) * 1000.0)
             # Readback done -> the step has consumed its inputs; only now
             # may the staging buffers be rewritten (CPU zero-copy alias).
             self._arena.release(xp_buf)

@@ -61,6 +61,12 @@ import grpc
 from igaming_platform_tpu.obs import tracing
 from igaming_platform_tpu.obs.metrics import ServiceMetrics
 from igaming_platform_tpu.serve import chaos
+from igaming_platform_tpu.serve import deadline as deadline_mod
+from igaming_platform_tpu.serve.deadline import (
+    DEADLINE_METADATA_KEY,
+    Deadline,
+    outbound_deadline_ms,
+)
 from igaming_platform_tpu.serve.wire import INDEX_WIRE_MAGIC, RawProtoMessage
 
 logger = logging.getLogger(__name__)
@@ -532,6 +538,7 @@ class ScoringRouter:
             "forwards": 0, "retries": 0, "pushbacks_honored": 0,
             "hedges_launched": 0, "hedge_wins": 0, "primary_wins": 0,
             "hedges_both_failed": 0, "link_drops": 0,
+            "hedges_suppressed": 0, "deadline_sheds": 0,
         }
 
         # Fleet aggregation plane (obs/fleetview.py): built by
@@ -639,22 +646,33 @@ class ScoringRouter:
         return base_s * self._jitter()
 
     @staticmethod
-    def _outbound_metadata(fallback: tuple = ()) -> tuple:
-        """Trace context for a replica hop: the CURRENT span's
-        traceparent when the router is inside one (so the replica's rpc
-        span parents under the router's attempt span — router time and
-        hedges become visible stages of the same trace), else the
-        caller's forwarded header."""
+    def _outbound_metadata(fallback: tuple = (),
+                           deadline: Deadline | None = None) -> tuple:
+        """Per-hop outbound metadata: the CURRENT span's traceparent when
+        the router is inside one (so the replica's rpc span parents under
+        the router's attempt span — router time and hedges become visible
+        stages of the same trace), else the caller's forwarded header;
+        plus ``risk-deadline-ms`` DECREMENTED by the time already spent
+        at this hop — the replica sees the budget that is actually left,
+        recomputed at every send (retries and hedges each get the honest
+        remainder), floored at 0 so a spent budget sheds at the replica's
+        admission instead of being scored dead."""
         tp = tracing.current_traceparent()
-        if tp:
-            return (("traceparent", tp),)
-        return fallback
+        md = [("traceparent", tp)] if tp else [
+            kv for kv in fallback if kv[0] != DEADLINE_METADATA_KEY]
+        ms = outbound_deadline_ms(deadline)
+        if ms is not None:
+            md.append((DEADLINE_METADATA_KEY, str(ms)))
+        return tuple(md)
 
     def _forward(self, call_attr: str, payload: bytes, key: str,
-                 timeout_s: float, metadata: tuple = ()) -> bytes:
+                 timeout_s: float, metadata: tuple = (),
+                 ddl: Deadline | None = None) -> bytes:
         """Forward to the ring owner of ``key``; UNAVAILABLE walks the
         ring to the next owner with a jittered (pushback-honoring) wait
-        between attempts, bounded by ``max_attempts``."""
+        between attempts, bounded by ``max_attempts``. ``ddl`` is the
+        caller's deadline — each attempt's outbound ``risk-deadline-ms``
+        carries the remaining budget at THAT send."""
         tried: set[str] = set()
         last_exc: grpc.RpcError | None = None
         for attempt in range(self.max_attempts):
@@ -676,7 +694,7 @@ class ScoringRouter:
                             f"router->{target} link dropped (chaos)")
                     return getattr(replica, call_attr)(
                         payload, timeout=timeout_s,
-                        metadata=self._outbound_metadata(metadata))
+                        metadata=self._outbound_metadata(metadata, ddl))
             except grpc.RpcError as exc:
                 if exc.code() != grpc.StatusCode.UNAVAILABLE:
                     raise  # the replica answered; its status is the answer
@@ -711,16 +729,17 @@ class ScoringRouter:
     # -- hedged single-transaction path --------------------------------------
 
     def _hedged_score_txn(self, payload: bytes, key: str, timeout_s: float,
-                          metadata: tuple) -> bytes:
+                          metadata: tuple, ddl: Deadline | None = None) -> bytes:
         owners = self.ring.owners(key, n=2)
         if len(owners) < 2:
-            return self._forward("score_txn", payload, key, timeout_s, metadata)
+            return self._forward("score_txn", payload, key, timeout_s,
+                                 metadata, ddl)
         primary, secondary = self.replicas[owners[0]], self.replicas[owners[1]]
         t0 = time.monotonic()
         self._bump("forwards")
         fut_primary = primary.score_txn.future(
             payload, timeout=timeout_s,
-            metadata=self._outbound_metadata(metadata))
+            metadata=self._outbound_metadata(metadata, ddl))
         hedge_s = self.latency.hedge_deadline_s()
         try:
             data = fut_primary.result(timeout=hedge_s)
@@ -735,8 +754,29 @@ class ScoringRouter:
             self._bump("retries")
             time.sleep(self._backoff_s(exc))
             return self._forward("score_txn", payload, key,
-                                 timeout_s, metadata)
+                                 timeout_s, metadata, ddl)
         else:
+            self.latency.observe_ms((time.monotonic() - t0) * 1000.0)
+            return data
+
+        # Deadline-aware hedge budget rule: a hedge is only worth its
+        # device time when the request's REMAINING budget still covers
+        # the secondary's expected completion (the same p95-derived
+        # figure the hedge trigger uses). Past that point the secondary
+        # would answer a caller who already gave up — ride out the
+        # primary instead and let its own deadline handling decide.
+        if ddl is not None and ddl.remaining_ms() < hedge_s * 1000.0:
+            self._bump("hedges_suppressed")
+            self.metrics.hedge_total.inc(outcome="suppressed")
+            remaining_s = max(0.01, min(timeout_s - hedge_s,
+                                        ddl.remaining_ms() / 1000.0))
+            try:
+                data = fut_primary.result(timeout=remaining_s)
+            except grpc.FutureTimeoutError as exc:
+                fut_primary.cancel()
+                raise RouterForwardError(
+                    f"primary {primary.id} straggled past the request "
+                    "deadline with no hedge budget left") from exc
             self.latency.observe_ms((time.monotonic() - t0) * 1000.0)
             return data
 
@@ -750,7 +790,7 @@ class ScoringRouter:
         with tracing.span("router.hedge", replica=secondary.id) as hedge_span:
             fut_hedge = secondary.score_txn.future(
                 payload, timeout=timeout_s,
-                metadata=self._outbound_metadata(metadata))
+                metadata=self._outbound_metadata(metadata, ddl))
             done = threading.Event()
             fut_primary.add_done_callback(lambda _f: done.set())
             fut_hedge.add_done_callback(lambda _f: done.set())
@@ -827,6 +867,32 @@ class ScoringRouter:
         return RpcAbort(grpc.StatusCode.UNAVAILABLE, str(exc),
                         trailing=_pushback_trailing())
 
+    def _admit_deadline(self, context) -> Deadline | None:
+        """The caller's deadline at the router hop: ``risk-deadline-ms``
+        metadata or the gRPC context deadline — None when the caller sent
+        neither (the router never invents one; replicas apply their own
+        default at their admission). Already-expired requests shed HERE
+        with DEADLINE_EXCEEDED + pushback: forwarding work no replica
+        can finish in time just burns fleet capacity."""
+        ddl = deadline_mod.from_grpc(
+            context, default_ms=deadline_mod.DEADLINE_MAX_MS)
+        if ddl.source == "default":
+            return None
+        if ddl.expired():
+            from igaming_platform_tpu.serve.grpc_server import (
+                RpcAbort,
+                _pushback_trailing,
+            )
+
+            self._bump("deadline_sheds")
+            self.metrics.deadline_expired_total.inc(stage="router")
+            raise RpcAbort(
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                "DEADLINE_SHED: request budget already spent at the "
+                "router hop",
+                trailing=_pushback_trailing(), shed=True)
+        return ddl
+
     def ScoreTransaction(self, request, context):
         from risk.v1 import risk_pb2
 
@@ -841,6 +907,7 @@ class ScoringRouter:
                            f"bad ScoreTransactionRequest: {exc}") from exc
         metadata = self._propagate_metadata(context)
         timeout_s = self._timeout_for(context)
+        ddl = self._admit_deadline(context)
         try:
             # Routing is a trace stage of the client's request: the time
             # between "router had the bytes" and "a replica answered" —
@@ -848,10 +915,10 @@ class ScoringRouter:
             with tracing.span("router.route", method="ScoreTransaction"):
                 if self.hedge_enabled:
                     data = self._hedged_score_txn(
-                        buf, account_id, timeout_s, metadata)
+                        buf, account_id, timeout_s, metadata, ddl)
                 else:
                     data = self._forward("score_txn", buf, account_id,
-                                         timeout_s, metadata)
+                                         timeout_s, metadata, ddl)
         except RouterForwardError as exc:
             raise self._abort(exc) from exc
         self.metrics.txns_scored_total.inc()
@@ -865,6 +932,7 @@ class ScoringRouter:
         buf = bytes(request)
         metadata = self._propagate_metadata(context)
         timeout_s = self._timeout_for(context)
+        ddl = self._admit_deadline(context)
         if buf[:4] == INDEX_WIRE_MAGIC:
             # Index frames are built per-owner by the client picker (the
             # whole point of index mode is replica-resident cache state);
@@ -883,7 +951,7 @@ class ScoringRouter:
                 with tracing.span("router.route", method="ScoreBatch",
                                   mode="index"):
                     data = self._forward("score_batch", buf, key,
-                                         timeout_s, metadata)
+                                         timeout_s, metadata, ddl)
             except RouterForwardError as exc:
                 raise self._abort(exc) from exc
             self.metrics.txns_scored_total.inc(len(ids))
@@ -910,17 +978,18 @@ class ScoringRouter:
                 if len(groups) <= 1:
                     key = txs[0].account_id if txs else ""
                     data = self._forward("score_batch", buf, key,
-                                         timeout_s, metadata)
+                                         timeout_s, metadata, ddl)
                     self.metrics.txns_scored_total.inc(len(txs))
                     return RawProtoMessage(data)
-                data = self._split_batch(req, groups, timeout_s, metadata)
+                data = self._split_batch(req, groups, timeout_s, metadata, ddl)
         except RouterForwardError as exc:
             raise self._abort(exc) from exc
         self.metrics.txns_scored_total.inc(len(txs))
         return data
 
     def _split_batch(self, req, groups: dict[str, list[int]],
-                     timeout_s: float, metadata: tuple):
+                     timeout_s: float, metadata: tuple,
+                     ddl: Deadline | None = None):
         """Account-affinity split: each owner gets exactly its rows, the
         sub-batches fly concurrently, and results merge back in request
         order. A sub-batch whose owner dies mid-flight retries onto the
@@ -938,7 +1007,7 @@ class ScoringRouter:
                     transactions=[txs[i] for i in idxs])
                 payload = self._forward(
                     "score_batch", sub.SerializeToString(),
-                    txs[idxs[0]].account_id, timeout_s, metadata)
+                    txs[idxs[0]].account_id, timeout_s, metadata, ddl)
                 return idxs, risk_pb2.ScoreBatchResponse.FromString(payload)
 
         futures = [self._pool.submit(_one, owner, idxs)

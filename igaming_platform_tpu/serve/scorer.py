@@ -36,6 +36,12 @@ from igaming_platform_tpu.models.ensemble import make_score_fn
 from igaming_platform_tpu.obs.tracing import annotate, span
 from igaming_platform_tpu.parallel.mesh import AXIS_DATA, validate_batch_for_mesh
 from igaming_platform_tpu.serve.batcher import ContinuousBatcher, pad_batch
+from igaming_platform_tpu.serve.deadline import (
+    LANE_BULK,
+    LANE_INTERACTIVE,
+    Deadline,
+    LaneGate,
+)
 from igaming_platform_tpu.serve.feature_store import InMemoryFeatureStore, TransactionEvent
 
 
@@ -392,10 +398,21 @@ class TPUScoringEngine:
         self._host_pipeline_lock = threading.Lock()
         self._pipeline_metrics_sink = None
 
+        # Deadline plane (serve/deadline.py): the online step-time model
+        # the scheduler plans batch shape/flush against, and the lane
+        # gate that gives interactive batches first access to the device
+        # when bulk chunk dispatches contend.
+        from igaming_platform_tpu.obs.perfmodel import OnlineStepModel
+
+        self.step_model = OnlineStepModel()
+        self.lane_gate = LaneGate()
         self._batcher = ContinuousBatcher(
             cfg=batcher_config,
             dispatch=self._dispatch_requests,
             collect=self._collect_requests,
+            shapes=self._shapes,
+            step_model=self.step_model,
+            lane_gate=self.lane_gate,
         )
         if warmup:
             self.warmup()
@@ -564,6 +581,10 @@ class TPUScoringEngine:
             snap = self.params_snapshot()
         if n_valid is None:
             n_valid = xp.shape[0]
+        # Bulk chunk dispatch yields briefly to a launching interactive
+        # batch (bounded by the bulk lane's aging budget) — the device
+        # queue orders interactive steps first under contention.
+        self.lane_gate.acquire(LANE_BULK)
         params = snap[1] if use_host else snap[0]
         thresholds = self._thresholds_host if use_host else self._thresholds
         if use_host:
@@ -625,12 +646,38 @@ class TPUScoringEngine:
 
     # -- scoring -------------------------------------------------------------
 
-    def score(self, req: ScoreRequest, timeout: float = 30.0) -> ScoreResponse:
-        """Single-transaction scoring via the continuous batcher."""
+    def score(self, req: ScoreRequest, timeout: float = 30.0,
+              deadline: Deadline | None = None,
+              lane: str = LANE_INTERACTIVE) -> ScoreResponse:
+        """Single-transaction scoring via the continuous batcher.
+        ``deadline`` (serve/deadline.py) rides into the scheduler: EDF
+        order within the lane, shed (DeadlineExpired) instead of scored
+        if the budget runs out while queued."""
         start = time.monotonic()
-        resp: ScoreResponse = self._batcher.score_sync(req, timeout=timeout)
+        resp: ScoreResponse = self._batcher.score_sync(
+            req, timeout=timeout, deadline=deadline, lane=lane)
         resp.response_time_ms = (time.monotonic() - start) * 1000.0
         return resp
+
+    def deadline_snapshot(self) -> dict:
+        """The deadline plane's debug surface (/debug/deadlinez): lane
+        depths, expiry-shed and hedge counters, the per-shape step-time
+        model, and the lane gate's yield count."""
+        b = self._batcher
+        return {
+            "lanes": b.scheduler.depths(),
+            "queued": b.scheduler.qsize(),
+            "batches_run": b.batches_run,
+            "rows_scored": b.rows_scored,
+            "batches_replayed": b.batches_replayed,
+            "batches_hedged": b.batches_hedged,
+            "expired_shed": b.expired_shed,
+            # Structural "zero scored dead" evidence: rows that entered a
+            # dispatch with a spent budget (the assembly shed keeps this 0).
+            "dead_dispatched": b.dead_dispatched,
+            "lane_gate_yields": self.lane_gate.yields,
+            "step_model": self.step_model.snapshot(),
+        }
 
     def score_batch(self, reqs: list[ScoreRequest]) -> list[ScoreResponse]:
         """Direct batch path (ScoreBatch RPC / event-stream replay)."""
@@ -808,6 +855,7 @@ class TPUScoringEngine:
             hi = min(lo + self.batch_size, total)
             with span("score.cache_lookup", batch=hi - lo):
                 idxs = self.cache.lookup(account_ids[lo:hi], now=now)
+            self.lane_gate.acquire(LANE_BULK)
             with span("score.dispatch", batch=hi - lo), annotate("score_step"):
                 out, n = self._launch_cached(
                     idxs, amounts32[lo:hi], types32[lo:hi], bl[lo:hi], snap)
@@ -1149,6 +1197,7 @@ class TPUScoringEngine:
         snap = self.params_snapshot()
         for lo in range(0, total, self.batch_size):
             hi = min(lo + self.batch_size, total)
+            self.lane_gate.acquire(LANE_BULK)
             with span("score.dispatch", batch=hi - lo), annotate("score_step"):
                 out, n = self._launch_device(x[lo:hi], bl[lo:hi], snap)
             inflight.append((out, lo, n))

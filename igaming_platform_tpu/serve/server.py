@@ -244,6 +244,7 @@ class RiskServer:
 
         self.slo = slo_mod.get_default()
         self.drift = drift_mod.get_default()
+        self.service = service
         self.telemetry = service.telemetry
         if self.telemetry is not None:
             self.telemetry.bind_profile_trigger(self._anomaly_profile_trigger)
@@ -561,6 +562,23 @@ class RiskServer:
                         self._send(404, '{"error":"telemetry disabled"}')
                         return
                     self._send(200, json.dumps(tel.snapshot()))
+                elif self.path == "/debug/deadlinez":
+                    # Deadline scheduler plane: lane depths, expiry
+                    # sheds, dead-dispatch evidence, the online
+                    # step-time model and the burn->shed gate (runbook:
+                    # docs/operations.md "Deadline scheduling").
+                    inner = getattr(server_ref.engine, "inner",
+                                    server_ref.engine)
+                    snap_fn = getattr(inner, "deadline_snapshot", None)
+                    if snap_fn is None:
+                        self._send(404, '{"error":"deadline plane unavailable"}')
+                        return
+                    snap = snap_fn()
+                    svc = getattr(server_ref, "service", None)
+                    gate = getattr(svc, "burn_gate", None)
+                    if gate is not None:
+                        snap["burn_gate"] = gate.stats()
+                    self._send(200, json.dumps(snap))
                 elif self.path == "/debug/spans":
                     from igaming_platform_tpu.obs.tracing import DEFAULT_COLLECTOR
                     self._send(200, DEFAULT_COLLECTOR.to_json())

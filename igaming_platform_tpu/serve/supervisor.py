@@ -740,14 +740,24 @@ class SupervisedScoringEngine:
 
     # -- scoring entry points --------------------------------------------------
 
-    def score(self, req, timeout: float = 30.0):
+    def score(self, req, timeout: float = 30.0, **kwargs):
+        """``kwargs`` (deadline=, lane= — serve/deadline.py) pass through
+        to the inner engine's scheduler; the degraded heuristic tier is
+        host-local and synchronous, so a deadline there is moot."""
         if self.supervisor.state == BROWNOUT:
             self._shed_brownout()
         if not self._device.allow():
             return self._degraded_requests([req])[0]
         try:
-            resp = self._inner.score(req, timeout=min(timeout, self._watchdog_s))
+            resp = self._inner.score(req, timeout=min(timeout, self._watchdog_s),
+                                     **kwargs)
         except Exception as exc:  # noqa: BLE001 — classified + degraded below
+            from igaming_platform_tpu.serve.deadline import DeadlineExpired
+
+            if isinstance(exc, DeadlineExpired):
+                # A deadline shed is the CALLER's status, not device
+                # sickness: no breaker evidence, no degraded answer.
+                raise
             dep, fatal = self._record_failure(exc)
             if fatal:
                 raise DeviceWedgedError(

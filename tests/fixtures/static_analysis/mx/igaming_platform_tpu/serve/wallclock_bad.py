@@ -1,0 +1,25 @@
+"""MX06 seed: wall-clock deadline/timeout arithmetic in serve/.
+
+Every marked line anchors a deadline-ish quantity to time.time(), which
+steps backwards under NTP — the monotonic-clock discipline violation the
+rule exists to catch."""
+
+import time
+
+
+def admission_deadline(budget_ms: float) -> float:
+    deadline = time.time() + budget_ms / 1000.0  # expect: MX06
+    return deadline
+
+
+def budget_left(deadline: float) -> float:
+    remaining_s = deadline - time.time()  # expect: MX06
+    return remaining_s
+
+
+def expired(expires_at: float) -> bool:
+    return time.time() >= expires_at  # expect: MX06
+
+
+def wait_for(cv, timeout_s: float) -> None:
+    cv.wait(timeout=timeout_s - time.time())  # expect: MX06

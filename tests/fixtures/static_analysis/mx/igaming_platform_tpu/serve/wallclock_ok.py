@@ -1,0 +1,23 @@
+"""MX06-compliant sibling: deadlines anchor to the monotonic clock;
+time.time() appears only to RECORD an event's wall timestamp (no
+deadline arithmetic), which is legitimate and must stay quiet."""
+
+import time
+
+
+def admission_deadline(budget_ms: float) -> float:
+    return time.monotonic() + budget_ms / 1000.0
+
+
+def budget_left(deadline: float) -> float:
+    remaining_s = deadline - time.monotonic()
+    return remaining_s
+
+
+def event_timestamp() -> float:
+    created_at = time.time()
+    return created_at
+
+
+def record(event) -> dict:
+    return {"ts": event.timestamp or time.time(), "kind": event.kind}

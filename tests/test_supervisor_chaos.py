@@ -273,7 +273,10 @@ def test_wedge_trips_watchdog_then_rpcs_recover():
             account_id="w", amount=1000, transaction_type="deposit")
         stub.ScoreTransaction(req)  # warm path
 
-        chaos.install("seed=5;device.readback=wedge:p=1.0:ms=2500:count=1")
+        # count=2: the batcher's stall hedge (serve/batcher.py) would
+        # recover a SINGLE wedged readback by re-dispatching the batch —
+        # to demonstrate the watchdog, the hedged collect must wedge too.
+        chaos.install("seed=5;device.readback=wedge:p=1.0:ms=2500:count=2")
         t0 = time.monotonic()
         with pytest.raises(grpc.RpcError) as exc_info:
             stub.ScoreTransaction(req)

@@ -12,6 +12,7 @@ argument from either position or keyword.
 from __future__ import annotations
 
 import ast
+import re
 
 from tools.analysis.engine import FileContext, call_name, rule
 
@@ -253,6 +254,72 @@ def metric_label_cardinality(ctx: FileContext):
                     f"identifier `{hit}`: one time series per value — "
                     "use a bounded enumeration, or carry the id as an "
                     "exemplar/flight/ledger field")
+
+
+# MX06: wall-clock in deadline/timeout arithmetic. time.time() steps
+# backwards under NTP and jumps on slew; a deadline computed from it can
+# revive an expired request or expire a live one (and breaks CC06 replay
+# determinism when the result is ledgered). The serving path's deadline
+# discipline (serve/deadline.py) is monotonic-only.
+_MX06_SCOPE_PART = "serve"
+_MX06_NAME = re.compile(r"deadline|timeout|expir|remaining|time_left", re.I)
+
+
+def _is_wall_clock_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "time"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time")
+
+
+def _mx06_deadline_mention(stmt: ast.stmt) -> str | None:
+    """A deadline-ish identifier anywhere in the statement: assignment
+    targets, names, attributes, or keyword-argument names."""
+    for sub in ast.walk(stmt):
+        if isinstance(sub, ast.Name) and _MX06_NAME.search(sub.id):
+            return sub.id
+        if isinstance(sub, ast.Attribute) and _MX06_NAME.search(sub.attr):
+            return sub.attr
+        if isinstance(sub, ast.keyword) and sub.arg and _MX06_NAME.search(sub.arg):
+            return sub.arg
+    return None
+
+
+@rule("MX06", "wall-clock-deadline",
+      "time.time() in deadline/timeout arithmetic on the serving path: "
+      "the wall clock steps backwards under NTP and jumps on slew, so a "
+      "deadline anchored to it can revive an expired request or expire a "
+      "live one (and, ledgered, breaks CC06 replay determinism). "
+      "Deadline/timeout computations in serve/ must use time.monotonic() "
+      "(serve/deadline.py is the reference discipline); event timestamps "
+      "that merely RECORD wall time are fine — the rule keys on the "
+      "statement also naming a deadline/timeout/expiry quantity.")
+def wall_clock_deadline(ctx: FileContext):
+    parts = ctx.path.parts
+    if "igaming_platform_tpu" not in parts or _MX06_SCOPE_PART not in parts:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.stmt):
+            continue
+        calls = [sub for sub in ast.walk(node)
+                 if _is_wall_clock_call(sub)
+                 # own statement only, not nested statements' calls
+                 ]
+        if not calls:
+            continue
+        # Anchor on the narrowest statement containing the call so one
+        # function body doesn't multi-report through its parents.
+        if any(isinstance(child, ast.stmt) for child in ast.walk(node)
+               if child is not node and any(
+                   _is_wall_clock_call(s) for s in ast.walk(child))):
+            continue
+        hit = _mx06_deadline_mention(node)
+        if hit is not None:
+            yield calls[0].lineno, (
+                f"time.time() feeding deadline-ish quantity `{hit}` — "
+                "wall clock steps under NTP; anchor deadlines/timeouts "
+                "to time.monotonic() (serve/deadline.py)")
 
 
 @rule("MX03", "orphan-metric",
