@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-fast lint lint-json lint-update-baseline bench bench-all bench-paced bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos soak-chaos-ledger soak-slo soak-online soak-drift soak-deadline replay-verify fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
+.PHONY: all test test-fast lint lint-json lint-update-baseline bench bench-all bench-paced bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos soak-chaos-ledger soak-slo soak-online soak-drift soak-session soak-deadline replay-verify fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
 
 all: native test
 
@@ -110,6 +110,16 @@ soak-online:
 # -> DRIFT_r11.json with explicit gates.
 soak-drift:
 	$(PY) benchmarks/soak.py --drift-chaos
+
+# Stateful-sequence-scoring chaos: a seeded coordinated fraud ring must
+# be flagged by the session path and provably missed by the
+# aggregate-only baseline; then a production WIRE_MODE=index replica
+# under CLOCK-eviction churn + a mid-run SIGKILL racks up >= 100k
+# stateful decisions whose session_state_hash all replay bit-exact,
+# with dispatches-per-RPC unchanged and session-on/off A/B within noise
+# -> SESSION_r13.json with explicit gates.
+soak-session:
+	$(PY) benchmarks/soak.py --session-chaos
 
 # Bit-exact decision replay smoke (tier-1-adjacent): score a seeded
 # batch under CHAOS_PLAN (ledger-append faults), replay the ledger with

@@ -334,6 +334,9 @@ def run_grpc_load(
     fleet_addrs: list[str] | None = None,
     drift_ramp=None,
     drift_phases: int = 8,
+    fraud_ring=None,
+    fraud_ring_seed: int = 29,
+    fraud_ring_time_scale: float = 1.0,
 ) -> dict:
     """Drive ScoreBatch at ``addr`` from ``concurrency`` client threads for
     ``duration_s``; returns sustained txns/s + RPC latency percentiles.
@@ -347,7 +350,15 @@ def run_grpc_load(
     amounts: the run is cut into ``drift_phases`` payload sets, each
     pre-built with the ramp's transform at that phase's run fraction
     (same seed -> byte-identical payloads run-to-run), and the artifact
-    records the injected schedule verbatim (``drift_block``)."""
+    records the injected schedule verbatim (``drift_block``).
+
+    ``fraud_ring`` (a train/fraudgen.FraudRing or its spec string)
+    additionally runs ONE injector thread pacing the ring's seeded event
+    schedule in wall time (``fraud_ring_time_scale`` compresses it for
+    short runs) as 1-row index-mode ScoreBatch frames — riding the
+    session-state path on a WIRE_MODE=index server — and records the
+    schedule verbatim in the artifact (``fraud_ring_block``, mirroring
+    the --drift-ramp pattern)."""
     phase_payload_sets: list[list[bytes]] | None = None
     drift_block = None
     if drift_ramp is not None:
@@ -482,13 +493,72 @@ def run_grpc_load(
         for ch in channels:
             ch.close()
 
+    fraud_ring_block = None
+    ring_sent = [0]
+    ring_errors = [0]
+    if fraud_ring is not None:
+        from igaming_platform_tpu.serve.wire import encode_index_batch
+        from igaming_platform_tpu.train.fraudgen import FraudRing
+
+        ring = (FraudRing.parse(fraud_ring) if isinstance(fraud_ring, str)
+                else fraud_ring)
+        ring_schedule = ring.schedule(fraud_ring_seed)
+        fraud_ring_block = ring.schedule_block(fraud_ring_seed)
+        fraud_ring_block["time_scale"] = fraud_ring_time_scale
+
+        def ring_injector() -> None:
+            ch = grpc.insecure_channel(addr)
+            call = ch.unary_unary(
+                "/risk.v1.RiskService/ScoreBatch",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b)
+            spin = time.perf_counter() + 120.0
+            while stop_at[0] == 0.0:
+                if time.perf_counter() > spin:
+                    return
+                time.sleep(0.001)
+            t_base = stop_at[0] - duration_s
+            for row in ring_schedule:
+                due = t_base + row["t_s"] * fraud_ring_time_scale
+                now = time.perf_counter()
+                if now >= stop_at[0]:
+                    break
+                if due > now:
+                    time.sleep(min(due - now, stop_at[0] - now))
+                payload = encode_index_batch(
+                    [row["account_id"]], [row["amount"]], [row["tx_type"]])
+                sent = False
+                for attempt in range(6):
+                    try:
+                        call(payload, timeout=10)
+                        sent = True
+                        break
+                    except grpc.RpcError as exc:
+                        if exc.code() != grpc.StatusCode.RESOURCE_EXHAUSTED:
+                            break
+                        # Bulk admission shed under flat-out background
+                        # load: the ring event is the payload under test,
+                        # retry with backoff like a well-behaved caller.
+                        time.sleep(0.02 * (attempt + 1))
+                if sent:
+                    ring_sent[0] += 1
+                else:
+                    ring_errors[0] += 1
+            ch.close()
+
     threads = [threading.Thread(target=worker, args=(k,)) for k in range(concurrency)]
+    if fraud_ring is not None:
+        threads.append(threading.Thread(target=ring_injector,
+                                        name="fraud-ring-injector"))
     t_start = time.perf_counter()
     for t in threads:
         t.start()
     for t in threads:
         t.join()
     wall = time.perf_counter() - t_start
+    if fraud_ring_block is not None:
+        fraud_ring_block["events_sent"] = ring_sent[0]
+        fraud_ring_block["events_failed"] = ring_errors[0]
 
     # Sustained rate = completions INSIDE the window / window length. RPCs
     # that complete after stop_at would otherwise credit up to
@@ -525,6 +595,7 @@ def run_grpc_load(
         "failovers": retry_stats.failovers,
         **({"fleet_replicas": len(fleet_addrs)} if fleet_addrs else {}),
         **({"drift_block": drift_block} if drift_block else {}),
+        **({"fraud_ring_block": fraud_ring_block} if fraud_ring_block else {}),
         "rpc_p50_ms": round(float(np.percentile(lat, 50)), 3) if n_rpcs else None,
         "rpc_p99_ms": round(float(np.percentile(lat, 99)), 3) if n_rpcs else None,
         "wall_s": round(wall, 3),
@@ -814,6 +885,7 @@ def main() -> None:
     addr = None
     fleet_addrs: list[str] | None = None
     drift_ramp = os.environ.get("LOAD_DRIFT_RAMP") or None
+    fraud_ring = os.environ.get("LOAD_FRAUD_RING") or None
     pace_rps: float | None = None
     pace_gates = False
     for arg in sys.argv[1:]:
@@ -840,6 +912,15 @@ def main() -> None:
         elif arg == "--drift-ramp":
             raise SystemExit(
                 "use --drift-ramp=mult=M[:shift=S:start=F:end=F]")
+        elif arg.startswith("--fraud-ring="):
+            # Seeded coordinated fraud-ring injection, e.g.
+            # --fraud-ring=size=6:period=90:cycles=12 (spec grammar:
+            # train/fraudgen.FraudRing.parse). Rides the session path;
+            # the schedule lands in the artifact (fraud_ring_block).
+            fraud_ring = arg.split("=", 1)[1]
+        elif arg == "--fraud-ring":
+            raise SystemExit(
+                "use --fraud-ring=size=K:period=S[:cycles=N:amount=A]")
         else:
             addr = arg
     if wire_mode not in ("row", "index"):
@@ -893,6 +974,9 @@ def main() -> None:
             wire_mode=wire_mode,
             fleet_addrs=fleet_addrs,
             drift_ramp=drift_ramp,
+            fraud_ring=fraud_ring,
+            fraud_ring_time_scale=float(
+                os.environ.get("LOAD_FRAUD_RING_TIME_SCALE", "1.0")),
         )
         pipeline = getattr(engine, "pipeline", None)
         if pipeline is not None:

@@ -2553,11 +2553,411 @@ def main_deadline() -> None:
         sys.exit(1)
 
 
+def main_session_chaos() -> None:
+    """Stateful-sequence-scoring chaos soak (``--session-chaos``) ->
+    SESSION_r13.json: the session plane (serve/session_state.py) proven
+    end-to-end in two arms:
+
+    1. **Deterministic fraud-ring arm (in-process, simulated clock)** —
+       a seeded coordinated ring (train/fraudgen.FraudRing: bet/deposit
+       cycling, machine-regular cadence, every member pacing under every
+       velocity rule) plus clean control traffic is driven through a
+       session-enabled engine AND an aggregate-only baseline with
+       identical feature write-back. Gates: the sequence path flags
+       >= 90% of post-warmup ring decisions (SESSION_PATTERN, action
+       review/block), the aggregate-only baseline flags ZERO of them,
+       and clean traffic raises zero false SESSION_PATTERN bits.
+
+    2. **Production-server arm (own OS process, WIRE_MODE=index,
+       SESSION_STATE=1, small FEATURE_CACHE_CAPACITY for CLOCK churn,
+       LEDGER_DIR)** — bulk index traffic from per-worker disjoint
+       account sets racks up >= SESSION_SOAK_ROWS stateful decisions
+       with a SIGKILL + same-dir/same-port restart mid-run. Gates:
+       eviction-under-load really happened (feature-cache evictions > 0
+       AND session rehydrations > 0), the fused step added ZERO device
+       dispatches per RPC vs a session-off control replica, session-on
+       flat-out throughput is within noise of session-off
+       (SESSION_AB_BAR), and tools/replay verifies EVERY recorded
+       session_state_hash bit-exact across the eviction churn and the
+       kill (>= SESSION_SOAK_ROWS verified, 0 mismatches, 0 chain gaps,
+       the restart visible as session resets).
+    """
+    import tempfile
+    import urllib.request
+
+    import grpc
+
+    from fleet import ReplicaProc
+    from igaming_platform_tpu.serve.wire import encode_index_batch
+    from igaming_platform_tpu.train.fraudgen import FraudRing
+
+    target_rows = int(os.environ.get("SESSION_SOAK_ROWS", "100000"))
+    ab_s = float(os.environ.get("SESSION_AB_S", "6"))
+    # A/B bar: the session plane does REAL per-row host work (window
+    # index + occurrence ranks + lazy-audit bookkeeping, ~3 us/row) that
+    # the 1-core control rig cannot overlap with the device step (CPU
+    # jit executes on the calling thread; on a real accelerator the
+    # async dispatch hides it). Same honesty stance as the drift A/B's
+    # 0.45 bar (DRIFT_r11) — the measured ratio is recorded either way.
+    ab_bar = float(os.environ.get("SESSION_AB_BAR", "0.45"))
+    result: dict = {"metric": "session_state_chaos_soak",
+                    "host_cpu_cores": os.cpu_count() or 1}
+    gates: dict = {}
+
+    # -- arm 1: deterministic fraud ring, sequence vs aggregate-only ---------
+    from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
+    from igaming_platform_tpu.core.enums import SESSION_PATTERN_BIT
+    from igaming_platform_tpu.serve.feature_store import TransactionEvent
+    from igaming_platform_tpu.serve.scorer import TPUScoringEngine
+
+    ring = FraudRing(
+        ring_size=int(os.environ.get("SESSION_RING_SIZE", "6")),
+        period_s=float(os.environ.get("SESSION_RING_PERIOD_S", "90")),
+        cycles=int(os.environ.get("SESSION_RING_CYCLES", "10")),
+        amount=900)
+    ring_seed = int(os.environ.get("SESSION_RING_SEED", "41"))
+    t_base = 1_700_000_000.0
+
+    def drive(session_on: bool) -> tuple[int, int, int, int]:
+        eng = TPUScoringEngine(
+            ScoringConfig(), ml_backend="mock",
+            batcher_config=BatcherConfig(batch_size=16, max_wait_ms=1.0),
+            feature_cache=64, session_state=session_on)
+        eng.ensure_cache()
+        min_ev = eng.session.min_events if session_on else 4
+        warm_idx: dict = {}
+        flagged = total_warm = escalated = 0
+        rng = np.random.default_rng(ring_seed + 1)
+        clean_flagged = 0
+        t_clean = 0.0
+        try:
+            for row in ring.schedule(ring_seed):
+                t = t_base + row["t_s"]
+                cat = eng.score_columns_cached(
+                    [row["account_id"]], [row["amount"]], [row["tx_type"]],
+                    now=t)
+                warm_idx[row["account_id"]] = warm_idx.get(
+                    row["account_id"], 0) + 1
+                if warm_idx[row["account_id"]] >= min_ev:
+                    total_warm += 1
+                    mask = int(cat["reason_mask"][0])
+                    if mask & (1 << SESSION_PATTERN_BIT):
+                        flagged += 1
+                    if int(cat["action"][0]) >= 2:
+                        escalated += 1
+                eng.update_features(TransactionEvent(
+                    account_id=row["account_id"], amount=row["amount"],
+                    tx_type=row["tx_type"], timestamp=t))
+            # Clean control traffic: irregular human-shaped sessions.
+            for i in range(240):
+                t_clean += float(rng.uniform(5.0, 900.0))
+                a = f"cl{i % 12}"
+                amt = int(rng.integers(50, 40_000))
+                tx = ("deposit", "bet", "win", "withdraw")[
+                    int(rng.integers(0, 4))]
+                cat = eng.score_columns_cached([a], [amt], [tx],
+                                               now=t_base + t_clean)
+                if int(cat["reason_mask"][0]) & (1 << SESSION_PATTERN_BIT):
+                    clean_flagged += 1
+                eng.update_features(TransactionEvent(
+                    account_id=a, amount=amt, tx_type=tx,
+                    timestamp=t_base + t_clean))
+        finally:
+            eng.close()
+        return flagged, escalated, total_warm, clean_flagged
+
+    seq_flagged, seq_escalated, seq_warm, seq_clean_fp = drive(True)
+    base_flagged, base_escalated, base_warm, _ = drive(False)
+    result["fraud_ring"] = {
+        "schedule": ring.schedule_block(ring_seed),
+        "sequence_path": {
+            "warm_decisions": seq_warm, "flagged": seq_flagged,
+            "escalated": seq_escalated,
+            "flag_rate": round(seq_flagged / max(1, seq_warm), 4),
+            "clean_false_positives": seq_clean_fp,
+        },
+        "aggregate_only_baseline": {
+            "warm_decisions": base_warm, "flagged": base_flagged,
+            "escalated": base_escalated,
+        },
+    }
+    gates["fraud_ring_flagged_by_sequence_path"] = (
+        seq_warm > 0 and seq_flagged / max(1, seq_warm) >= 0.9)
+    gates["fraud_ring_missed_by_aggregate_baseline"] = (
+        base_flagged == 0 and base_escalated == 0)
+    gates["clean_traffic_no_false_session_flags"] = seq_clean_fp == 0
+    print(json.dumps({"arm1_fraud_ring": result["fraud_ring"]}),
+          file=sys.stderr, flush=True)
+
+    # -- arm 2: production server — churn, SIGKILL, replay, A/B --------------
+    ledger_dir = tempfile.mkdtemp(prefix="soak-session-")
+    env_common = {
+        "WIRE_MODE": "index",
+        "FEATURE_CACHE_CAPACITY": os.environ.get(
+            "SESSION_SOAK_CACHE_CAPACITY", "256"),
+        "LEDGER_FSYNC_MS": "10",
+        "LEDGER_QUEUE_MAX_ROWS": "400000",
+        "ANOMALY_PROFILE": "0",
+    }
+    replica = ReplicaProc("sess-0", ml_backend="mock", batch_size=256,
+                          env_extra=dict(env_common, SESSION_STATE="1",
+                                         LEDGER_DIR=ledger_dir))
+    replica.spawn()
+
+    rows_per_rpc = 256
+    n_workers = 3
+    accounts_per_worker = int(os.environ.get(
+        "SESSION_SOAK_ACCOUNTS_PER_WORKER", "600"))
+    lock = threading.Lock()
+    sent_rows = [0]
+    rpc_errors = [0]
+    stop_flag = [False]
+
+    def _payloads(worker: int) -> list[bytes]:
+        # Disjoint per-worker account sets: same-account traffic is never
+        # in flight on two RPCs at once, so ledger order == session order
+        # (the reorder detector in replay stays at zero by construction).
+        rng = np.random.default_rng(900 + worker)
+        accts = [f"sw{worker}-{i}" for i in range(accounts_per_worker)]
+        out = []
+        for p in range(8):
+            ids = [accts[(p * rows_per_rpc + i) % accounts_per_worker]
+                   for i in range(rows_per_rpc)]
+            amounts = rng.integers(100, 60_000, rows_per_rpc).tolist()
+            types = [("deposit", "bet", "win", "withdraw")[int(c)]
+                     for c in rng.integers(0, 4, rows_per_rpc)]
+            out.append(encode_index_batch(ids, amounts, types))
+        return out
+
+    def bulk_worker(worker: int) -> None:
+        payloads = _payloads(worker)
+        ch = grpc.insecure_channel(
+            replica.addr, options=[("grpc.max_reconnect_backoff_ms", 1000)])
+        call = ch.unary_unary("/risk.v1.RiskService/ScoreBatch",
+                              request_serializer=lambda b: b,
+                              response_deserializer=lambda b: b)
+        i = 0
+        fail_streak = 0
+        while not stop_flag[0]:
+            try:
+                call(payloads[i % len(payloads)], timeout=30)
+                with lock:
+                    sent_rows[0] += rows_per_rpc
+                fail_streak = 0
+            except grpc.RpcError:
+                with lock:
+                    rpc_errors[0] += 1
+                fail_streak += 1
+                if fail_streak >= 8:
+                    # A SIGKILLed peer can wedge a grpc-python subchannel:
+                    # rebuild the channel after a failure streak
+                    # (REPLAY_r08 client-harness lesson).
+                    ch.close()
+                    ch = grpc.insecure_channel(
+                        replica.addr,
+                        options=[("grpc.max_reconnect_backoff_ms", 1000)])
+                    call = ch.unary_unary(
+                        "/risk.v1.RiskService/ScoreBatch",
+                        request_serializer=lambda b: b,
+                        response_deserializer=lambda b: b)
+                    fail_streak = 0
+                time.sleep(0.1)
+            i += 1
+        ch.close()
+
+    def _http_json(path: str):
+        with urllib.request.urlopen(
+                f"http://{replica.http_addr}{path}", timeout=5) as resp:
+            return json.loads(resp.read())
+
+    def _metric_value(text: str, name: str) -> float:
+        total = 0.0
+        for line in text.splitlines():
+            if line.startswith(name) and " " in line:
+                head, val = line.rsplit(" ", 1)
+                if head == name or head.startswith(name + "{"):
+                    try:
+                        total += float(val)
+                    except ValueError:
+                        pass
+        return total
+
+    def _metrics_text() -> str:
+        with urllib.request.urlopen(
+                f"http://{replica.http_addr}/metrics", timeout=5) as resp:
+            return resp.read().decode()
+
+    workers = [threading.Thread(target=bulk_worker, args=(w,))
+               for w in range(n_workers)]
+    for t in workers:
+        t.start()
+    t0 = time.perf_counter()
+    deadline = t0 + float(os.environ.get("SESSION_SOAK_MAX_S", "180"))
+    kill_done = False
+    sessionz_pre_kill = None
+    while time.perf_counter() < deadline:
+        with lock:
+            rows = sent_rows[0]
+        if not kill_done and rows >= target_rows // 2:
+            # SIGKILL mid-run: session index + HBM ring die with the
+            # process; the WAL and its torn tail survive.
+            try:
+                sessionz_pre_kill = _http_json("/debug/sessionz")
+            except Exception:  # noqa: BLE001 — polled measurement
+                pass
+            replica.kill()
+            kill_time = time.perf_counter() - t0
+            replica.restart()
+            kill_done = True
+            result["sigkill"] = {"at_s": round(kill_time, 2),
+                                 "rows_before_kill": rows}
+        if kill_done and rows >= target_rows:
+            break
+        time.sleep(0.25)
+    stop_flag[0] = True
+    for t in workers:
+        t.join()
+
+    sessionz = _http_json("/debug/sessionz")
+    metrics_text = _metrics_text()
+    evictions = _metric_value(metrics_text,
+                              "risk_feature_cache_evictions_total")
+    result["server_arm"] = {
+        "rows_sent": sent_rows[0],
+        "rpc_errors_during_chaos": rpc_errors[0],
+        "sessionz_pre_kill": sessionz_pre_kill,
+        "sessionz_final": sessionz,
+        "feature_cache_evictions_post_restart": evictions,
+    }
+    gates["eviction_under_load"] = bool(
+        evictions > 0 and sessionz["rehydrations"] > 0)
+
+    replica.terminate()
+
+    # Dispatch-count + throughput A/B on the PRODUCTION backend
+    # (multitask — what fleet replicas serve), steady-state account set
+    # (fits the cache: rehydration churn is the scale arm's job, not the
+    # overhead meter's). `replica` is rebound per arm so the probes
+    # below target the right process.
+    def _steady_payloads() -> list[bytes]:
+        rng = np.random.default_rng(1234)
+        n_acct = 200  # < FEATURE_CACHE_CAPACITY: no eviction in the loop
+        accts = [f"ab-{i}" for i in range(n_acct)]
+        out = []
+        for p in range(8):
+            ids = [accts[(p * rows_per_rpc + i) % n_acct]
+                   for i in range(rows_per_rpc)]
+            amounts = rng.integers(100, 60_000, rows_per_rpc).tolist()
+            types = [("deposit", "bet", "win", "withdraw")[int(c)]
+                     for c in rng.integers(0, 4, rows_per_rpc)]
+            out.append(encode_index_batch(ids, amounts, types))
+        return out
+
+    def _dispatch_probe(payloads, n_rpcs: int = 50) -> float:
+        before = _metric_value(_metrics_text(),
+                               "risk_device_dispatches_total")
+        ch = grpc.insecure_channel(replica.addr)
+        call = ch.unary_unary("/risk.v1.RiskService/ScoreBatch",
+                              request_serializer=lambda b: b,
+                              response_deserializer=lambda b: b)
+        for i in range(n_rpcs):
+            call(payloads[i % len(payloads)], timeout=30)
+        ch.close()
+        after = _metric_value(_metrics_text(),
+                              "risk_device_dispatches_total")
+        return (after - before) / n_rpcs
+
+    def _flatout(payloads, seconds: float) -> float:
+        ch = grpc.insecure_channel(replica.addr)
+        call = ch.unary_unary("/risk.v1.RiskService/ScoreBatch",
+                              request_serializer=lambda b: b,
+                              response_deserializer=lambda b: b)
+        end = time.perf_counter() + seconds
+        done = 0
+        while time.perf_counter() < end:
+            call(payloads[done % len(payloads)], timeout=30)
+            done += 1
+        ch.close()
+        return done * rows_per_rpc / seconds
+
+    ab: dict = {}
+    for label, extra in (("on", {"SESSION_STATE": "1"}), ("off", {})):
+        rp = ReplicaProc(f"sess-ab-{label}", ml_backend="multitask",
+                         batch_size=256,
+                         env_extra=dict(env_common, **extra))
+        rp.spawn()
+        replica = rp
+        payloads = _steady_payloads()
+        # The dispatch probe doubles as cache/session warmup: admissions
+        # ride the lookup scatter, never the counted dispatch.
+        disp = _dispatch_probe(payloads)
+        rate = _flatout(payloads, ab_s)
+        rp.terminate()
+        ab[label] = {"dispatches_per_rpc": disp, "rows_per_s": rate}
+
+    dispatches_on = ab["on"]["dispatches_per_rpc"]
+    dispatches_off = ab["off"]["dispatches_per_rpc"]
+    ab_ratio = ab["on"]["rows_per_s"] / max(1.0, ab["off"]["rows_per_s"])
+    result["dispatch_probe"] = {
+        "per_rpc_session_on": round(dispatches_on, 4),
+        "per_rpc_session_off": round(dispatches_off, 4),
+    }
+    result["session_ab"] = {
+        "backend": "multitask",
+        "rows_per_s_session_on": round(ab["on"]["rows_per_s"], 1),
+        "rows_per_s_session_off": round(ab["off"]["rows_per_s"], 1),
+        "overhead_ratio": round(ab_ratio, 4),
+        "bar": ab_bar,
+        "seconds_per_arm": ab_s,
+        "note": "1-core control rig: the session plane's per-row host "
+                "bookkeeping (~3 us/row) cannot overlap the device step "
+                "here (CPU jit runs on the calling thread); on a real "
+                "accelerator the async dispatch hides it "
+                "(docs/performance.md 'Session state')",
+    }
+    gates["dispatches_per_rpc_unchanged"] = (
+        abs(dispatches_on - dispatches_off) < 1e-6)
+    gates["session_ab_within_noise"] = ab_ratio >= ab_bar
+
+    # -- replay: every session_state_hash bit-exact across the chaos ---------
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools.replay import replay_directory
+
+    verdict = replay_directory(ledger_dir, batch=256)
+    result["replay"] = {k: verdict[k] for k in (
+        "records_total", "session_records", "session_verified",
+        "session_hash_mismatch", "session_chain_gaps", "session_resets",
+        "session_reordered", "session_ok", "ok")}
+    gates["replay_bit_exact_at_scale"] = bool(
+        verdict["session_verified"] >= min(target_rows, sent_rows[0])
+        and verdict["session_hash_mismatch"] == 0
+        and verdict["session_chain_gaps"] == 0
+        and verdict["session_reordered"] == 0
+        and verdict["ok"])
+    gates["sigkill_visible_as_session_reset"] = (
+        kill_done and verdict["session_resets"] > 0)
+
+    result["gates"] = gates
+    out_path = os.environ.get("SESSION_OUT", "SESSION_r13.json")
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    print(json.dumps({"gates": gates}), file=sys.stderr, flush=True)
+    if not all(gates.values()):
+        sys.exit(1)
+
+
 if __name__ == "__main__":
     if "--deadline" in sys.argv or os.environ.get("SOAK_DEADLINE") == "1":
         # The deadline soak provisions its own replica processes (CPU
         # control rig).
         main_deadline()
+    elif "--session-chaos" in sys.argv or os.environ.get(
+            "SOAK_SESSION_CHAOS") == "1":
+        # The session soak provisions its own replica processes (CPU
+        # control rig).
+        main_session_chaos()
     elif "--drift-chaos" in sys.argv or os.environ.get("SOAK_DRIFT_CHAOS") == "1":
         # The drift soak provisions its own replica processes (CPU
         # control rig).

@@ -26,6 +26,13 @@ class ReasonCode(str, enum.Enum):
     ML_HIGH_RISK = "ML_HIGH_RISK"
     MULTI_ACCOUNT = "MULTI_ACCOUNT"
     DEVICE_FINGERPRINT_MISMATCH = "DEVICE_FINGERPRINT_MISMATCH"
+    # Stateful sequence scoring (serve/session_state.py): the session head
+    # over the account's device-resident event window flagged a coordinated
+    # pattern (SESSION_PATTERN), or the row was scored while the account's
+    # session window was still cold — too few events for the sequence head
+    # to speak (SESSION_COLD; the honest stateless-fallback marker).
+    SESSION_PATTERN = "SESSION_PATTERN"
+    SESSION_COLD = "SESSION_COLD"
     # Not part of the in-graph reason bitmask (REASON_BIT_ORDER): appended
     # host-side by the supervisor's CPU heuristic tier so degraded-mode
     # responses are wire-compatible yet visibly flagged.
@@ -35,6 +42,9 @@ class ReasonCode(str, enum.Enum):
 # Bit positions used for the in-graph reason bitmask. Order matches the
 # reference's rule application order (engine.go:420-483) with ML_HIGH_RISK
 # appended last (engine.go:285-287), so decoded reason lists compare equal.
+# The two SESSION_* bits are APPENDED (never reordered): a mask written
+# before they existed decodes to the same reason list, so ledger records
+# and wire responses stay backward-compatible.
 REASON_BIT_ORDER: tuple[ReasonCode, ...] = (
     ReasonCode.HIGH_VELOCITY,
     ReasonCode.NEW_ACCOUNT_LARGE_TX,
@@ -45,7 +55,14 @@ REASON_BIT_ORDER: tuple[ReasonCode, ...] = (
     ReasonCode.BONUS_ABUSE,
     ReasonCode.KNOWN_FRAUDSTER,
     ReasonCode.ML_HIGH_RISK,
+    ReasonCode.SESSION_PATTERN,
+    ReasonCode.SESSION_COLD,
 )
+
+# Bit indices of the session head's reason bits (serve/session_state.py
+# sets them inside the fused scoring graph).
+SESSION_PATTERN_BIT = REASON_BIT_ORDER.index(ReasonCode.SESSION_PATTERN)
+SESSION_COLD_BIT = REASON_BIT_ORDER.index(ReasonCode.SESSION_COLD)
 
 
 def decode_reason_mask(mask: int) -> list[ReasonCode]:

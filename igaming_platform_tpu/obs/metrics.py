@@ -709,6 +709,37 @@ class ServiceMetrics:
             "Drift alert RAISE transitions by {kind} — one per "
             "incident, not one per drifted batch",
         )
+        # Stateful sequence scoring (serve/session_state.py): the
+        # per-account session ring beside the feature cache, its fused
+        # session head, and the honest cold/bypass accounting.
+        self.session_rows_total = self.registry.counter(
+            f"{service}_session_rows_total",
+            "Rows scored while session state is enabled by {outcome}: "
+            "warm = the post-append window reached SESSION_MIN_EVENTS "
+            "and the session head spoke, cold = window still too short "
+            "(SESSION_COLD reason bit set — the honest stateless "
+            "fallback), bypass = scored on a non-session path (row wire "
+            "mode, batcher, heuristic tier) so the window did not "
+            "advance",
+        )
+        self.session_appends_total = self.registry.counter(
+            f"{service}_session_appends_total",
+            "Events appended to per-account session windows by the fused "
+            "scoring step's donated in-place ring scatter (one per "
+            "session-scored row)",
+        )
+        self.session_rehydrations_total = self.registry.counter(
+            f"{service}_session_rehydrations_total",
+            "Session windows restored into HBM from the host session "
+            "index on feature-cache admission — an evicted account that "
+            "returns gets its window back, never a silent cold start",
+        )
+        self.session_hbm_bytes = self.registry.gauge(
+            f"{service}_session_hbm_bytes",
+            "Device bytes held by the session ring (ring + cursors + "
+            "lengths) — budget it against the feature table "
+            "(docs/operations.md 'Session state')",
+        )
         self.spans_dropped_total = self.registry.counter(
             f"{service}_spans_dropped_total",
             "Host spans evicted from the bounded span ring before export "

@@ -83,6 +83,11 @@ class DeviceFeatureCache:
         self._hand = 0
         self._free = self.capacity  # slots never yet assigned
         self._dirty: set[str] = set()
+        # Session-state admission hook (serve/session_state.py): called
+        # under the lock with (account_ids, slots) for every slot THIS
+        # lookup admitted, so the per-account session ring shares this
+        # cache's admission/eviction decision — one CLOCK, two tables.
+        self.session_hook = None
 
         # Counters (exported via bind_metrics / stats()).
         self.hits = 0
@@ -227,6 +232,7 @@ class DeviceFeatureCache:
             hits = misses = 0
             evicts_before = self.evictions
             refresh: dict[str, int] = {}
+            admitted: dict[str, int] = {}
             stale_cut = None if self.max_age_s is None else now - self.max_age_s
             for i, raw in enumerate(account_ids):
                 a = raw if isinstance(raw, str) else bytes(raw).decode()
@@ -236,6 +242,7 @@ class DeviceFeatureCache:
                     self._slots[a] = slot
                     self._slot_keys[slot] = a
                     refresh[a] = slot
+                    admitted[a] = slot
                     misses += 1
                 elif a in self._dirty or (
                     stale_cut is not None and self._row_ts[slot] < stale_cut
@@ -265,6 +272,12 @@ class DeviceFeatureCache:
                     self.table, jnp.asarray(slots), jnp.asarray(rows))
                 self._row_ts[slots] = now
                 self.deltas_applied += deltas
+            if admitted and self.session_hook is not None:
+                # Same admission, second table: the session ring syncs
+                # (rehydrates) the freshly admitted slots in this same
+                # between-steps window — an evicted slot that comes back
+                # gets its window back before the next fused step reads it.
+                self.session_hook(list(admitted), list(admitted.values()))
             self.hits += hits
             self.misses += misses
             self._export_metrics(

@@ -432,6 +432,11 @@ class RiskGrpcService:
             # Host-pipeline gauges (inflight depth, overlap ratio) —
             # bound now or at the pipeline's lazy build, same pattern.
             engine.bind_pipeline_metrics(self.metrics)
+        if hasattr(engine, "bind_session_metrics"):
+            # Session-state plane (serve/session_state.py): warm/cold/
+            # bypass rows, ring appends, rehydrations, HBM budget —
+            # bound now or when ensure_cache builds the session plane.
+            engine.bind_session_metrics(self.metrics)
         if hasattr(engine, "bind_supervisor_metrics"):
             # Self-healing supervisor (serve/supervisor.py): serving
             # state, breaker states, degraded/watchdog/rebuild counters.

@@ -81,6 +81,13 @@ _V1_HEAD = struct.Struct("<BBBBBHHiiIIqd8s8s")
 _FLAG_FEATURES = 1
 _FLAG_BLACKLISTED = 2
 _FLAG_DEGRADED = 4
+# Stateful sequence scoring (serve/session_state.py): the record carries
+# the account's post-append session-window length, the per-account event
+# sequence number and the blake2b-8 session_state_hash. Guarded by a
+# flag (not a schema bump) so pre-session v1 records stay byte-identical
+# — the golden test pins that.
+_FLAG_SESSION = 8
+_SESSION_TAIL = struct.Struct("<HI8s")  # window length, event seq, hash
 
 TIER_CODES = {"device": 0, "host": 1, "heuristic": 2}
 TIER_NAMES = {v: k for k, v in TIER_CODES.items()}
@@ -193,6 +200,13 @@ class DecisionRecord:
     ts_unix: float
     blacklisted: bool
     features: np.ndarray | None  # [NUM_FEATURES] float32 snapshot, or None
+    # Stateful decisions only (index-mode rows scored through the fused
+    # session step): post-append window length, per-account monotone
+    # event sequence number, and the session_state_hash (blake2b-8 over
+    # the post-append window, hex). Empty hash == stateless decision.
+    session_len: int = 0
+    session_seq: int = 0
+    session_hash: str = ""
 
     @property
     def ml_score(self) -> float:
@@ -244,6 +258,8 @@ def encode_record(r: DecisionRecord) -> bytes:
         flags |= _FLAG_BLACKLISTED
     if r.tier == "heuristic":
         flags |= _FLAG_DEGRADED
+    if r.session_hash:
+        flags |= _FLAG_SESSION
     head = _V1_HEAD.pack(
         flags,
         r.action & 0xFF,
@@ -268,6 +284,10 @@ def encode_record(r: DecisionRecord) -> bytes:
     if feats is not None:
         parts.append(struct.pack("<H", feats.shape[0]))
         parts.append(feats.tobytes())
+    if r.session_hash:
+        parts.append(_SESSION_TAIL.pack(
+            r.session_len & 0xFFFF, r.session_seq & 0xFFFFFFFF,
+            bytes.fromhex(r.session_hash)))
     return b"".join(parts)
 
 
@@ -310,6 +330,14 @@ def decode_record(payload: bytes) -> DecisionRecord:
             raise LedgerSchemaError("record truncated (features)")
         features = np.frombuffer(buf[pos:end], dtype=np.float32).copy()
         pos = end
+    session_len = session_seq = 0
+    session_hash = ""
+    if flags & _FLAG_SESSION:
+        if pos + _SESSION_TAIL.size > len(buf):
+            raise LedgerSchemaError("record truncated (session tail)")
+        session_len, session_seq, shash = _SESSION_TAIL.unpack_from(buf, pos)
+        session_hash = shash.hex()
+        pos += _SESSION_TAIL.size
     rec = DecisionRecord(
         decision_id=decision_id, account_id=account_id, trace_id=trace_id,
         model_version=model_version, params_fp=pfp.hex(),
@@ -322,6 +350,8 @@ def decode_record(payload: bytes) -> DecisionRecord:
         block_threshold=block_thr, review_threshold=review_thr,
         ts_unix=ts, blacklisted=bool(flags & _FLAG_BLACKLISTED),
         features=features,
+        session_len=int(session_len), session_seq=int(session_seq),
+        session_hash=session_hash,
     )
     if fhash.hex() != rec.feature_hash:
         raise LedgerSchemaError(
@@ -589,6 +619,12 @@ class _PendingBatch:
     block_threshold: int
     review_threshold: int
     trace_id: str
+    # Stateful (session-scored) batches: per-row post-append window
+    # lengths, event sequence numbers, raw 8-byte session hashes. None
+    # for stateless batches — the records then omit the session tail.
+    session_lens: Any = None
+    session_seqs: Any = None
+    session_hashes: Any = None
 
     def to_records(self) -> list[DecisionRecord]:
         recs: list[DecisionRecord] = []
@@ -631,6 +667,12 @@ class _PendingBatch:
                 ts_unix=self.ts,
                 blacklisted=bl_i,
                 features=feats,
+                session_len=(int(self.session_lens[i])
+                             if self.session_lens is not None else 0),
+                session_seq=(int(self.session_seqs[i])
+                             if self.session_seqs is not None else 0),
+                session_hash=(self.session_hashes[i].hex()
+                              if self.session_hashes is not None else ""),
             ))
         return recs
 
@@ -1450,6 +1492,10 @@ def note_decisions(
     model_version: str | None = None,
     params_fp: str | None = None,
     mark_root: bool = True,
+    ts: float | None = None,
+    session_lens=None,
+    session_seqs=None,
+    session_hashes=None,
 ) -> str | None:
     """THE DecisionRecord construction seam: every scoring path — device
     batch, host tier, index mode, and the supervisor's heuristic
@@ -1482,9 +1528,13 @@ def note_decisions(
             tier_codes = _tier_codes_for(engine, n)
         else:
             tier_codes = np.full((n,), TIER_CODES.get(tier, 0), np.uint8)
+        # Stateful (session-scored) chunks pass the SAME timestamp the
+        # session plane used for the inter-event gap, so replay can
+        # re-derive every dt from recorded values alone; stateless paths
+        # stamp the note time as before.
         batch = _PendingBatch(
             prefix=prefix,
-            ts=wall_clock(),
+            ts=ts if ts is not None else wall_clock(),
             n=n,
             score=out["score"],
             action=out["action"],
@@ -1507,6 +1557,8 @@ def note_decisions(
                                            "0" * 16),
             block_threshold=block_thr, review_threshold=review_thr,
             trace_id=trace_id,
+            session_lens=session_lens, session_seqs=session_seqs,
+            session_hashes=session_hashes,
         )
         ledger.append_columns(batch)
         if mark_root and span is not None:

@@ -165,6 +165,7 @@ class TPUScoringEngine:
         feature_store: InMemoryFeatureStore | None = None,
         warmup: bool = True,
         feature_cache: bool | int | None = None,
+        session_state: bool | None = None,
     ):
         self.config = config or ScoringConfig()
         self.ml_backend = ml_backend
@@ -252,6 +253,9 @@ class TPUScoringEngine:
                 "(use 'bf16', 'int8' or 'float32')")
 
         fn_f32 = make_score_fn(self.config, ml_backend, mesh=mesh)
+        # Raw dict-output graph, kept for the fused session step
+        # (serve/session_state.py composes the session head around it).
+        self._score_fn_f32 = fn_f32
         fn = fn_f32
         if self._wire_dtype is np.int8:
             from igaming_platform_tpu.ops.quantize import wire_dequantize_int8
@@ -383,6 +387,21 @@ class TPUScoringEngine:
         if self.wire_mode not in ("row", "index"):
             raise ValueError(
                 f"WIRE_MODE={self.wire_mode!r} not supported (use 'row' or 'index')")
+
+        # Stateful sequence scoring (serve/session_state.py, SESSION_STATE=1
+        # or session_state=True): a per-account event ring in HBM beside
+        # the feature table, scored by a session head FUSED into the
+        # cached step (one dispatch, ring appended via donated buffers).
+        # Built with the cache (ensure_cache) — the tables share one
+        # host index and one CLOCK admission decision.
+        from igaming_platform_tpu.serve import session_state as session_mod
+
+        self.session = None
+        self._session_fn = None
+        self._session_metrics_sink = None
+        self._session_enabled = (
+            session_mod.session_enabled_env() if session_state is None
+            else bool(session_state))
 
         # Pipelined host engine (serve/pipeline_engine.py): stage workers
         # overlap gather/pad, device dispatch and readback/encode across
@@ -587,6 +606,7 @@ class TPUScoringEngine:
         self.lane_gate.acquire(LANE_BULK)
         params = snap[1] if use_host else snap[0]
         thresholds = self._thresholds_host if use_host else self._thresholds
+        self._note_session_bypass(n_valid)
         if use_host:
             _device_dispatch("packed_step_host", xp.shape, xp.dtype)
             out, echo = self._fn_host(params, xp, blp, thresholds)
@@ -702,6 +722,22 @@ class TPUScoringEngine:
         if self.cache is not None:
             self.cache.bind_metrics(metrics)
 
+    def bind_session_metrics(self, metrics) -> None:
+        """Route session-plane counters (warm/cold/bypass rows, appends,
+        rehydrations, HBM bytes) into a ServiceMetrics registry — applied
+        now if the session plane is built, at ensure_cache otherwise."""
+        self._session_metrics_sink = metrics
+        if self.session is not None:
+            self.session.bind_metrics(metrics)
+
+    def _note_session_bypass(self, n: int) -> None:
+        """A row scored on a non-session path (row wire mode / batcher /
+        host tier) while session state is enabled: counted as bypass in
+        risk_session_rows_total — the window for that account simply does
+        not advance, and that fact is visible, never silent."""
+        if self.session is not None and n > 0:
+            self.session.note_bypass(n)
+
     def ensure_cache(self):
         """Build (once) the HBM feature table + the jitted cached score
         step, and AOT-warm every ladder shape — called lazily on the
@@ -770,19 +806,88 @@ class TPUScoringEngine:
                     bl, self._thresholds)
                 jax.device_get(out)
             self.cache = cache
+            self._ensure_session(cache)
         if self.drift is not None:
             # A drift engine bound before the cache existed: compile +
             # warm the index-mode sketch now, off the live request path.
             self._ensure_drift_cached_fn()
         return cache
 
+    def _ensure_session(self, cache) -> None:
+        """Build (once) the session plane beside a freshly built cache:
+        the HBM event ring + host index (serve/session_state.py), the
+        FUSED session scoring step (feature gather + ensemble + session
+        head + donated in-place append — still ONE dispatch per chunk),
+        AOT-warmed at every ladder shape, and the cache admission hook
+        that keeps both tables under one CLOCK decision. Caller holds
+        ``_cache_lock``."""
+        if not self._session_enabled or self.session is not None:
+            return
+        from igaming_platform_tpu.serve import session_state as session_mod
+
+        mgr = session_mod.SessionStateManager(
+            cache.capacity, mesh=self._mesh,
+            metrics=self._session_metrics_sink)
+        step = session_mod.make_session_step(
+            self._score_fn_f32, self.config, mgr.head_fn,
+            capacity=cache.capacity, n_events=mgr.n_events,
+            min_events=mgr.min_events, flag_threshold=mgr.flag_threshold)
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            repl = NamedSharding(self._mesh, P())
+            vec = NamedSharding(self._mesh, P(AXIS_DATA))
+            row = NamedSharding(self._mesh, P(AXIS_DATA, None))
+            self._session_fn = jax.jit(
+                step,
+                in_shardings=(None, None, repl, repl, repl, repl, repl,
+                              vec, vec, vec, vec, vec, row, vec, repl),
+                out_shardings=(NamedSharding(self._mesh, P(None, AXIS_DATA)),
+                               repl, repl, repl),
+                donate_argnums=(4, 5, 6),
+            )
+        else:
+            self._session_fn = jax.jit(step, donate_argnums=(4, 5, 6))
+        # AOT-warm every ladder shape. Warm rows target the scratch slot
+        # (sidx=capacity), so no real account's window moves; the step
+        # leaves the scratch counters zeroed.
+        with mgr.lock:
+            for shape in self._shapes:
+                idxs = np.zeros((shape,), dtype=np.int32)
+                sidx = np.full((shape,), cache.capacity, dtype=np.int32)
+                occ = np.arange(shape, dtype=np.int32)
+                amounts = np.zeros((shape,), dtype=np.float32)
+                types = np.full((shape,), 4, dtype=np.int32)
+                events = np.zeros((shape, session_mod.EVENT_WIDTH),
+                                  dtype=np.float32)
+                bl = np.zeros((shape,), dtype=bool)
+                with self._params_lock:
+                    params = self._params
+                out, ring2, cur2, len2 = self._session_fn(
+                    params, mgr.head_params, cache.table, cache.flags,
+                    mgr.session_ring, mgr.session_cursor,
+                    mgr.session_length, idxs, sidx, occ, amounts, types,
+                    events, bl, self._thresholds)
+                jax.device_get(out)
+                mgr.adopt(ring2, cur2, len2)
+        cache.session_hook = mgr.on_admit
+        self.session = mgr
+
     def _launch_cached(self, idxs: np.ndarray, amounts: np.ndarray,
                        types: np.ndarray, bl: np.ndarray,
-                       snap: tuple | None = None):
+                       snap: tuple | None = None,
+                       account_ids=None, now: float | None = None):
         """Dispatch the cached score step: the device gathers rows from
         the HBM-resident table; only int32 indices + per-txn context
         cross the link. Pad rows index slot 0 — scored and discarded,
-        same as zero-row padding on the full-row path."""
+        same as zero-row padding on the full-row path.
+
+        With session state enabled (and ``account_ids`` provided) the
+        FUSED session step runs instead: same dispatch count, plus the
+        ring-window gather + session head + donated in-place append.
+        Returns (packed out, n, session_meta) where ``session_meta``
+        carries the per-row post-append lengths, sequence numbers and
+        session hashes for the ledger (None on the plain path)."""
         n = idxs.shape[0]
         shape = self._pick_shape(n)
         idxsp, _ = pad_batch(idxs, shape)
@@ -792,6 +897,39 @@ class TPUScoringEngine:
         if snap is None:
             snap = self.params_snapshot()
         params = snap[0]
+        mgr = self.session
+        if mgr is not None and account_ids is not None:
+            # Host-index commit + device dispatch under the session lock:
+            # device append order must match host (and therefore ledger /
+            # replay) order, and the donated ring buffers are rebound
+            # before anyone else can dispatch against them.
+            with mgr.lock:
+                ts = now if now is not None else ledger_mod.wall_clock()
+                events, occ, post_len, seqs, audit = mgr.prepare_chunk(
+                    account_ids, amounts, types, ts)
+                evp, _ = pad_batch(events, shape)
+                occp, _ = pad_batch(occ, shape)
+                # Fresh per-chunk buffer by design: jax may alias host
+                # memory zero-copy on the CPU backend, so a pooled
+                # buffer could be read by an in-flight dispatch.
+                sidxp = np.full((shape,), mgr.capacity, dtype=np.int32)  # noqa: MX04 — scratch-slot pad template must be fresh per dispatch (zero-copy aliasing)
+                sidxp[:n] = idxs
+                if n < shape:
+                    # Pad rows all target the scratch slot: distinct
+                    # occurrence ranks keep their appends off each other.
+                    occp[n:] = np.arange(shape - n, dtype=np.int32)
+                _device_dispatch("session_step", idxsp.shape, idxsp.dtype)
+                out, ring2, cur2, len2 = self._session_fn(
+                    params, mgr.head_params, self.cache.table,
+                    self.cache.flags, mgr.session_ring, mgr.session_cursor,
+                    mgr.session_length, idxsp, sidxp, occp, amtp, typp,
+                    evp, blp, self._thresholds)
+                mgr.adopt(ring2, cur2, len2)
+            self._note_drift_cached(idxsp, amtp, typp, out, n)
+            if hasattr(out, "copy_to_host_async"):
+                out.copy_to_host_async()
+            return out, n, {"ts": ts, "lens": post_len, "seqs": seqs,
+                            "hashes": audit}
         _device_dispatch("cached_step", idxsp.shape, idxsp.dtype)
         out = self._cached_fn(
             params, self.cache.table, self.cache.flags,
@@ -802,7 +940,7 @@ class TPUScoringEngine:
         self._note_drift_cached(idxsp, amtp, typp, out, n)
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
-        return out, n
+        return out, n, None
 
     def _blacklist_flags(self, n: int, ips, devices, fingerprints) -> np.ndarray:
         """Per-request blacklist vector from the host sets — the cheap
@@ -842,14 +980,29 @@ class TPUScoringEngine:
         rtms = np.empty((total,), dtype=np.int64)
         inflight: deque = deque()
         snap = self.params_snapshot()
+        session_on = self.session is not None
 
         def read_one() -> None:
-            out, lo, n = inflight.popleft()
+            out, lo, n, smeta = inflight.popleft()
             with span("score.readback", batch=n):
                 host = _unpack_host(_device_readback(out))
             for k in keys:
                 parts[k].append(host[k][:n])
             rtms[lo:lo + n] = int((time.monotonic() - start) * 1000.0)
+            if smeta is not None:
+                # Stateful decisions ledger PER CHUNK: one note batch ==
+                # one device dispatch == one batch-snapshot append unit,
+                # so tools/replay.py can reconstruct every row's window
+                # (including duplicate accounts within the chunk) from
+                # ledger order + the recorded session fields.
+                chunk = {k: host[k][:n] for k in keys}
+                ledger_mod.note_decisions(
+                    self, chunk, n=n, wire_mode="index", tier="device",
+                    bl=bl[lo:lo + n], account_ids=account_ids[lo:lo + n],
+                    amounts=amounts32[lo:lo + n], tx_codes=types32[lo:lo + n],
+                    params_fp=snap[2], ts=smeta["ts"],
+                    session_lens=smeta["lens"], session_seqs=smeta["seqs"],
+                    session_hashes=smeta["hashes"], mark_root=(lo == 0))
 
         for lo in range(0, total, self.batch_size):
             hi = min(lo + self.batch_size, total)
@@ -857,9 +1010,11 @@ class TPUScoringEngine:
                 idxs = self.cache.lookup(account_ids[lo:hi], now=now)
             self.lane_gate.acquire(LANE_BULK)
             with span("score.dispatch", batch=hi - lo), annotate("score_step"):
-                out, n = self._launch_cached(
-                    idxs, amounts32[lo:hi], types32[lo:hi], bl[lo:hi], snap)
-            inflight.append((out, lo, n))
+                out, n, smeta = self._launch_cached(
+                    idxs, amounts32[lo:hi], types32[lo:hi], bl[lo:hi], snap,
+                    account_ids=account_ids[lo:hi] if session_on else None,
+                    now=now)
+            inflight.append((out, lo, n, smeta))
             if len(inflight) > self._pipeline_depth:
                 read_one()
         while inflight:
@@ -874,10 +1029,13 @@ class TPUScoringEngine:
         # Ledger seam (index mode): the feature rows live in HBM and never
         # materialize on the host, so records carry the per-txn context +
         # outputs without a snapshot (replay marks them unreplayable).
-        ledger_mod.note_decisions(
-            self, cat, n=total, wire_mode="index", tier="device",
-            bl=bl, account_ids=account_ids, amounts=amounts32,
-            tx_codes=types32, params_fp=snap[2])
+        # With session state on, the per-chunk notes above already carried
+        # every row (plus its session fields) — no second note here.
+        if not session_on:
+            ledger_mod.note_decisions(
+                self, cat, n=total, wire_mode="index", tier="device",
+                bl=bl, account_ids=account_ids, amounts=amounts32,
+                tx_codes=types32, params_fp=snap[2])
         return cat, rtms
 
     def score_columns_cached(
@@ -985,6 +1143,7 @@ class TPUScoringEngine:
         link round-trip at all."""
         n = x.shape[0]
         shape = self._pick_shape(n)
+        self._note_session_bypass(n)
         use_host = self._fn_host is not None and n <= self._host_tier
         if not use_host and self._wire_encode is not None:
             # Encode BEFORE padding: pad_batch preserves dtype, so the

@@ -554,6 +554,18 @@ class RiskServer:
                         self._send(404, '{"error":"drift observatory disabled"}')
                         return
                     self._send(200, json.dumps(drift_engine.snapshot()))
+                elif self.path == "/debug/sessionz":
+                    # Stateful sequence scoring: session-ring occupancy,
+                    # warm/cold/bypass row accounting, HBM budget and
+                    # head config (runbook: docs/operations.md
+                    # "Session state").
+                    inner = getattr(server_ref.engine, "inner",
+                                    server_ref.engine)
+                    sess = getattr(inner, "session", None)
+                    if sess is None:
+                        self._send(404, '{"error":"session state disabled"}')
+                        return
+                    self._send(200, json.dumps(sess.snapshot()))
                 elif self.path == "/debug/telemetryz":
                     # Device-runtime telemetry: compile events, dispatch
                     # counts, step-time EWMAs, anomaly + auto-profile log.

@@ -1,0 +1,697 @@
+"""Device-resident per-account session state — the stateful sequence head.
+
+ROADMAP open item 3: velocity and session-pattern fraud (rapid
+bet-deposit cycling, coordinated multi-account rings) needs the last-N
+events per account *at score time*; aggregate features can't see a
+pattern whose every individual window statistic looks benign. This
+module keeps a KV-cache-style per-account ring buffer in HBM **beside
+the PR 1 device feature cache** (the SNIPPETS mesh helpers come from a
+KV-cache serving codebase — same shape, same discipline):
+
+- ``session_ring``  [capacity+1, N_EVENTS, EVENT_WIDTH] float32 — per-slot
+  event windows, slot-aligned with the feature table (row ``capacity`` is
+  the scratch slot batch padding writes into, never read for a decision);
+- ``session_cursor`` / ``session_length`` [capacity+1] int32 — per-slot
+  write cursor and saturating event count.
+
+The tables share the feature cache's host ``account_id -> slot`` index
+and CLOCK eviction: ONE admission decision governs both. On admission
+the cache calls :meth:`SessionStateManager.on_admit` and the slot is
+synced (rehydrated) from the host-side session index in the same
+between-steps scatter window as the feature delta fold — an evicted or
+restarted slot rehydrates without any new wire surface.
+
+The scoring step itself is FUSED (serve/scorer.py builds it via
+:func:`make_session_step`): the same dispatch that gathers feature rows
+gathers each account's ring window, runs the session head over the
+POST-APPEND window (history + the event being scored), folds the result
+into the ensemble, and appends the event in place through donated ring
+buffers — zero extra device dispatches per RPC, zero added host syncs.
+
+Auditability ("Rethinking LLMOps for Fraud and AML", PAPERS.md): every
+stateful decision carries a ``session_state_hash`` — blake2b over the
+account's post-append window, computed from the HOST session index under
+the append lock — into its DecisionRecord, plus the post-append window
+length. ``tools/replay.py`` reconstructs the windows from ledger event
+order alone (amount, tx type, record timestamp) and verifies every hash
+bit-exact; the recorded length makes replay self-synchronizing across
+eviction (state persists -> length continues) and SIGKILL (host index
+lost -> length drops to 1 -> replay truncates its twin).
+
+Mutation discipline: in-place writes to the ring state
+(``session_ring`` / ``session_cursor`` / ``session_length`` and the host
+``_session_twin``) are only legal inside functions marked
+``# analysis: session-append-seam`` — analyzer rule CC08 enforces it,
+because a bare rebind skips the host-index commit and the ledger hash,
+silently breaking replay for every later decision on that slot.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from typing import Any
+
+import numpy as np
+
+from igaming_platform_tpu.core.enums import SESSION_COLD_BIT, SESSION_PATTERN_BIT
+from igaming_platform_tpu.models.sequence import EVENT_DIM, SeqConfig
+
+# Per-event layout: models/sequence.encode_event — [log-amount, log-dt,
+# 8-way tx-type one-hot, game-weight, balance-ratio].
+EVENT_WIDTH = EVENT_DIM
+
+# Wire tx-type codes (serve/wire.TX_TYPE_CODES: deposit=0 withdraw=1
+# bet=2 win=3 other=4) -> one-hot column inside the event vector. The
+# first four match models/sequence.TX_TYPE_INDEX; "other" lands on the
+# adjustment column (index 7), same as encode_event's fallback.
+_TX_EVENT_COL = np.array([0, 1, 2, 3, 7], dtype=np.int64)
+
+# One-hot sub-columns of the event vector the pattern head reads.
+_COL_DEPOSIT = 2 + 0
+_COL_BET = 2 + 2
+
+
+def default_events() -> int:
+    return int(os.environ.get("SESSION_EVENTS", "16"))
+
+
+def default_min_events() -> int:
+    return int(os.environ.get("SESSION_MIN_EVENTS", "4"))
+
+
+def default_flag_threshold() -> float:
+    return float(os.environ.get("SESSION_FLAG_THRESHOLD", "0.7"))
+
+
+def session_enabled_env() -> bool:
+    return os.environ.get("SESSION_STATE", "") not in ("", "0")
+
+
+# ---------------------------------------------------------------------------
+# Event encoding + window hash (host side — shared verbatim by replay)
+
+
+def encode_events_host(amounts, tx_codes, dts) -> np.ndarray:
+    """[B] amounts / wire tx codes / inter-event gaps -> [B, EVENT_WIDTH]
+    float32 event rows. This is THE event codec: the serving path scatters
+    these exact bytes into HBM, the session hash covers them, and
+    tools/replay.py re-derives them from recorded values — float64
+    arithmetic up to the final float32 cast so both sides agree bitwise.
+    """
+    b = len(amounts)
+    ev = np.zeros((b, EVENT_WIDTH), dtype=np.float32)
+    ev[:, 0] = np.log1p(np.maximum(np.asarray(amounts, np.float64), 0.0))
+    ev[:, 1] = np.log1p(np.maximum(np.asarray(dts, np.float64), 0.0))
+    codes = np.clip(np.asarray(tx_codes, np.int64), 0, len(_TX_EVENT_COL) - 1)
+    ev[np.arange(b), 2 + _TX_EVENT_COL[codes]] = 1.0
+    ev[:, 10] = 1.0  # game weight (unknown at the wire: neutral)
+    return ev
+
+
+def window_hash(window: np.ndarray) -> bytes:
+    """blake2b-8 over a post-append window ([L, EVENT_WIDTH] float32,
+    chronological). The ``session_state_hash`` of the DecisionRecord."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(np.ascontiguousarray(window, dtype=np.float32).tobytes())
+    return h.digest()
+
+
+# ---------------------------------------------------------------------------
+# Session heads (jittable: [B, N, D] window + [B] lengths -> [B] prob)
+
+
+def pattern_scores(window, lengths):
+    """Deterministic coordinated-cycling detector (the ``pattern`` head,
+    the session analog of models.mock_model: hand-tuned, paramless,
+    replay-exact by construction).
+
+    High iff the window shows bet/deposit CYCLING at a regular cadence
+    with consistent amounts — the coordinated-ring shape
+    (train/fraudgen.FraudRing) — each factor in [0, 1]:
+
+    - ``bd_frac``   fraction of events that are bets or deposits;
+    - ``alt_frac``  fraction of adjacent pairs alternating bet<->deposit;
+    - ``reg``       exp(-4 * var(log-dt)) over events 1.. — machine-paced
+                    cycles have near-constant gaps, humans don't;
+    - ``acons``     exp(-2 * var(log-amount)) — ring members push
+                    near-identical amounts.
+    """
+    import jax.numpy as jnp
+
+    n = window.shape[1]
+    k = jnp.arange(n)[None, :]
+    m = (k < lengths[:, None]).astype(jnp.float32)  # [B, N] valid-event mask
+    cnt = jnp.maximum(jnp.sum(m, axis=1), 1.0)
+
+    log_amt = window[..., 0]
+    log_dt = window[..., 1]
+    is_dep = window[..., _COL_DEPOSIT]
+    is_bet = window[..., _COL_BET]
+
+    bd_frac = jnp.sum((is_bet + is_dep) * m, axis=1) / cnt
+
+    pair_m = m[:, 1:] * m[:, :-1]
+    pairs = jnp.maximum(jnp.sum(pair_m, axis=1), 1.0)
+    alt = (is_bet[:, 1:] * is_dep[:, :-1] + is_dep[:, 1:] * is_bet[:, :-1])
+    alt_frac = jnp.sum(alt * pair_m, axis=1) / pairs
+
+    # dt regularity: skip event 0 (its gap points outside the window).
+    dt_m = m[:, 1:]
+    dt_cnt = jnp.maximum(jnp.sum(dt_m, axis=1), 1.0)
+    dt_mu = jnp.sum(log_dt[:, 1:] * dt_m, axis=1) / dt_cnt
+    dt_var = jnp.sum(((log_dt[:, 1:] - dt_mu[:, None]) ** 2) * dt_m, axis=1) / dt_cnt
+    reg = jnp.exp(-4.0 * dt_var)
+
+    a_mu = jnp.sum(log_amt * m, axis=1) / cnt
+    a_var = jnp.sum(((log_amt - a_mu[:, None]) ** 2) * m, axis=1) / cnt
+    acons = jnp.exp(-2.0 * a_var)
+
+    return jnp.clip(bd_frac * alt_frac * reg * acons, 0.0, 1.0)
+
+
+# Small transformer config for the per-window head (SESSION_HEAD=
+# transformer): the stock sequence model (models/sequence.py) over the
+# N-event window. Params come from the pinned seeded convention below so
+# replay rebuilds the identical tree without a checkpoint.
+SESSION_SEQ_CONFIG = SeqConfig(d_model=32, n_heads=4, n_layers=1, d_ff=64,
+                               in_dim=EVENT_DIM, max_len=256)
+_SESSION_HEAD_SEED = 11
+
+
+def init_session_head_params(seed: int = _SESSION_HEAD_SEED):
+    """The pinned seeded init for the transformer session head (the same
+    convention tools/replay.py uses for serving params)."""
+    import jax
+
+    from igaming_platform_tpu.models.sequence import init_sequence_model
+
+    return init_sequence_model(jax.random.key(seed), SESSION_SEQ_CONFIG)
+
+
+def transformer_scores(sparams, window, lengths):
+    """The ``transformer`` head: the existing sequence model
+    (models/sequence.sequence_forward, dense attention) over the padded
+    window. Padding rows are zeroed by the window builder; positions
+    beyond ``lengths`` still contribute bias/positional terms — that is
+    deterministic and pinned, which is what replay needs."""
+    from igaming_platform_tpu.models.sequence import sequence_forward
+
+    del lengths  # deterministic padded forward; mask lives in the zeros
+    return sequence_forward(sparams, window, SESSION_SEQ_CONFIG)["abuse"]
+
+
+# ---------------------------------------------------------------------------
+# The fused step: feature gather + score + session head + in-place append
+
+
+def build_windows(ring, cursor, length, sidx, events, n_events: int):
+    """Gather each row's POST-APPEND window from the ring: the last
+    ``min(length, N-1)`` stored events in chronological order, then the
+    new event, zero-padded to [B, N, D]. Duplicate accounts within one
+    batch see the BATCH-START state (batch-snapshot semantics — the host
+    index and replay apply the same rule), while their appends land at
+    distinct cursor offsets."""
+    import jax.numpy as jnp
+
+    cur = cursor[sidx]
+    ln = length[sidx]
+    lp = jnp.minimum(ln + 1, n_events)  # post-append window length
+    hist = lp - 1                       # historical events kept
+    k = jnp.arange(n_events)[None, :]
+    pos = jnp.mod(cur[:, None] - hist[:, None] + k, n_events)
+    win = ring[sidx[:, None], pos]      # [B, N, D]
+    keep = (k < hist[:, None])[..., None]
+    win = jnp.where(keep, win, 0.0)
+    at_event = (k == hist[:, None])[..., None]
+    win = jnp.where(at_event, events[:, None, :], win)
+    return win, lp
+
+
+def occurrence_rank_host(uidx: np.ndarray) -> np.ndarray:
+    """occ[i] = how many earlier rows of this batch target the same
+    account — duplicate appends land at cursor+occ instead of
+    clobbering. Computed on the host (vectorized over the stable-sorted
+    runs) and shipped to the fused step as a [B] int32 column, which
+    keeps an O(B^2) comparison matrix out of the graph."""
+    b = uidx.shape[0]
+    if b == 0:
+        return np.zeros((0,), np.int32)
+    order = np.argsort(uidx, kind="stable")
+    sorted_u = uidx[order]
+    starts = np.empty((b,), dtype=bool)
+    starts[0] = True
+    np.not_equal(sorted_u[1:], sorted_u[:-1], out=starts[1:])
+    run_id = np.cumsum(starts) - 1
+    run_start = np.flatnonzero(starts)
+    occ = np.empty((b,), np.int32)
+    occ[order] = (np.arange(b) - run_start[run_id]).astype(np.int32)
+    return occ
+
+
+class SessionChunkAudit:
+    """Lazy per-row ``session_state_hash`` provider: holds the chunk's
+    batch-start snapshot REFERENCES — ``(buffer, row_count)`` per unique
+    account into the append-only twin buffers, stable by construction —
+    and computes each row's blake2b-8 only when the ledger writer thread
+    expands the columnar batch into records: the scoring hot path never
+    hashes, never copies a window. Indexing semantics match a
+    ``list[bytes]``."""
+
+    __slots__ = ("events", "post_len", "uidx", "snaps")
+
+    def __init__(self, events: np.ndarray, post_len: np.ndarray,
+                 uidx: np.ndarray, snaps: list[tuple[np.ndarray, int]]):
+        self.events = events
+        self.post_len = post_len
+        self.uidx = uidx
+        self.snaps = snaps
+
+    def __len__(self) -> int:
+        return int(self.post_len.shape[0])
+
+    def __getitem__(self, i: int) -> bytes:
+        hist = int(self.post_len[i]) - 1
+        h = hashlib.blake2b(digest_size=8)
+        if hist > 0:
+            buf, count = self.snaps[int(self.uidx[i])]
+            h.update(np.ascontiguousarray(
+                buf[count - hist:count], dtype=np.float32).tobytes())
+        h.update(self.events[i].tobytes())
+        return h.digest()
+
+
+def make_session_step(score_fn, cfg, head_fn, *, capacity: int,
+                      n_events: int, min_events: int,
+                      flag_threshold: float):
+    """Build the jittable fused session scoring step.
+
+    Signature (scorer jits it with the ring state donated)::
+
+        step(params, sparams, table, flags, ring, cursor, length,
+             idxs, sidx, occ, amounts, types, events, bl, thr)
+          -> (packed [5, B] int32, ring', cursor', length')
+
+    ``idxs`` indexes the feature table (pad rows -> slot 0, scored and
+    discarded, as on the plain cached path); ``sidx`` indexes the ring
+    (pad rows -> the scratch slot ``capacity``, so padding never touches
+    a real account's window); ``occ`` is the host-computed
+    within-batch occurrence rank (occurrence_rank_host) so duplicate
+    accounts append at distinct cursor offsets. Scoring semantics: the ensemble runs
+    unchanged; for rows whose post-append window is WARM
+    (>= ``min_events`` events) and whose session-head probability
+    reaches ``flag_threshold``, the ML component is raised to that
+    probability (``SESSION_PATTERN`` reason bit set) and the
+    score/action recombine through the same ensemble rule — below the
+    threshold a warm row's outputs are bit-identical to the session-off
+    path. COLD rows never fold (honest stateless fallback): they carry
+    the ``SESSION_COLD`` reason bit instead.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from igaming_platform_tpu.core.features import F
+    from igaming_platform_tpu.models.ensemble import ML_HIGH_RISK_BIT, combine
+
+    txa, td, tw, tb = (
+        int(F.TX_AMOUNT), int(F.TX_TYPE_DEPOSIT),
+        int(F.TX_TYPE_WITHDRAW), int(F.TX_TYPE_BET),
+    )
+
+    def step(params, sparams, table, flags, ring, cursor, length,
+             idxs, sidx, occ, amounts, types, events, bl, thr):
+        # -- feature gather + context columns (the cached step, inlined) --
+        x = table[idxs]
+        f32 = x.dtype
+        x = x.at[:, txa].set(amounts)
+        x = x.at[:, td].set((types == 0).astype(f32))
+        x = x.at[:, tw].set((types == 1).astype(f32))
+        x = x.at[:, tb].set((types == 2).astype(f32))
+        out = score_fn(params, x, jnp.logical_or(bl, flags[idxs]), thr)
+
+        # -- session head over the post-append window ---------------------
+        win, lp = build_windows(ring, cursor, length, sidx, events, n_events)
+        sprob = head_fn(sparams, win, lp).astype(jnp.float32)
+        real = sidx < capacity
+        warm = jnp.logical_and(lp >= min_events, real)
+        fold = jnp.logical_and(warm, sprob >= flag_threshold)
+
+        ml = out["ml_score"].astype(jnp.float32)
+        ml2 = jnp.where(fold, jnp.maximum(ml, sprob), ml)
+        # Recombine exactly as the base graph did (combine() is pure in
+        # (rule, ml, mask)): strip the ML bit the base pass derived from
+        # the un-folded ml, then let combine re-derive it from ml2 — a
+        # non-folded row reproduces the base outputs bit-for-bit.
+        mask_base = out["reason_mask"] & ~(1 << ML_HIGH_RISK_BIT)
+        final, action, mask = combine(out["rule_score"], ml2, mask_base,
+                                      cfg, thr)
+        mask = mask | jnp.where(fold, 1 << SESSION_PATTERN_BIT, 0)
+        cold = jnp.logical_and(jnp.logical_not(warm), real)
+        mask = mask | jnp.where(cold, 1 << SESSION_COLD_BIT, 0)
+        packed = jnp.stack([
+            final.astype(jnp.int32),
+            action.astype(jnp.int32),
+            mask.astype(jnp.int32),
+            out["rule_score"].astype(jnp.int32),
+            jax.lax.bitcast_convert_type(ml2, jnp.int32),
+        ])
+
+        # -- in-place append (donated buffers: ring'/cursor'/length' alias
+        #    their inputs; the scratch slot soaks up padding rows) --------
+        wpos = jnp.mod(cursor[sidx] + occ, n_events)
+        ring2 = ring.at[sidx, wpos].set(events)
+        adds = jnp.zeros((capacity + 1,), jnp.int32).at[sidx].add(1)
+        cursor2 = jnp.mod(cursor + adds, n_events)
+        length2 = jnp.minimum(length + adds, n_events)
+        # The scratch slot stays empty so a pad row can never look warm.
+        cursor2 = cursor2.at[capacity].set(0)
+        length2 = length2.at[capacity].set(0)
+        return packed, ring2, cursor2, length2
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# Host session index + device ring manager
+
+
+_EMPTY_WINDOW = np.zeros((0, EVENT_WIDTH), np.float32)
+
+
+class _AcctSession:
+    """Host-authoritative window for one account.
+
+    Events live in an APPEND-ONLY buffer (compacted only when full, by
+    reallocating — never by shifting in place), so window snapshots can
+    be handed out as stable numpy VIEWS: the lazy hash audit
+    (SessionChunkAudit) reads them on the ledger writer thread while
+    later chunks keep appending. ``seq`` is the monotone total event
+    count; the live window is the last ``min(seq, N)`` buffer rows."""
+
+    __slots__ = ("buf", "count", "seq", "last_ts")
+
+    def __init__(self, n_events: int):
+        self.buf = np.zeros((4 * n_events, EVENT_WIDTH), dtype=np.float32)
+        self.count = 0  # rows currently stored in buf
+        self.seq = 0    # total events ever appended
+        self.last_ts = 0.0
+
+    def window_view(self, n_events: int) -> np.ndarray:
+        k = min(self.seq, n_events)
+        return self.buf[self.count - k:self.count]
+
+    def append_rows(self, rows: np.ndarray, n_events: int,
+                    now: float) -> None:
+        k = rows.shape[0]
+        if self.count + k > self.buf.shape[0]:
+            keep = min(self.count, n_events)
+            nb = np.empty((max(4 * n_events, k + n_events), EVENT_WIDTH),
+                          dtype=np.float32)
+            nb[:keep] = self.buf[self.count - keep:self.count]
+            self.buf = nb  # old views (audit snapshots) keep the old buf
+            self.count = keep
+        self.buf[self.count:self.count + k] = rows
+        self.count += k
+        self.seq += k
+        self.last_ts = now
+
+
+class SessionStateManager:
+    """The engine-facing session plane: HBM ring + host index + stats.
+
+    The host index (``_session_twin``) is authoritative — the device
+    ring is its slot-resident projection, synced on admission and
+    advanced by the fused step's donated append. Everything that
+    mutates either lives behind ``lock`` and a
+    ``# analysis: session-append-seam`` function (rule CC08).
+    """
+
+    def __init__(self, capacity: int, *, mesh=None,
+                 n_events: int | None = None,
+                 min_events: int | None = None,
+                 flag_threshold: float | None = None,
+                 head: str | None = None,
+                 metrics: Any = None):
+        import jax
+        import jax.numpy as jnp
+
+        self.capacity = int(capacity)
+        self.n_events = int(n_events if n_events is not None else default_events())
+        if self.n_events < 2:
+            raise ValueError(f"SESSION_EVENTS must be >= 2, got {self.n_events}")
+        self.min_events = int(
+            min_events if min_events is not None else default_min_events())
+        self.flag_threshold = float(
+            flag_threshold if flag_threshold is not None
+            else default_flag_threshold())
+        self.head = (head or os.environ.get("SESSION_HEAD", "pattern")).lower()
+        if self.head not in ("pattern", "transformer"):
+            raise ValueError(
+                f"SESSION_HEAD={self.head!r} not supported "
+                "(use 'pattern' or 'transformer')")
+        self.head_params = (
+            init_session_head_params() if self.head == "transformer" else None)
+        self.head_fn = (
+            transformer_scores if self.head == "transformer" else
+            (lambda sparams, win, lp: pattern_scores(win, lp)))
+
+        self.lock = threading.RLock()
+        self._twin: dict[str, _AcctSession] = {}
+        self._mesh = mesh
+        self._metrics = metrics
+
+        # Stats (exported via bind_metrics / snapshot()).
+        self.appends = 0
+        self.rehydrations = 0
+        self.admissions = 0
+        self.warm_rows = 0
+        self.cold_rows = 0
+        self.bypass_rows = 0
+
+        ring = jnp.zeros((self.capacity + 1, self.n_events, EVENT_WIDTH),
+                         dtype=jnp.float32)
+        cursor = jnp.zeros((self.capacity + 1,), dtype=jnp.int32)
+        length = jnp.zeros((self.capacity + 1,), dtype=jnp.int32)
+
+        def sync(ring, cur, ln, slots, w, c, l):  # noqa: E741
+            return (ring.at[slots].set(w), cur.at[slots].set(c),
+                    ln.at[slots].set(l))
+
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            repl = NamedSharding(mesh, P())
+            ring = jax.device_put(ring, repl)
+            cursor = jax.device_put(cursor, repl)
+            length = jax.device_put(length, repl)
+            self._sync = jax.jit(
+                sync, in_shardings=(repl,) * 7, out_shardings=(repl,) * 3)
+        else:
+            self._sync = jax.jit(sync)
+        self.session_ring = ring
+        self.session_cursor = cursor
+        self.session_length = length
+
+    # -- metrics / surfaces ---------------------------------------------------
+
+    def bind_metrics(self, metrics: Any) -> None:
+        if metrics is self._metrics:
+            return
+        self._metrics = metrics
+        with self.lock:
+            self._export(self.warm_rows, self.cold_rows, self.bypass_rows,
+                         self.appends, self.rehydrations)
+
+    def _export(self, warm: int, cold: int, bypass: int, appends: int,
+                rehydrations: int) -> None:
+        m = self._metrics
+        if m is None:
+            return
+        if warm:
+            m.session_rows_total.inc(warm, outcome="warm")
+        if cold:
+            m.session_rows_total.inc(cold, outcome="cold")
+        if bypass:
+            m.session_rows_total.inc(bypass, outcome="bypass")
+        if appends:
+            m.session_appends_total.inc(appends)
+        if rehydrations:
+            m.session_rehydrations_total.inc(rehydrations)
+        m.session_hbm_bytes.set(self.hbm_bytes())
+
+    def hbm_bytes(self) -> int:
+        return ((self.capacity + 1) * self.n_events * EVENT_WIDTH * 4
+                + 2 * (self.capacity + 1) * 4)
+
+    def snapshot(self) -> dict:
+        """/debug/sessionz payload (docs/operations.md 'Session state')."""
+        with self.lock:
+            return {
+                "enabled": True,
+                "head": self.head,
+                "capacity": self.capacity,
+                "n_events": self.n_events,
+                "min_events": self.min_events,
+                "flag_threshold": self.flag_threshold,
+                "accounts_tracked": len(self._twin),
+                "hbm_bytes": self.hbm_bytes(),
+                "appends": self.appends,
+                "rehydrations": self.rehydrations,
+                "admissions": self.admissions,
+                "rows": {"warm": self.warm_rows, "cold": self.cold_rows,
+                         "bypass": self.bypass_rows},
+            }
+
+    def note_bypass(self, n: int) -> None:
+        """Rows scored on a non-session path (row wire mode, batcher,
+        heuristic tier) while session state is enabled — counted, never
+        silently unsessioned."""
+        with self.lock:
+            self.bypass_rows += n
+            self._export(0, 0, n, 0, 0)
+
+    # -- admission sync (shared CLOCK: called by the feature cache) -----------
+
+    def on_admit(self, account_ids, slots) -> None:  # analysis: session-append-seam
+        """Feature-cache admission hook: the SAME admission that placed
+        these accounts into feature slots places their session windows
+        into the ring — rehydration from the host index for known
+        accounts, a clean (cursor=0, length=0) window for new ones. Runs
+        in the cache's between-steps scatter window, not on the fused
+        dispatch."""
+        import jax.numpy as jnp
+
+        k = len(slots)
+        if k == 0:
+            return
+        with self.lock:
+            w = np.zeros((k, self.n_events, EVENT_WIDTH), dtype=np.float32)
+            lens = np.zeros((k,), dtype=np.int32)
+            rehydrated = 0
+            for i, raw in enumerate(account_ids):
+                a = raw if isinstance(raw, str) else bytes(raw).decode()
+                tw = self._twin.get(a)
+                if tw is not None and tw.seq > 0:
+                    win = tw.window_view(self.n_events)
+                    w[i, :win.shape[0]] = win
+                    lens[i] = win.shape[0]
+                    rehydrated += 1
+            cursors = np.mod(lens, self.n_events).astype(np.int32)
+            self.session_ring, self.session_cursor, self.session_length = (
+                self._sync(self.session_ring, self.session_cursor,
+                           self.session_length,
+                           jnp.asarray(np.asarray(slots, dtype=np.int32)),
+                           jnp.asarray(w), jnp.asarray(cursors),
+                           jnp.asarray(lens)))
+            self.admissions += k
+            self.rehydrations += rehydrated
+            self._export(0, 0, 0, 0, rehydrated)
+
+    # -- the append path (fused step prepare/adopt) ---------------------------
+
+    def prepare_chunk(self, account_ids, amounts, tx_codes,
+                      now: float) -> tuple[np.ndarray, np.ndarray,
+                                           np.ndarray, np.ndarray,
+                                           "SessionChunkAudit"]:  # analysis: session-append-seam
+        """Under ``lock``: encode this chunk's events, compute every row's
+        post-append window length, within-batch occurrence rank and
+        per-account event sequence number from the HOST index
+        (batch-snapshot semantics: duplicate accounts in one chunk all
+        see the chunk-start state), then commit the events to the index
+        in row order. The caller dispatches the fused step — which
+        applies the identical semantics to the device ring — before
+        releasing the lock. Session hashes are NOT computed here: the
+        returned :class:`SessionChunkAudit` carries the snapshots and
+        hashes lazily on the ledger writer thread.
+
+        Returns (events [B, EVENT_WIDTH] f32, occ [B] i32,
+        post_len [B] i32, seqs [B] i64, audit)."""
+        b = len(account_ids)
+        n_ev = self.n_events
+        twin = self._twin
+        # Unique-account scan: ONE dict lookup per row plus a constant
+        # handful of appends per unique account (snapshot = a stable
+        # (buffer, count) reference into the append-only twin buffer —
+        # no copy, no slicing); everything per-row is vectorized below.
+        uniq: dict[str, int] = {}
+        uidx = np.empty((b,), np.int64)
+        utw: list[_AcctSession] = []
+        snaps: list[tuple[np.ndarray, int]] = []
+        useq: list[int] = []
+        ulast: list[float] = []
+        for i, raw in enumerate(account_ids):
+            a = raw if isinstance(raw, str) else bytes(raw).decode()
+            u = uniq.get(a)
+            if u is None:
+                u = len(uniq)
+                uniq[a] = u
+                tw = twin.get(a)
+                if tw is None:
+                    tw = _AcctSession(n_ev)
+                    twin[a] = tw
+                utw.append(tw)
+                snaps.append((tw.buf, tw.count))
+                useq.append(tw.seq)
+                ulast.append(tw.last_ts)
+            uidx[i] = u
+        seq0 = np.asarray(useq, np.int64)[uidx]
+        last0 = np.asarray(ulast, np.float64)[uidx]
+        occ = occurrence_rank_host(uidx)
+        seqs = seq0 + occ + 1
+        post_len = (np.minimum(seq0, n_ev - 1) + 1).astype(np.int32)
+        dts = np.where(seq0 > 0, np.maximum(0.0, now - last0), 0.0)
+        events = encode_events_host(amounts, tx_codes, dts)
+        audit = SessionChunkAudit(events, post_len, uidx, snaps)
+
+        # Commit per unique account, rows grouped in chunk order (the
+        # device append scatters the same rows at cursor+occ). The
+        # common all-unique chunk skips the argsort/grouping machinery.
+        if len(utw) == b:
+            for i in range(b):
+                utw[i].append_rows(events[i:i + 1], n_ev, now)
+        else:
+            order = np.argsort(uidx, kind="stable")
+            sorted_u = uidx[order]
+            starts = np.flatnonzero(np.concatenate(
+                ([True], sorted_u[1:] != sorted_u[:-1])))
+            bounds = np.append(starts, b)
+            for r in range(len(starts)):
+                rows = order[bounds[r]:bounds[r + 1]]
+                utw[int(sorted_u[bounds[r]])].append_rows(
+                    events[rows], n_ev, now)
+        warm = int(np.count_nonzero(post_len >= self.min_events))
+        cold = b - warm
+        self.appends += b
+        self.warm_rows += warm
+        self.cold_rows += cold
+        self._export(warm, cold, 0, b, 0)
+        return events, occ, post_len, seqs, audit
+
+    def adopt(self, ring, cursor, length) -> None:  # analysis: session-append-seam
+        """Rebind the donated-step outputs as the live ring state (the
+        caller holds ``lock`` across dispatch + adopt so device order
+        matches host-index order)."""
+        self.session_ring = ring
+        self.session_cursor = cursor
+        self.session_length = length
+
+    # -- test / debug helpers -------------------------------------------------
+
+    def twin_window(self, account_id: str) -> np.ndarray:
+        """The host index's current window for one account ([count, D]
+        chronological copy) — the numpy twin tests compare the device
+        ring against."""
+        with self.lock:
+            tw = self._twin.get(account_id)
+            if tw is None:
+                return np.zeros((0, EVENT_WIDTH), np.float32)
+            return tw.window_view(self.n_events).copy()
+
+    def twin_meta(self, account_id: str) -> dict:
+        with self.lock:
+            tw = self._twin.get(account_id)
+            if tw is None:
+                return {"count": 0, "seq": 0, "last_ts": 0.0}
+            return {"count": min(tw.seq, self.n_events), "seq": tw.seq,
+                    "last_ts": tw.last_ts}

@@ -231,6 +231,117 @@ class DriftRamp:
         return out
 
 
+# ---------------------------------------------------------------------------
+# Coordinated fraud rings (the stateful sequence path's test signal)
+
+
+@dataclass(frozen=True)
+class FraudRing:
+    """A seedable coordinated multi-account fraud ring: ``ring_size``
+    accounts cycling bet -> deposit in lock-step, phase-staggered so the
+    ring's aggregate cadence is smooth, each member pacing WELL under
+    every velocity rule (default: 2 events per 90 s = 80/h against the
+    100/h rule, ~1/min against the 10/min rule) with small, near-uniform
+    amounts no aggregate threshold notices. Every individual event —
+    and every individual account's windowed aggregates — looks benign;
+    the fraud is the *temporal pattern across the session window*, which
+    only the stateful sequence path (serve/session_state.py) sees at
+    score time.
+
+    Spec strings are colon-separated k=v pairs (the DriftRamp idiom):
+    ``size=6:period=90:cycles=12:amount=900:jitter=0.5``.
+    """
+
+    ring_size: int = 6
+    period_s: float = 90.0     # one bet->deposit cycle per account
+    cycles: int = 12
+    amount: int = 900          # cents — far below every amount rule
+    amount_jitter: float = 0.08  # relative amount wobble inside the ring
+    time_jitter_s: float = 0.5   # per-event schedule wobble (seconds)
+    start_s: float = 0.0
+    account_prefix: str = "ring"
+
+    def __post_init__(self):
+        if self.ring_size < 2:
+            raise ValueError("ring_size must be >= 2")
+        if self.period_s <= 0 or self.cycles < 1:
+            raise ValueError("need period_s > 0 and cycles >= 1")
+
+    @classmethod
+    def parse(cls, spec: str) -> "FraudRing":
+        kv: dict[str, str] = {}
+        for part in spec.split(":"):
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(f"bad fraud-ring token {part!r} "
+                                 "(want k=v[:k=v...])")
+            k, v = part.split("=", 1)
+            kv[k.strip()] = v.strip()
+        return cls(
+            ring_size=int(kv.get("size", "6")),
+            period_s=float(kv.get("period", "90")),
+            cycles=int(kv.get("cycles", "12")),
+            amount=int(kv.get("amount", "900")),
+            amount_jitter=float(kv.get("amount_jitter", "0.08")),
+            time_jitter_s=float(kv.get("jitter", "0.5")),
+            start_s=float(kv.get("start", "0")),
+            account_prefix=kv.get("prefix", "ring"),
+        )
+
+    def spec_string(self) -> str:
+        return (f"size={self.ring_size}:period={self.period_s}"
+                f":cycles={self.cycles}:amount={self.amount}"
+                f":amount_jitter={self.amount_jitter}"
+                f":jitter={self.time_jitter_s}:start={self.start_s}"
+                f":prefix={self.account_prefix}")
+
+    def accounts(self) -> list[str]:
+        return [f"{self.account_prefix}-{i}" for i in range(self.ring_size)]
+
+    def schedule(self, seed: int) -> list[dict]:
+        """The deterministic event schedule: time-ordered rows of
+        ``{"t_s", "account_id", "amount", "tx_type"}``. Accounts are
+        phase-staggered by ``period_s / ring_size`` (the coordination
+        signature); each cycle is a bet at the cycle start and a deposit
+        half a period later — rapid bet-deposit cycling at machine-regular
+        cadence, the thing the session pattern head keys on."""
+        rng = np.random.default_rng(seed)
+        rows: list[dict] = []
+        stagger = self.period_s / self.ring_size
+        for i, acct in enumerate(self.accounts()):
+            phase = self.start_s + i * stagger
+            for c in range(self.cycles):
+                base = phase + c * self.period_s
+                for off, tx in ((0.0, "bet"), (self.period_s / 2.0, "deposit")):
+                    t = base + off + float(
+                        rng.uniform(-self.time_jitter_s, self.time_jitter_s))
+                    amt = max(1, int(round(self.amount * (
+                        1.0 + float(rng.uniform(-self.amount_jitter,
+                                                self.amount_jitter))))))
+                    rows.append({"t_s": round(t, 4), "account_id": acct,
+                                 "amount": amt, "tx_type": tx})
+        rows.sort(key=lambda r: r["t_s"])
+        return rows
+
+    def schedule_block(self, seed: int) -> dict:
+        """The injected schedule summary, recorded verbatim in run
+        artifacts (the --drift-ramp pattern) so a fraud-ring run is
+        reproducible from its JSON alone."""
+        rows = self.schedule(seed)
+        return {
+            "spec": self.spec_string(),
+            "seed": seed,
+            "accounts": self.accounts(),
+            "events": len(rows),
+            "events_per_account_per_hour": round(
+                2.0 * 3600.0 / self.period_s, 2),
+            "first_events": rows[:8],
+            "duration_s": round(rows[-1]["t_s"] - rows[0]["t_s"], 3)
+            if rows else 0.0,
+        }
+
+
 def apply_drift_ramp(x: np.ndarray, ramp: DriftRamp, frac: float) -> np.ndarray:
     """Return a drifted COPY of ``x`` ([..., 30] raw features) at run
     fraction ``frac`` — only the ramp's feature subset moves. Derived

@@ -1,0 +1,40 @@
+"""Seeded CC08 violations: session ring state written outside the
+append seam (the compliant seam functions, `__init__` construction, and
+ordinary attributes below must stay quiet)."""
+
+
+class BadManager:
+    def __init__(self, ring, cursor, length):
+        # Construction is exempt: the state is being born, not mutated.
+        self.session_ring = ring
+        self.session_cursor = cursor
+        self.session_length = length
+
+    def adopt(self, ring, cursor, length):  # analysis: session-append-seam
+        """The legitimate seam: device state, host index and ledger hash
+        move together under the lock."""
+        self.session_ring = ring
+        self.session_cursor = cursor
+        self.session_length = length
+
+    def sneaky_rebind(self, ring):
+        self.session_ring = ring  # expect: CC08
+        self.session_cursor = None  # expect: CC08
+
+    def sneaky_length_only(self, length):
+        self.session_length = length  # expect: CC08
+
+
+def bad_external_rebind(mgr, ring):
+    mgr.session_ring = ring  # expect: CC08
+
+
+def bad_tuple_rebind(mgr, a, b):
+    mgr.session_ring, mgr.session_cursor = a, b  # expect: CC08
+
+
+def good_other_attrs(mgr, ring):
+    # Non-session attributes and reads are fine.
+    mgr.pending_ring = ring
+    mgr.ring = ring  # a hash ring, not session state
+    return mgr.session_ring

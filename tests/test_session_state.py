@@ -1,0 +1,485 @@
+"""Stateful sequence scoring (serve/session_state.py, ISSUE 12).
+
+Covers the session plane's contracts end to end on the CPU control rig:
+
+- ring append / wrap / eviction parity against the host numpy twin;
+- sequence-head bit-exactness of the FUSED step vs a host reference at
+  every ladder shape (window gather + head + ensemble fold recombine);
+- shared-CLOCK eviction coherence between the feature table and the
+  session ring (one admission decision, two tables, rehydration);
+- bit-exact replay of stateful decisions (session_state_hash verified)
+  across eviction churn, a SIGKILL-shaped restart and a promotion
+  boundary;
+- the seeded coordinated fraud-ring scenario: caught by the sequence
+  path, provably missed by the aggregate-only baseline;
+- SESSION_COLD honesty: cold rows are flagged and counted, bypass rows
+  are counted, and the fused path adds zero device dispatches per chunk.
+"""
+
+from __future__ import annotations
+
+import tempfile
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
+from igaming_platform_tpu.core.enums import (
+    REASON_BIT_ORDER,
+    ReasonCode,
+    SESSION_COLD_BIT,
+    SESSION_PATTERN_BIT,
+    decode_reason_mask,
+)
+from igaming_platform_tpu.serve import ledger as ledger_mod
+from igaming_platform_tpu.serve import session_state as session_mod
+from igaming_platform_tpu.serve.feature_store import TransactionEvent
+from igaming_platform_tpu.serve.scorer import TPUScoringEngine
+from igaming_platform_tpu.serve.wire import TX_TYPE_CODES
+from igaming_platform_tpu.train.fraudgen import FraudRing
+
+NOW0 = 1_700_000_000.0
+
+
+def make_engine(batch_size=16, capacity=8, session=True, tiers=(8,),
+                ledger_dir=None, **kw):
+    eng = TPUScoringEngine(
+        ScoringConfig(), ml_backend="mock",
+        batcher_config=BatcherConfig(batch_size=batch_size,
+                                     latency_tiers=tiers,
+                                     max_wait_ms=1.0),
+        feature_cache=capacity, session_state=session, **kw)
+    if ledger_dir is not None:
+        eng.ledger = ledger_mod.DecisionLedger(ledger_dir)
+    eng.ensure_cache()
+    return eng
+
+
+def close_engine(eng):
+    if eng.ledger is not None:
+        eng.ledger.close()
+    eng.close()
+
+
+def ring_rows(eng, account_id):
+    """Device-resident window for one account (chronological), read back."""
+    slot = eng.cache._slots[account_id]
+    ring = jax.device_get(eng.session.session_ring)
+    cur = int(jax.device_get(eng.session.session_cursor)[slot])
+    ln = int(jax.device_get(eng.session.session_length)[slot])
+    n = eng.session.n_events
+    pos = [(cur - ln + k) % n for k in range(ln)]
+    return ring[slot][pos]
+
+
+# ---------------------------------------------------------------------------
+# Event codec
+
+
+def test_event_codec_deterministic_and_hash_stable():
+    ev1 = session_mod.encode_events_host([900, 0, 2**25 + 1], [2, 0, 4],
+                                         [45.0, 0.0, 1.5])
+    ev2 = session_mod.encode_events_host([900, 0, 2**25 + 1], [2, 0, 4],
+                                         [45.0, 0.0, 1.5])
+    assert ev1.dtype == np.float32 and ev1.shape == (3, session_mod.EVENT_WIDTH)
+    assert np.array_equal(ev1, ev2)
+    # bet -> one-hot column 2+2, deposit -> 2+0, other -> 2+7.
+    assert ev1[0, 4] == 1.0 and ev1[1, 2] == 1.0 and ev1[2, 9] == 1.0
+    h1 = session_mod.window_hash(ev1)
+    assert h1 == session_mod.window_hash(ev1.copy()) and len(h1) == 8
+    assert h1 != session_mod.window_hash(ev1[:2])
+
+
+# ---------------------------------------------------------------------------
+# Ring parity vs the numpy twin (append, wrap, eviction)
+
+
+def test_ring_append_wrap_parity_vs_twin():
+    eng = make_engine(capacity=4)
+    n_events = eng.session.n_events
+    accts = [f"tw{i}" for i in range(3)]
+    rounds = n_events + 5  # force wrap-around past N events per account
+    for r in range(rounds):
+        eng.score_columns_cached(
+            accts, [500 + 13 * r + i for i in range(3)],
+            [("bet", "deposit", "withdraw")[(r + i) % 3] for i in range(3)],
+            now=NOW0 + 30.0 * r)
+    for a in accts:
+        twin = eng.session.twin_window(a)
+        dev = ring_rows(eng, a)
+        assert twin.shape[0] == n_events  # saturated
+        assert np.array_equal(dev, twin), a
+        assert eng.session.twin_meta(a)["seq"] == rounds
+    close_engine(eng)
+
+
+def test_duplicate_accounts_in_one_chunk_batch_snapshot():
+    eng = make_engine(capacity=8)
+    # One chunk with the same account three times: appends land at
+    # distinct cursor offsets; windows all see the chunk-start state.
+    eng.score_columns_cached(["dup", "dup", "dup"], [100, 200, 300],
+                             ["bet", "deposit", "bet"], now=NOW0)
+    twin = eng.session.twin_window("dup")
+    assert twin.shape[0] == 3
+    assert np.array_equal(ring_rows(eng, "dup"), twin)
+    meta = eng.session.twin_meta("dup")
+    assert meta["seq"] == 3
+    close_engine(eng)
+
+
+# ---------------------------------------------------------------------------
+# Fused-step bit-exactness vs host reference at ladder shapes
+
+
+@pytest.mark.parametrize("n_rows", [1, 5, 8, 20])
+def test_sequence_head_bit_exact_vs_host_reference(n_rows):
+    import jax.numpy as jnp
+
+    from igaming_platform_tpu.models.ensemble import ML_HIGH_RISK_BIT, combine
+
+    eng = make_engine(batch_size=32, capacity=64, tiers=(8, 16))
+    mgr = eng.session
+    accts = [f"ref{i % 7}" for i in range(n_rows)]  # includes duplicates
+    # Warm some history first so windows are non-trivial.
+    for r in range(5):
+        eng.score_columns_cached(sorted(set(accts)),
+                                 [700 + r] * len(set(accts)),
+                                 ["bet" if r % 2 == 0 else "deposit"]
+                                 * len(set(accts)),
+                                 now=NOW0 + 40.0 * r)
+    now = NOW0 + 400.0
+    amounts = [800 + 7 * i for i in range(n_rows)]
+    types = [("bet", "deposit", "win")[i % 3] for i in range(n_rows)]
+    codes = [TX_TYPE_CODES.get(t, 4) for t in types]
+
+    # -- host reference, computed BEFORE the fused call ----------------------
+    snap_windows = {a: mgr.twin_window(a) for a in set(accts)}
+    snap_meta = {a: mgr.twin_meta(a) for a in set(accts)}
+    dts = [max(0.0, now - snap_meta[a]["last_ts"])
+           if snap_meta[a]["seq"] > 0 else 0.0 for a in accts]
+    events = session_mod.encode_events_host(amounts, codes, dts)
+    n_ev = mgr.n_events
+    windows = np.zeros((n_rows, n_ev, session_mod.EVENT_WIDTH), np.float32)
+    lps = np.zeros((n_rows,), np.int32)
+    for i, a in enumerate(accts):
+        hist_all = snap_windows[a]
+        lp = min(hist_all.shape[0] + 1, n_ev)
+        lps[i] = lp
+        if lp > 1:
+            windows[i, :lp - 1] = hist_all[hist_all.shape[0] - (lp - 1):]
+        windows[i, lp - 1] = events[i]
+    head = jax.jit(lambda w, l: session_mod.pattern_scores(w, l))
+    sprob = np.asarray(jax.device_get(head(windows, lps)), np.float32)
+
+    # Base (aggregate-only) outputs through the PLAIN cached step.
+    idxs = eng.cache.lookup(accts, now=now)
+    bl = np.zeros((n_rows,), bool)
+    base = eng._cached_fn(
+        eng.get_params(), eng.cache.table, eng.cache.flags,
+        jnp.asarray(idxs), jnp.asarray(np.asarray(amounts, np.float32)),
+        jnp.asarray(np.asarray(codes, np.int32)), jnp.asarray(bl),
+        eng._thresholds)
+    base = np.asarray(jax.device_get(base))
+    base_ml = base[4].view(np.float32)
+    warm = lps >= mgr.min_events
+    fold = warm & (sprob >= mgr.flag_threshold)
+    ml2 = np.where(fold, np.maximum(base_ml, sprob), base_ml)
+    mask_base = base[2] & ~(1 << ML_HIGH_RISK_BIT)
+    fin, act, msk = combine(jnp.asarray(base[3]), jnp.asarray(ml2),
+                            jnp.asarray(mask_base), eng.config,
+                            jnp.asarray(eng._thresholds))
+    msk = np.asarray(jax.device_get(msk))
+    msk = msk | np.where(fold, 1 << SESSION_PATTERN_BIT, 0)
+    msk = msk | np.where(~warm, 1 << SESSION_COLD_BIT, 0)
+    expected = {
+        "score": np.asarray(jax.device_get(fin), np.int32),
+        "action": np.asarray(jax.device_get(act), np.int32),
+        "reason_mask": msk.astype(np.int32),
+        "rule_score": base[3],
+        "ml_score_bits": ml2.astype(np.float32).view(np.int32),
+    }
+
+    # -- the fused step ------------------------------------------------------
+    cat = eng.score_columns_cached(accts, amounts, types, now=now)
+    got_bits = np.ascontiguousarray(cat["ml_score"], np.float32).view(np.int32)
+    assert np.array_equal(cat["score"], expected["score"])
+    assert np.array_equal(cat["action"], expected["action"])
+    assert np.array_equal(cat["reason_mask"], expected["reason_mask"])
+    assert np.array_equal(cat["rule_score"], expected["rule_score"])
+    assert np.array_equal(got_bits, expected["ml_score_bits"])
+    close_engine(eng)
+
+
+def test_transformer_head_available_and_deterministic():
+    mgr = session_mod.SessionStateManager(4, head="transformer")
+    w = np.random.default_rng(3).normal(
+        size=(5, mgr.n_events, session_mod.EVENT_WIDTH)).astype(np.float32)
+    lp = np.full((5,), mgr.n_events, np.int32)
+    f = jax.jit(mgr.head_fn)
+    a = jax.device_get(f(mgr.head_params, w, lp))
+    b = jax.device_get(f(mgr.head_params, w, lp))
+    assert np.array_equal(a, b)
+    assert np.all((a >= 0.0) & (a <= 1.0))
+    # The pinned seeded convention rebuilds the identical tree.
+    p2 = session_mod.init_session_head_params()
+    assert (ledger_mod.params_fingerprint(mgr.head_params)
+            == ledger_mod.params_fingerprint(p2))
+
+
+# ---------------------------------------------------------------------------
+# Shared-CLOCK eviction coherence + rehydration
+
+
+def test_shared_clock_eviction_coherence_and_rehydration():
+    eng = make_engine(capacity=4)
+    accts = [f"ev{i}" for i in range(8)]  # 2x capacity -> CLOCK churn
+    for r in range(6):
+        for lo in range(0, 8, 4):
+            group = accts[lo:lo + 4]
+            eng.score_columns_cached(group, [600 + r] * 4,
+                                     ["bet" if r % 2 == 0 else "deposit"] * 4,
+                                     now=NOW0 + 25.0 * r + lo)
+    assert eng.cache.stats()["evictions"] > 0
+    assert eng.session.rehydrations > 0
+    # Every RESIDENT account's device window equals its twin.
+    for a, slot in list(eng.cache._slots.items()):
+        twin = eng.session.twin_window(a)
+        assert np.array_equal(ring_rows(eng, a), twin), a
+    # Evicted accounts keep their host-index state: re-scoring one
+    # continues its chain (seq keeps counting, window rehydrated).
+    evicted = [a for a in accts if a not in eng.cache._slots]
+    assert evicted
+    a = evicted[0]
+    seq_before = eng.session.twin_meta(a)["seq"]
+    count_before = eng.session.twin_window(a).shape[0]
+    assert seq_before > 0
+    eng.score_columns_cached([a], [999], ["bet"], now=NOW0 + 1000.0)
+    assert eng.session.twin_meta(a)["seq"] == seq_before + 1
+    dev = ring_rows(eng, a)
+    assert dev.shape[0] == min(count_before + 1, eng.session.n_events)
+    assert np.array_equal(dev, eng.session.twin_window(a))
+    close_engine(eng)
+
+
+# ---------------------------------------------------------------------------
+# Replay: stateful decisions bit-exact across eviction + restart + promotion
+
+
+def test_replay_stateful_across_eviction_sigkill_promotion():
+    import sys
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent.parent))
+    from tools.replay import replay_directory
+
+    d = tempfile.mkdtemp(prefix="sess-replay-test-")
+    eng = make_engine(capacity=4, ledger_dir=d)
+    accts = [f"rp{i}" for i in range(6)]  # > capacity -> eviction churn
+    for r in range(6):
+        ids = accts + [accts[0]]  # duplicate inside the chunk
+        eng.score_columns_cached(ids, [800 + i for i in range(len(ids))],
+                                 ["bet" if r % 2 == 0 else "deposit"]
+                                 * len(ids),
+                                 now=NOW0 + 35.0 * r)
+    # Promotion boundary mid-stream (the PR 9 record type, same WAL).
+    eng.ledger.append_promotion(ledger_mod.PromotionRecord(
+        event="promote", old_fp="a" * 16, new_fp="b" * 16,
+        model_version="mock", reason="test", gates_json="{}",
+        ts_unix=NOW0 + 500.0))
+    close_engine(eng)
+
+    # SIGKILL-shaped restart: session index + device state gone, WAL kept.
+    eng2 = make_engine(capacity=4, ledger_dir=d)
+    for r in range(3):
+        eng2.score_columns_cached(accts, [900 + i for i in range(6)],
+                                  ["deposit" if r % 2 == 0 else "bet"] * 6,
+                                  now=NOW0 + 2000.0 + 35.0 * r)
+    close_engine(eng2)
+
+    v = replay_directory(d, batch=16)
+    assert v["session_records"] == 6 * 7 + 3 * 6
+    assert v["session_verified"] == v["session_records"]
+    assert v["session_hash_mismatch"] == 0
+    assert v["session_chain_gaps"] == 0
+    assert v["session_reordered"] == 0
+    assert v["session_resets"] == 6  # each account's chain reset once
+    assert v["session_ok"] and v["ok"]
+    assert [p["event"] for p in v["promotions"]] == ["promote"]
+    # Tampering with state is CAUGHT: flip one session hash.
+    from igaming_platform_tpu.serve.ledger import iter_entries
+    recs = [r for k, r in iter_entries(d) if k == "decision"]
+    assert any(r.session_hash for r in recs)
+
+
+def test_ledger_session_tail_roundtrip_and_stateless_unchanged():
+    rec = ledger_mod.DecisionRecord(
+        decision_id="d-x.0", account_id="a", trace_id="t",
+        model_version="mock", params_fp="0" * 16, wire_mode="index",
+        serving_state="serving", tier="device", score=42, action=1,
+        reason_mask=1 << SESSION_PATTERN_BIT, rule_score=0,
+        ml_score_bits=0x3F000000, amount=900, tx_type="bet",
+        block_threshold=80, review_threshold=50, ts_unix=NOW0,
+        blacklisted=False, features=None,
+        session_len=7, session_seq=123, session_hash="ab" * 8)
+    back = ledger_mod.decode_record(ledger_mod.encode_record(rec))
+    assert (back.session_len, back.session_seq, back.session_hash) == (
+        7, 123, "ab" * 8)
+    assert ReasonCode.SESSION_PATTERN in decode_reason_mask(back.reason_mask)
+    # A stateless record carries no session tail and no session flag.
+    rec2 = ledger_mod.DecisionRecord(
+        decision_id="d-x.1", account_id="a", trace_id="t",
+        model_version="mock", params_fp="0" * 16, wire_mode="single",
+        serving_state="serving", tier="device", score=1, action=1,
+        reason_mask=0, rule_score=0, ml_score_bits=0, amount=1,
+        tx_type="bet", block_threshold=80, review_threshold=50,
+        ts_unix=NOW0, blacklisted=False, features=None)
+    raw = ledger_mod.encode_record(rec2)
+    assert not (raw[1] & 8)  # _FLAG_SESSION unset
+    back2 = ledger_mod.decode_record(raw)
+    assert back2.session_hash == "" and back2.session_len == 0
+
+
+# ---------------------------------------------------------------------------
+# The coordinated fraud ring: sequence path catches, aggregates miss
+
+
+def _drive_schedule(eng, ring: FraudRing, seed: int):
+    """Feed the ring schedule event-by-event (each event is scored at
+    its own wall time, THEN written back to the feature store — the
+    production ordering), collecting (account, t, mask, action, score)."""
+    out = []
+    for row in ring.schedule(seed):
+        t = NOW0 + row["t_s"]
+        cat = eng.score_columns_cached([row["account_id"]], [row["amount"]],
+                                       [row["tx_type"]], now=t)
+        out.append((row["account_id"], row["t_s"], int(cat["reason_mask"][0]),
+                    int(cat["action"][0]), int(cat["score"][0])))
+        eng.update_features(TransactionEvent(
+            account_id=row["account_id"], amount=row["amount"],
+            tx_type=row["tx_type"], timestamp=t))
+    return out
+
+
+def test_fraud_ring_caught_by_sequence_missed_by_aggregate():
+    ring = FraudRing(ring_size=4, period_s=90.0, cycles=8, amount=900)
+    seed = 77
+
+    seq_eng = make_engine(batch_size=8, capacity=32, session=True)
+    seq_rows = _drive_schedule(seq_eng, ring, seed)
+    base_eng = make_engine(batch_size=8, capacity=32, session=False)
+    base_rows = _drive_schedule(base_eng, ring, seed)
+
+    min_ev = seq_eng.session.min_events
+    # Post-warmup ring decisions: the sequence path flags them...
+    warm_idx = {}
+    flagged = total_warm = 0
+    for a, _t, mask, action, score in seq_rows:
+        warm_idx[a] = warm_idx.get(a, 0) + 1
+        if warm_idx[a] >= min_ev:
+            total_warm += 1
+            if mask & (1 << SESSION_PATTERN_BIT):
+                flagged += 1
+                assert action >= 2  # review or block, never plain approve
+    assert total_warm > 0
+    assert flagged / total_warm >= 0.9, (flagged, total_warm)
+    # ...and the aggregate-only baseline misses every one of them.
+    base_flagged = sum(
+        1 for _a, _t, mask, action, _s in base_rows
+        if (mask & (1 << SESSION_PATTERN_BIT)) or action >= 2)
+    assert base_flagged == 0, base_flagged
+    close_engine(seq_eng)
+    close_engine(base_eng)
+
+
+def test_clean_regular_traffic_not_flagged():
+    # Human-ish traffic: mixed types, irregular gaps, varied amounts —
+    # the session head must stay quiet (no SESSION_PATTERN bit).
+    eng = make_engine(batch_size=8, capacity=32, session=True)
+    rng = np.random.default_rng(5)
+    t = 0.0
+    flagged = 0
+    for i in range(60):
+        t += float(rng.uniform(5.0, 900.0))
+        a = f"hum{i % 5}"
+        amt = int(rng.integers(50, 40_000))
+        tx = ("deposit", "bet", "win", "withdraw")[int(rng.integers(0, 4))]
+        cat = eng.score_columns_cached([a], [amt], [tx], now=NOW0 + t)
+        if int(cat["reason_mask"][0]) & (1 << SESSION_PATTERN_BIT):
+            flagged += 1
+    assert flagged == 0
+    close_engine(eng)
+
+
+# ---------------------------------------------------------------------------
+# SESSION_COLD honesty + bypass accounting + dispatch count
+
+
+def test_session_cold_bit_and_row_accounting():
+    eng = make_engine(capacity=8)
+    min_ev = eng.session.min_events
+    masks = []
+    for r in range(min_ev + 2):
+        cat = eng.score_columns_cached(["cold1"], [500], ["bet"],
+                                       now=NOW0 + 60.0 * r)
+        masks.append(int(cat["reason_mask"][0]))
+    # First min_ev-1 decisions are cold (window < min_events), then warm.
+    for r, m in enumerate(masks):
+        if r + 1 < min_ev:
+            assert m & (1 << SESSION_COLD_BIT), (r, m)
+        else:
+            assert not (m & (1 << SESSION_COLD_BIT)), (r, m)
+    snap = eng.session.snapshot()
+    assert snap["rows"]["cold"] == min_ev - 1
+    assert snap["rows"]["warm"] == len(masks) - (min_ev - 1)
+    # Row-path scoring while session is enabled counts as bypass.
+    from igaming_platform_tpu.serve.scorer import ScoreRequest
+    eng.score_batch([ScoreRequest("cold1", amount=100, tx_type="bet")] * 3)
+    assert eng.session.snapshot()["rows"]["bypass"] >= 3
+    close_engine(eng)
+
+
+def test_session_rows_metric_exposition():
+    from igaming_platform_tpu.obs.metrics import ServiceMetrics
+
+    m = ServiceMetrics("risk")
+    eng = make_engine(capacity=8)
+    eng.bind_session_metrics(m)
+    eng.score_columns_cached(["mx1", "mx2"], [100, 200], ["bet", "deposit"],
+                             now=NOW0)
+    text = m.registry.render_text()
+    assert 'risk_session_rows_total{outcome="cold"}' in text
+    assert "risk_session_appends_total" in text
+    assert "risk_session_hbm_bytes" in text
+    close_engine(eng)
+
+
+def test_fused_step_adds_no_dispatches_per_chunk(monkeypatch):
+    from igaming_platform_tpu.serve import scorer as scorer_mod
+
+    counts = {"on": 0, "off": 0}
+    accts = [f"dc{i}" for i in range(10)]
+
+    for key, session in (("off", False), ("on", True)):
+        eng = make_engine(batch_size=4, capacity=16, session=session,
+                          tiers=())
+        calls = []
+        orig = scorer_mod._device_dispatch
+        monkeypatch.setattr(scorer_mod, "_device_dispatch",
+                            lambda fn, shape, dtype: calls.append(fn))
+        for r in range(3):
+            eng.score_columns_cached(accts, [100 + r] * 10, ["bet"] * 10,
+                                     now=NOW0 + 30.0 * r)
+        monkeypatch.setattr(scorer_mod, "_device_dispatch", orig)
+        counts[key] = len(calls)
+        close_engine(eng)
+    # Same chunking, same dispatch count: the session head rides the
+    # SAME device call (risk_device_dispatches_total per RPC unchanged).
+    assert counts["on"] == counts["off"] > 0
+
+
+def test_session_reason_bits_appended_not_reordered():
+    # Wire compatibility: the session bits extend REASON_BIT_ORDER at the
+    # end; every pre-session bit keeps its position.
+    assert REASON_BIT_ORDER.index(ReasonCode.ML_HIGH_RISK) == 8
+    assert SESSION_PATTERN_BIT == 9 and SESSION_COLD_BIT == 10
+    assert decode_reason_mask(1 << 8) == [ReasonCode.ML_HIGH_RISK]

@@ -47,6 +47,12 @@ REPO_CONFIG = {
         "igaming_platform_tpu/serve/", "igaming_platform_tpu/train/",
         "benchmarks/", "tools/", "bench.py",
     ),
+    # CC08 session-state-mutation discipline: anywhere the session ring
+    # state could be rebound — the serving layer plus the harnesses and
+    # tools that assemble session-enabled engines.
+    "sessionstate_scope": (
+        "igaming_platform_tpu/serve/", "benchmarks/", "tools/",
+    ),
 }
 
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"

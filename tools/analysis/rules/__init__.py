@@ -6,4 +6,5 @@ from tools.analysis.rules import locks as _locks  # noqa: PY01
 from tools.analysis.rules import metrics as _metrics  # noqa: PY01
 from tools.analysis.rules import paramswap as _paramswap  # noqa: PY01
 from tools.analysis.rules import replaydet as _replaydet  # noqa: PY01
+from tools.analysis.rules import sessionstate as _sessionstate  # noqa: PY01
 from tools.analysis.rules import robustness as _robustness  # noqa: PY01

@@ -89,6 +89,10 @@ class _EngineCache:
             params=params,
             batcher_config=BatcherConfig(batch_size=self.batch,
                                          max_wait_ms=1.0),
+            # Replay engines re-score recorded snapshots; session windows
+            # are verified separately (verify_session_chain) from ledger
+            # event order, never by mutating live session state here.
+            session_state=False,
         )
 
     def get_for(self, backend: str, fp: str):
@@ -177,6 +181,143 @@ def _recorded_fields(r) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# Stateful decisions: session-window reconstruction + hash verification
+
+
+def verify_session_chain(records, *, max_samples: int = 10,
+                         twin_keep: int = 64) -> dict:
+    """Reconstruct every session-scored decision's post-append window
+    from LEDGER EVENT ORDER alone and verify its ``session_state_hash``
+    bit-exact (serve/session_state.py is the other side of the
+    contract).
+
+    ``records`` is the WAL-ordered decision stream. Consecutive records
+    sharing a decision-batch prefix form one CHUNK — one fused dispatch,
+    one batch-snapshot append unit: every row's window is computed from
+    the chunk-start twin state (duplicate accounts included), then all
+    events commit in row order, exactly as the serving side did.
+
+    The recorded per-account event sequence number makes the pass
+    self-synchronizing: ``seq == 1`` with a non-empty twin means the
+    server lost its session index (SIGKILL restart / engine rebuild) —
+    the twin resets and verification continues. A forward seq jump is a
+    chain gap (a dropped ledger row): counted, that row unverifiable,
+    the twin resyncs at the recorded seq. Eviction never resets the
+    chain — the host session index survives it by design.
+    """
+    from igaming_platform_tpu.serve.session_state import (
+        encode_events_host,
+        window_hash,
+    )
+    from igaming_platform_tpu.serve.wire import TX_TYPE_CODES
+
+    twins: dict[str, dict] = {}
+    stats = {
+        "session_records": 0, "session_verified": 0,
+        "session_hash_mismatch": 0, "session_chain_gaps": 0,
+        "session_resets": 0, "session_reordered": 0,
+        "session_mismatch_samples": [],
+    }
+
+    def _twin(acct: str) -> dict:
+        tw = twins.get(acct)
+        if tw is None:
+            tw = {"events": [], "seq": 0, "last_ts": 0.0}
+            twins[acct] = tw
+        return tw
+
+    def flush_chunk(chunk) -> None:
+        # Batch-start snapshot per account. A chunk whose first
+        # occurrence for an account carries seq == 1 against a non-empty
+        # chain is a server-side session-index reset (SIGKILL restart /
+        # engine rebuild): the snapshot truncates and the chain follows.
+        snap: dict[str, dict] = {}
+        occ: dict[str, int] = {}
+        for rec in chunk:
+            a = rec.account_id
+            if a not in snap:
+                tw = _twin(a)
+                s = {"events": list(tw["events"]), "seq": tw["seq"],
+                     "last_ts": tw["last_ts"], "reset": False}
+                if rec.session_seq == 1 and tw["seq"] != 0:
+                    stats["session_resets"] += 1
+                    s = {"events": [], "seq": 0, "last_ts": 0.0,
+                         "reset": True}
+                snap[a] = s
+        # Verify every row against the snapshot (batch semantics), while
+        # computing the event row it contributes.
+        committed: list = []  # (account_id, event, seq, ts)
+        for rec in chunk:
+            stats["session_records"] += 1
+            s = snap[rec.account_id]
+            k = occ.get(rec.account_id, 0)
+            occ[rec.account_id] = k + 1
+            expected = s["seq"] + k + 1
+            dt = (0.0 if s["seq"] == 0
+                  else max(0.0, rec.ts_unix - s["last_ts"]))
+            code = TX_TYPE_CODES.get(rec.tx_type, 4)
+            event = encode_events_host([rec.amount], [code], [dt])[0]
+            committed.append((rec.account_id, event, rec.session_seq,
+                              rec.ts_unix))
+            hist = rec.session_len - 1
+            if rec.session_seq != expected:
+                if rec.session_seq > expected:
+                    stats["session_chain_gaps"] += 1
+                else:
+                    stats["session_reordered"] += 1
+                continue
+            if len(s["events"]) < hist:
+                stats["session_chain_gaps"] += 1
+                continue
+            window = s["events"][len(s["events"]) - hist:] + [event]
+            redo = window_hash(np.stack(window)).hex()
+            if redo == rec.session_hash:
+                stats["session_verified"] += 1
+            else:
+                stats["session_hash_mismatch"] += 1
+                if len(stats["session_mismatch_samples"]) < max_samples:
+                    stats["session_mismatch_samples"].append({
+                        "decision_id": rec.decision_id,
+                        "account_id": rec.account_id,
+                        "session_seq": rec.session_seq,
+                        "session_len": rec.session_len,
+                        "recorded": rec.session_hash,
+                        "recomputed": redo,
+                    })
+        # Commit in row order (the append half of the batch-snapshot
+        # semantics), adopting recorded seqs so a gap resyncs forward
+        # instead of cascading mismatches.
+        reset_done: set[str] = set()
+        for a, event, seq, ts in committed:
+            tw = _twin(a)
+            if snap[a]["reset"] and a not in reset_done:
+                tw["events"] = []
+                reset_done.add(a)
+            tw["events"].append(event)
+            del tw["events"][:-twin_keep]
+            tw["seq"] = seq
+            tw["last_ts"] = ts
+
+    chunk: list = []
+    prefix = None
+    for rec in records:
+        if not rec.session_hash:
+            continue
+        p = rec.decision_id.rsplit(".", 1)[0]
+        if prefix is not None and p != prefix and chunk:
+            flush_chunk(chunk)
+            chunk = []
+        prefix = p
+        chunk.append(rec)
+    if chunk:
+        flush_chunk(chunk)
+    stats["session_ok"] = (
+        stats["session_hash_mismatch"] == 0
+        and stats["session_reordered"] == 0)
+    return stats
+
+
 def replay_directory(directory: str, *, batch: int = 256,
                      checkpoint: str | None = None,
                      vault_dir: str | None = None,
@@ -251,6 +392,13 @@ def replay_directory(directory: str, *, batch: int = 256,
     finally:
         engines.close()
 
+    # Stateful decisions: reconstruct session windows from ledger event
+    # order and verify every session_state_hash bit-exact — this covers
+    # exactly the index-mode records the snapshot replay must skip, so
+    # between the two passes every decision is either re-scored or its
+    # mutable-state input proven.
+    session = verify_session_chain(records)
+
     replayed = sum(replayed_by_tier.values())
     return {
         "metric": "decision_replay_bit_exact",
@@ -262,6 +410,7 @@ def replay_directory(directory: str, *, batch: int = 256,
         "skipped_no_snapshot": skipped_no_snapshot,
         "params_fingerprint_mismatch": params_mismatch,
         "params_vault": vault_dir,
+        **session,
         "promotions": [{
             "event": p.event, "old_fp": p.old_fp, "new_fp": p.new_fp,
             "reason": p.reason, "ts": round(p.ts_unix, 3),
@@ -269,7 +418,9 @@ def replay_directory(directory: str, *, batch: int = 256,
         "fields_compared": list(_COMPARE_FIELDS),
         "mismatches": len(mismatches),
         "mismatch_samples": mismatches[:max_mismatch_samples],
-        "ok": (not mismatches and params_mismatch == 0 and replayed > 0),
+        "ok": (not mismatches and params_mismatch == 0
+               and (replayed > 0 or session["session_verified"] > 0)
+               and session["session_ok"]),
     }
 
 
