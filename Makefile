@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-fast lint lint-json lint-update-baseline bench bench-all bench-paced bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos soak-chaos-ledger soak-slo soak-online soak-drift soak-session soak-deadline replay-verify fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
+.PHONY: all test test-fast lint lint-json lint-changed lint-sarif lint-update-baseline bench bench-all bench-paced bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos soak-chaos-ledger soak-slo soak-online soak-drift soak-session soak-deadline replay-verify fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
 
 all: native test
 
@@ -24,6 +24,15 @@ lint:
 
 lint-json:
 	$(PY) -m tools.analysis --format=json
+
+# Incremental mode: findings only in git-changed files (cross-file rules
+# still see the whole repo; stale-baseline enforcement skipped).
+lint-changed:
+	$(PY) -m tools.analysis --changed-only
+
+# SARIF 2.1.0 for CI inline annotation (deterministic, golden-pinned).
+lint-sarif:
+	$(PY) -m tools.analysis --format=sarif
 
 lint-update-baseline:
 	$(PY) -m tools.analysis --update-baseline

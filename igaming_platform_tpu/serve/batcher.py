@@ -112,7 +112,7 @@ class CollectorPipeline:
             raise self._errors[0]
         while True:
             try:
-                self._queue.put(item, timeout=0.1)
+                self._queue.put(item, timeout=0.1)  # noqa: MX07 — deliberate bounded backpressure; the timeout re-checks collector errors so a dead collector can never wedge the producer
                 return
             except queue.Full:
                 if self._errors:
@@ -131,7 +131,7 @@ class CollectorPipeline:
             self._closed = True
             while True:
                 try:
-                    self._queue.put(_SENTINEL, timeout=0.1)
+                    self._queue.put(_SENTINEL, timeout=0.1)  # noqa: MX07 — shutdown sentinel delivery; bounded wait with a dead-thread escape, not a scoring hand-off
                     break
                 except queue.Full:
                     if not self._thread.is_alive():

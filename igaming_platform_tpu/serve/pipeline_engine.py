@@ -255,7 +255,7 @@ class HostPipeline:
             for idx, lo in enumerate(range(0, total, batch)):
                 # Blocks when the stage queue is full — backpressure on
                 # the gRPC caller, same as the admission gate's intent.
-                self._stage_q.put((job, idx, lo, min(lo + batch, total)))
+                self._stage_q.put((job, idx, lo, min(lo + batch, total)))  # noqa: MX07 — deliberate bounded backpressure on the gRPC caller (admission-gate intent), never a silent drop
             job.future.result()  # all chunks read back (or job failed)
             return self._encode_job(job)
         finally:
@@ -330,7 +330,7 @@ class HostPipeline:
                     self._stage_alive -= 1
                     last = self._stage_alive == 0
                 if last:
-                    self._inflight_q.put(_SENTINEL)
+                    self._inflight_q.put(_SENTINEL)  # noqa: MX07 — shutdown sentinel from the last stage worker; blocking is correct (the readback worker must outlive every producer)
                 return
             job, idx, lo, hi = item
             if job.failed:
@@ -350,7 +350,7 @@ class HostPipeline:
                 self.batches += 1
             # Blocks at `depth` batches in flight: the device stays <=
             # depth steps ahead of readback (bounded memory, ping-pong).
-            self._inflight_q.put(
+            self._inflight_q.put(  # noqa: MX07 — the bounded in-flight window IS the ping-pong: blocking at depth is the design, not an accident
                 (job, idx, lo, hi - lo, out, xp_buf, bl_buf, t0))
 
     # -- readback worker -----------------------------------------------------
@@ -405,7 +405,7 @@ class HostPipeline:
                 return
             self._closed = True
         for _ in self._stage_threads:
-            self._stage_q.put(_SENTINEL)
+            self._stage_q.put(_SENTINEL)  # noqa: MX07 — shutdown sentinel; pending chunks are already queued ahead of it, blocking delivery is the drain contract
         for t in self._stage_threads:
             t.join(timeout=30)
         self._readback_worker.join(timeout=30)

@@ -1,0 +1,60 @@
+"""Seeded CC09 violations: a declared scoring path that never reaches a
+mandatory seam, and an unregistered scoring-terminal function. The
+contract table below is the file-local analog of the repo's
+REPO_CONFIG["seam_contracts"] (tools/analysis/driver.py)."""
+
+ANALYSIS_SEAM_CONTRACT = {
+    "seams": {
+        "ledger": ("note_decisions",),
+        "drift": ("note_drift",),
+    },
+    "paths": {
+        "good": ("GoodEngine.score_rows",),
+        "forgetful": ("ForgetfulEngine.score_rows",),
+    },
+    "exempt": ("degraded_rows",),
+    "cover_files": ("cc/seams.py",),
+    "terminal_calls": ("encode_rows",),
+}
+
+
+def note_decisions(out):
+    return "prefix"
+
+
+def note_drift(out):
+    return None
+
+
+def encode_rows(out):
+    return b""
+
+
+def degraded_rows(rows):
+    # The heuristic tier: declared exempt in the contract table, never
+    # silently in code.
+    return encode_rows(rows)
+
+
+class GoodEngine:
+    def score_rows(self, rows):
+        out = self._launch(rows)
+        note_decisions(out)
+        return encode_rows(out)
+
+    def _launch(self, rows):
+        note_drift(rows)
+        return rows
+
+
+class ForgetfulEngine:
+    def score_rows(self, rows):  # expect: CC09
+        out = list(rows)
+        note_decisions(out)
+        return encode_rows(out)
+
+
+def rogue_path(rows):  # expect: CC09
+    # A scoring path nobody registered: reaches the encoder without
+    # appearing in the contract table or the exempt list.
+    return encode_rows(rows)

@@ -1,0 +1,45 @@
+"""Seeded JX05 violations: buffers read after being passed in a donated
+argument position. The echo pattern (rebinding to the echoed output) and
+post-read releases are the compliant controls and must stay quiet."""
+
+import jax
+
+
+class DonorEngine:
+    def __init__(self, fn):
+        # Attribute binding: donation metadata registers by attr name and
+        # is recognized at call sites in ANY scanned file (see
+        # jx/donate_caller.py for the cross-file misuse).
+        self._step = jax.jit(fn, donate_argnums=(0,))
+
+    def bad_launch(self, batch, thresholds):
+        out, echo = self._step(batch, thresholds)
+        total = batch.sum()  # expect: JX05
+        return out, total
+
+    def bad_branch(self, batch, thresholds, flag):
+        out, echo = self._step(batch, thresholds)
+        if flag:
+            return out
+        return batch  # expect: JX05
+
+    def good_echo(self, batch, thresholds):
+        out, echo = self._step(batch, thresholds)
+        # Sanctioned: the echo IS the batch — XLA aliased the output
+        # onto the donated buffer; reading the echo is reading the
+        # recycled staging slot.
+        return out, echo.sum()
+
+    def good_rebind_loop(self, batch, thresholds):
+        out = None
+        for _ in range(4):
+            # Rebinding the donated name to the echoed output each
+            # iteration keeps the next dispatch legal.
+            out, batch = self._step(batch, thresholds)
+        return out
+
+    def good_fresh_each_time(self, make_batch, thresholds):
+        for _ in range(4):
+            batch = make_batch()
+            self._step(batch, thresholds)
+        return None

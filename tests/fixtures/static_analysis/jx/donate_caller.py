@@ -1,0 +1,40 @@
+"""Cross-file JX05: the donation registered in jx/donate.py resolves
+here by attribute name (no import needed — the lock-graph name-matching
+trade-off), and ArenaPool buffers released back to the pool are dead."""
+
+
+class ArenaPool:
+    """Stand-in with the real arena's acquire/release surface."""
+
+    def acquire(self, shape):
+        return bytearray(shape)
+
+    def release(self, buf):
+        return None
+
+
+class StagePool:
+    def __init__(self):
+        self._arena = ArenaPool()
+
+    def bad_recycle(self, n):
+        buf = self._arena.acquire(n)
+        self._arena.release(buf)
+        buf[0] = 1  # expect: JX05
+        return buf  # expect: JX05
+
+    def good_release_after_use(self, n):
+        buf = self._arena.acquire(n)
+        buf[0] = 1
+        self._arena.release(buf)
+        return None
+
+
+def cross_file_misuse(eng, batch, thresholds):
+    out, echo = eng._step(batch, thresholds)
+    return out, batch  # expect: JX05
+
+
+def cross_file_echo(eng, batch, thresholds):
+    out, echo = eng._step(batch, thresholds)
+    return out, echo

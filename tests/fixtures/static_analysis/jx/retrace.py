@@ -1,0 +1,71 @@
+"""Seeded JX06 violations: jit construction in loops / hot-loop
+functions, Python-varying static arguments, and implicit host syncs on
+device arrays in hot-loop code. Loop-invariant statics, readback through
+device_get and attribute probes (hasattr) are the compliant controls."""
+
+import functools
+
+import jax
+
+
+def rebuild_per_batch(fns, x):
+    outs = []
+    for f in fns:
+        step = jax.jit(f)  # expect: JX06
+        outs.append(step(x))
+    return outs
+
+
+def hot_rebuild(f, x):  # analysis: hot-loop
+    step = jax.jit(f)  # expect: JX06
+    return step(x)
+
+
+def build_once(fns):
+    # Construction at init time (no loop, not a hot loop) is the
+    # sanctioned shape.
+    return [jax.jit(f) for f in fns]
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def topk_step(x, k):
+    return x * k
+
+
+def bad_sweep(xs):
+    out = []
+    for i, x in enumerate(xs):
+        out.append(topk_step(x, k=i))  # expect: JX06
+    return out
+
+
+def good_fixed_static(xs, k):
+    out = []
+    for x in xs:
+        out.append(topk_step(x, k=k))  # loop-invariant static: fine
+    return out
+
+
+class SyncEngine:
+    def __init__(self, fn):
+        self._fn = jax.jit(fn)
+
+    def bad_hot_step(self, x):  # analysis: hot-loop
+        out = self._fn(x)
+        if out:  # expect: JX06
+            return None
+        return float(out)  # expect: JX06
+
+    def good_hot_step(self, x):  # analysis: hot-loop
+        out = self._fn(x)
+        if hasattr(out, "copy_to_host_async"):
+            out.copy_to_host_async()
+        host = jax.device_get(out)
+        if host > 0:  # host value: the sync already happened at the seam
+            return host
+        return None
+
+    def cold_inspect(self, x):
+        # Not a hot loop: debugging/benchmark code may coerce freely.
+        out = self._fn(x)
+        return bool(out)

@@ -1,0 +1,53 @@
+"""Seeded MX07 violations: scoring-path hand-offs that block, grow
+without bound, or drop without counting. The bounded ring, the counted
+queue.Full handler, the guarded-append idiom and the off-path function
+are the compliant controls."""
+
+import queue
+from collections import deque
+
+ANALYSIS_SEAM_CONTRACT = {
+    "paths": {
+        "wire": ("Pipeline.submit_batch", "Pipeline.worker_loop"),
+    },
+}
+
+_OFFLINE_Q = queue.Queue()
+
+
+class Pipeline:
+    def __init__(self):
+        self._stage_q = queue.Queue(8)
+        self._free_q = queue.Queue()  # unbounded
+        self._pending = deque()  # unbounded
+        self._ring = deque(maxlen=64)  # bounded ring: compliant
+        self.queue_max = 128
+        self.dropped = 0
+
+    def submit_batch(self, item):
+        self._stage_q.put(item)  # expect: MX07
+        self._free_q.put_nowait(item)  # expect: MX07
+        self._pending.append(item)  # expect: MX07
+        self._ring.append(item)
+        try:
+            self._stage_q.put_nowait(item)
+        except queue.Full:
+            self.dropped += 1  # counted drop: compliant
+        self._helper(item)
+
+    def worker_loop(self, item):
+        # The guarded-append idiom (what the ledger/shadow/drift queues
+        # do): bound compared, drop counted in the other branch.
+        if len(self._pending) >= self.queue_max:
+            self.dropped += 1
+        else:
+            self._pending.append(item)
+
+    def _helper(self, item):
+        self._stage_q.put_nowait(item)  # expect: MX07
+
+
+def offline_backfill(item):
+    # Not reachable from any declared scoring path: MX07 stays quiet —
+    # offline tooling may block as long as it likes.
+    _OFFLINE_Q.put(item)

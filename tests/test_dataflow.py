@@ -1,0 +1,475 @@
+"""Tier-1 tests for the dataflow layer (tools/analysis/dataflow.py) and
+the analyzer features that ride it: CFG construction and reaching
+definitions on hand-built snippets, poison flow (use-after-X), the
+donation registry's name-matching rules, the generic call graph, the
+seam-contract machinery in explicit-path mode, --changed-only
+incremental filtering, SARIF output against its golden file, and the
+registration-order-independent output ordering (the PR 13 bugfix).
+
+Regenerate the SARIF golden after deliberate rule-catalog changes:
+
+    python -m tools.analysis tests/fixtures/static_analysis/py \
+        --format=sarif > tests/golden/analysis_sarif.json
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from pathlib import Path
+
+from tools.analysis import dataflow
+from tools.analysis.driver import (
+    _discover_paths,
+    build_project,
+    main as cli_main,
+    run_analysis,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+GOLDEN = REPO / "tests" / "golden" / "analysis_sarif.json"
+PY_FIXTURES = REPO / "tests" / "fixtures" / "static_analysis" / "py"
+
+
+def _fn(src: str) -> ast.AST:
+    tree = ast.parse(src)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return node
+    raise AssertionError("no function in snippet")
+
+
+def _project(tmp_path: Path, files: dict[str, str]):
+    for name, src in files.items():
+        p = tmp_path / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return build_project(_discover_paths([tmp_path]))[0]
+
+
+# ---------------------------------------------------------------------------
+# CFG
+
+
+def test_cfg_if_join_and_exit_edges():
+    cfg = dataflow.function_cfg(_fn(
+        "def f(a):\n"
+        "    x = 1\n"
+        "    if a:\n"
+        "        x = 2\n"
+        "    else:\n"
+        "        x = 3\n"
+        "    return x\n"))
+    returns = [n for n in cfg.nodes if isinstance(n.stmt, ast.Return)]
+    assert len(returns) == 1
+    # Both branch arms flow into the return; the return reaches exit.
+    assert len(returns[0].preds) == 2
+    assert cfg.exit in returns[0].succs
+
+
+def test_cfg_while_has_back_edge_and_break_exits_loop():
+    cfg = dataflow.function_cfg(_fn(
+        "def f(a):\n"
+        "    while a:\n"
+        "        a -= 1\n"
+        "        if a == 3:\n"
+        "            break\n"
+        "    return a\n"))
+    head = next(n for n in cfg.nodes if n.kind == "loop")
+    body = next(n for n in cfg.nodes if isinstance(n.stmt, ast.AugAssign))
+    assert head.id in body.succs or any(
+        head.id in cfg.nodes[s].succs for s in body.succs)  # back edge
+    brk = next(n for n in cfg.nodes if isinstance(n.stmt, ast.Break))
+    ret = next(n for n in cfg.nodes if isinstance(n.stmt, ast.Return))
+    assert ret.id in brk.succs  # break jumps past the loop
+
+
+def test_cfg_try_handler_reachable_from_inside_body():
+    cfg = dataflow.function_cfg(_fn(
+        "def f(q):\n"
+        "    try:\n"
+        "        a = q.get()\n"
+        "        b = q.get()\n"
+        "    except Exception:\n"
+        "        c = 1\n"
+        "    return 0\n"))
+    handler = next(n for n in cfg.nodes
+                   if isinstance(n.stmt, ast.Assign)
+                   and n.stmt.targets[0].id == "c")
+    # Conservative: the handler is a successor of every try-body node.
+    body_ids = {n.id for n in cfg.nodes
+                if isinstance(n.stmt, ast.Assign)
+                and n.stmt.targets[0].id in ("a", "b")}
+    assert body_ids <= handler.preds
+
+
+def test_cfg_code_after_return_is_unreachable():
+    cfg = dataflow.function_cfg(_fn(
+        "def f():\n"
+        "    return 1\n"
+        "    x = 2\n"))
+    assert not any(isinstance(n.stmt, ast.Assign) for n in cfg.nodes)
+
+
+# ---------------------------------------------------------------------------
+# Reaching definitions
+
+
+def test_reaching_defs_branch_join_merges_both_defs():
+    cfg = dataflow.function_cfg(_fn(
+        "def f(a):\n"
+        "    x = 1\n"
+        "    if a:\n"
+        "        x = 2\n"
+        "    return x\n"))
+    rd = dataflow.ReachingDefs(cfg)
+    ret = next(n for n in cfg.nodes if isinstance(n.stmt, ast.Return))
+    sites = rd.defs_in(ret.id)["x"]
+    lines = {cfg.nodes[s].lineno for s in sites}
+    assert lines == {2, 4}  # both x = 1 and x = 2 reach the return
+
+
+def test_reaching_defs_loop_var_defined_at_head():
+    cfg = dataflow.function_cfg(_fn(
+        "def f(xs):\n"
+        "    for i in xs:\n"
+        "        y = i\n"
+        "    return y\n"))
+    rd = dataflow.ReachingDefs(cfg)
+    body = next(n for n in cfg.nodes if isinstance(n.stmt, ast.Assign))
+    assert "i" in rd.defs_in(body.id)
+    head = next(n for n in cfg.nodes if n.kind == "loop")
+    assert rd.defs_in(body.id)["i"] == frozenset({head.id})
+
+
+def test_reaching_defs_kill_replaces_earlier_def():
+    cfg = dataflow.function_cfg(_fn(
+        "def f():\n"
+        "    x = 1\n"
+        "    x = 2\n"
+        "    return x\n"))
+    rd = dataflow.ReachingDefs(cfg)
+    ret = next(n for n in cfg.nodes if isinstance(n.stmt, ast.Return))
+    assert {cfg.nodes[s].lineno for s in rd.defs_in(ret.id)["x"]} == {3}
+
+
+# ---------------------------------------------------------------------------
+# Poison flow
+
+
+def _poison(src: str, poison_line: int, symbol: str):
+    """Poison `symbol` after the node at `poison_line`; return read lines."""
+    cfg = dataflow.function_cfg(_fn(src))
+    gens = {}
+    for node in cfg.nodes:
+        if node.lineno == poison_line:
+            gens[node.id] = {symbol: (poison_line, "donated")}
+    assert gens, "poison line not found in CFG"
+    return [f.lineno for f in dataflow.poison_flow(cfg, gens)]
+
+
+def test_poison_read_after_fires_and_rebind_clears():
+    src = (
+        "def f(step, x):\n"
+        "    out = step(x)\n"     # poison x after line 2
+        "    y = x + 1\n"         # read -> finding
+        "    x = out\n"           # rebind clears
+        "    return x\n")         # clean
+    assert _poison(src, 2, "x") == [3]
+
+
+def test_poison_flows_through_one_branch_only():
+    src = (
+        "def f(step, x, flag):\n"
+        "    if flag:\n"
+        "        step(x)\n"       # poison on this path only
+        "    else:\n"
+        "        x = 0\n"
+        "    return x\n")         # reachable poisoned via the then-branch
+    assert _poison(src, 3, "x") == [6]
+
+
+def test_poison_dotted_symbol_cleared_by_base_method_call():
+    src = (
+        "def f(step, mgr):\n"
+        "    r = step(mgr.ring)\n"   # poison mgr.ring
+        "    mgr.adopt(r)\n"         # base call conservatively clears
+        "    return mgr.ring\n")
+    assert _poison(src, 2, "mgr.ring") == []
+
+
+def test_poison_subscript_store_counts_as_read():
+    src = (
+        "def f(pool, buf):\n"
+        "    pool.release(buf)\n"
+        "    buf[0] = 1\n")
+    assert _poison(src, 2, "buf") == [3]
+
+
+def test_poison_survives_loop_back_edge_without_rebind():
+    src = (
+        "def f(step, x, xs):\n"
+        "    for _ in xs:\n"
+        "        step(x)\n")   # second iteration reads poisoned x
+    assert _poison(src, 3, "x") == [3]
+
+
+def test_jx05_session_ring_shape_is_the_acid_test(tmp_path):
+    """The PR 12 session-ring warmup shape: ring/cursor/length donated
+    every loop iteration. With mgr.adopt() rebinding the triple, the
+    loop analyzes clean; forget the adopt and the next iteration reads
+    donated buffers — JX05 fires."""
+    good = (
+        "import jax\n"
+        "class Eng:\n"
+        "    def __init__(self, step):\n"
+        "        self._session_fn = jax.jit(step, donate_argnums=(1, 2, 3))\n"
+        "    def warm(self, mgr, shapes, params):\n"
+        "        for shape in shapes:\n"
+        "            out, r2, c2, l2 = self._session_fn(\n"
+        "                params, mgr.session_ring, mgr.session_cursor,\n"
+        "                mgr.session_length)\n"
+        "            mgr.adopt(r2, c2, l2)\n")
+    report = run_analysis([_write(tmp_path, "m.py", good)])
+    assert [f.rule for f in report.new] == []
+    bad = good.replace("            mgr.adopt(r2, c2, l2)\n", "")
+    (tmp_path / "m.py").write_text(bad)
+    report = run_analysis([tmp_path])
+    assert "JX05" in {f.rule for f in report.new}
+    assert any("session_ring" in f.message for f in report.new)
+
+
+# ---------------------------------------------------------------------------
+# Donation registry name matching
+
+
+def test_registry_attr_binding_matches_cross_file(tmp_path):
+    project = _project(tmp_path, {
+        "a.py": "import jax\n\nclass E:\n    def __init__(self, fn):\n"
+                "        self._step = jax.jit(fn, donate_argnums=(0,))\n",
+        "b.py": "def use(eng, x):\n    return eng._step(x)\n",
+    })
+    reg = dataflow.donation_registry(project)
+    call = ast.parse("eng._step(x)").body[0].value
+    info = reg.lookup(call, "b.py")
+    assert info is not None and info.donate_positions == frozenset({0})
+
+
+def test_registry_name_binding_is_file_local(tmp_path):
+    project = _project(tmp_path, {
+        "a.py": "import jax\nfn = jax.jit(lambda x: x, donate_argnums=(0,))\n",
+        "b.py": "import jax\nfn = jax.jit(lambda x: x)\n",
+    })
+    reg = dataflow.donation_registry(project)
+    call = ast.parse("fn(x)").body[0].value
+    assert reg.lookup(call, "a.py").donate_positions == frozenset({0})
+    # Same name in another file: its OWN (donation-free) binding, never
+    # a.py's metadata.
+    assert reg.lookup(call, "b.py").donate_positions == frozenset()
+    assert reg.lookup(call, "c.py") is None
+
+
+def test_registry_static_argnames_resolved_to_positions(tmp_path):
+    project = _project(tmp_path, {
+        "a.py": "import jax\n\ndef step(x, k):\n    return x\n\n"
+                "run = jax.jit(step, static_argnames=('k',))\n",
+    })
+    reg = dataflow.donation_registry(project)
+    call = ast.parse("run(x, 3)").body[0].value
+    info = reg.lookup(call, "a.py")
+    assert info.static_names == frozenset({"k"})
+    assert info.static_positions == frozenset({1})
+
+
+# ---------------------------------------------------------------------------
+# Call graph
+
+
+_GRAPH_FILES = {
+    "mod_a.py": (
+        "from mod_b import helper\n"
+        "import mod_b\n"
+        "\n"
+        "class Engine:\n"
+        "    def entry(self):\n"
+        "        self.inner()\n"
+        "        helper()\n"
+        "        mod_b.direct()\n"
+        "\n"
+        "    def inner(self):\n"
+        "        def nested():\n"
+        "            seam_call()\n"
+        "        nested()\n"
+        "\n"
+        "def seam_call():\n"
+        "    return None\n"
+    ),
+    "mod_b.py": (
+        "def helper():\n"
+        "    return None\n"
+        "\n"
+        "def direct():\n"
+        "    return None\n"
+        "\n"
+        "class Other:\n"
+        "    def by_name_only(self):\n"
+        "        return None\n"
+    ),
+}
+
+
+def test_call_graph_resolution_and_reachability(tmp_path):
+    project = _project(tmp_path, _GRAPH_FILES)
+    graph = dataflow.call_graph(project)
+    entry = graph.lookup("mod_a.py", "Engine.entry")
+    assert entry is not None
+    reach = graph.reachable_from([entry])
+    quals = {q for _, q in reach}
+    assert "Engine.inner" in quals          # self.<m>() exact
+    assert "helper" in quals                # from-import exact
+    assert "direct" in quals                # module-alias attribute exact
+    assert "Engine.inner.nested" in quals   # nested defs are children
+    assert graph.reaches_name(reach, ("seam_call",))  # via the closure
+    assert "Other.by_name_only" not in quals
+
+
+def test_call_graph_name_based_attr_fallback(tmp_path):
+    project = _project(tmp_path, {
+        "a.py": "def entry(obj):\n    obj.by_name_only()\n",
+        "b.py": "class Other:\n    def by_name_only(self):\n"
+                "        target_seam()\n\ndef target_seam():\n    return 1\n",
+    })
+    graph = dataflow.call_graph(project)
+    entry = graph.lookup("a.py", "entry")
+    reach = graph.reachable_from([entry])
+    assert graph.reaches_name(reach, ("target_seam",))
+
+
+# ---------------------------------------------------------------------------
+# Seam contracts (explicit-path mode) — drift and MX07 idioms
+
+
+def test_contract_unknown_member_is_a_finding(tmp_path):
+    report = run_analysis([_write(tmp_path, "m.py", (
+        "ANALYSIS_SEAM_CONTRACT = {\n"
+        "    'seams': {'ledger': ('note',)},\n"
+        "    'paths': {'p': ('NoSuchEngine.run',)},\n"
+        "}\n"
+        "def note():\n"
+        "    return None\n"))])
+    assert [f.rule for f in report.new] == ["CC09"]
+    assert "unknown function" in report.new[0].message
+
+
+def test_mx07_blocking_put_and_unbounded_deque(tmp_path):
+    report = run_analysis([_write(tmp_path, "m.py", (
+        "import queue\n"
+        "from collections import deque\n"
+        "ANALYSIS_SEAM_CONTRACT = {'paths': {'p': ('Eng.run',)}}\n"
+        "class Eng:\n"
+        "    def __init__(self):\n"
+        "        self._q = queue.Queue(4)\n"
+        "        self._d = deque()\n"
+        "    def run(self, item):\n"
+        "        self._q.put(item)\n"
+        "        self._d.append(item)\n"))])
+    # The contract declares no seams -> CC09 stays quiet; the blocking
+    # put and the unguarded unbounded-deque append each fire MX07.
+    assert [(f.rule, f.line) for f in report.new] == [
+        ("MX07", 9), ("MX07", 10)]
+
+
+def _write(tmp_path: Path, name: str, src: str) -> Path:
+    p = tmp_path / name
+    p.write_text(src)
+    return p
+
+
+def test_mx07_counted_drop_and_guarded_idiom_are_compliant(tmp_path):
+    report = run_analysis([_write(tmp_path, "m.py", (
+        "import queue\n"
+        "from collections import deque\n"
+        "ANALYSIS_SEAM_CONTRACT = {'paths': {'p': ('Eng.run',)}}\n"
+        "class Eng:\n"
+        "    def __init__(self):\n"
+        "        self._q = queue.Queue(4)\n"
+        "        self._d = deque()\n"
+        "        self.limit = 8\n"
+        "        self.dropped = 0\n"
+        "    def run(self, item):\n"
+        "        try:\n"
+        "            self._q.put_nowait(item)\n"
+        "        except queue.Full:\n"
+        "            self.dropped += 1\n"
+        "        if len(self._d) >= self.limit:\n"
+        "            self.dropped += 1\n"
+        "        else:\n"
+        "            self._d.append(item)\n"))])
+    assert [f.rule for f in report.new] == []
+
+
+# ---------------------------------------------------------------------------
+# --changed-only incremental mode
+
+
+def test_changed_only_filters_findings_and_skips_stale(tmp_path):
+    # Full run on two files -> findings in both; changed_only on one.
+    for name in ("one.py", "two.py"):
+        (tmp_path / name).write_text("x = 1\ny = x == None\n")
+    full = run_analysis([tmp_path])
+    assert sorted(f.path for f in full.new) == ["one.py", "two.py"]
+    partial = run_analysis([tmp_path], changed_only={"one.py"})
+    assert [f.path for f in partial.new] == ["one.py"]
+    assert partial.stale == []  # shrink-only not enforced incrementally
+    assert partial.files == 1
+
+
+# ---------------------------------------------------------------------------
+# SARIF
+
+
+def test_sarif_matches_golden(capsys):
+    assert cli_main([str(PY_FIXTURES), "--format=sarif"]) == 1
+    rendered = capsys.readouterr().out.strip()
+    assert rendered == GOLDEN.read_text().strip(), (
+        "SARIF output drifted from tests/golden/analysis_sarif.json — "
+        "if the change is deliberate, regenerate the golden (command in "
+        "this module's docstring)")
+
+
+def test_sarif_is_deterministic_and_wellformed(capsys):
+    cli_main([str(PY_FIXTURES), "--format=sarif"])
+    first = capsys.readouterr().out
+    cli_main([str(PY_FIXTURES), "--format=sarif"])
+    second = capsys.readouterr().out
+    assert first == second
+    doc = json.loads(first)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    rules = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert rules == sorted(rules)  # catalog in rule-id order
+    for result in run["results"]:
+        assert result["ruleId"] in set(rules)
+        loc = result["locations"][0]["physicalLocation"]
+        assert loc["region"]["startLine"] >= 1
+        assert result["partialFingerprints"]["analysisFingerprint/v1"]
+    keys = [(r["locations"][0]["physicalLocation"]["artifactLocation"]["uri"],
+             r["locations"][0]["physicalLocation"]["region"]["startLine"],
+             r["ruleId"]) for r in run["results"]]
+    assert keys == sorted(keys)
+
+
+# ---------------------------------------------------------------------------
+# Output ordering (the registration-order bugfix)
+
+
+def test_json_output_is_sorted_and_registration_independent(tmp_path, capsys):
+    (tmp_path / "m.py").write_text(
+        "import os\n"            # PY01
+        "x = 1\n"
+        "y = x == None\n")       # PY04
+    assert cli_main([str(tmp_path), "--format=json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    keys = [(f["path"], f["line"], f["rule"]) for f in payload["findings"]]
+    assert keys == sorted(keys)
+    assert list(payload["rules"]) == sorted(payload["rules"])

@@ -1,0 +1,803 @@
+"""Dataflow layer: per-function CFGs, reaching definitions, poison flow,
+and the project-wide registries the v2 rule families share.
+
+The v1 analyzer pattern-matched single statements; the four v2 rule
+families (JX05 use-after-donate, JX06 retrace/host-sync hazards, CC09
+mandatory-seam coverage, MX07 bounded-handoff discipline) all need
+*flow*: whether a read happens after a donation on some path, whether a
+static argument varies per loop iteration, whether a scoring entry point
+reaches the ledger seam through any chain of calls. This module provides
+that on top of the existing parse-once driver:
+
+- :func:`function_cfg` builds a statement-level control-flow graph for
+  one function (branches, loops with back edges, try/except with
+  conservative any-point handler edges, break/continue/return);
+- :class:`ReachingDefs` runs the classic forward reaching-definitions
+  fixpoint over a CFG (per-name def sites live at each node);
+- :func:`poison_flow` is a forward may-analysis for use-after-X rules:
+  given per-node "these symbols become poisoned after this node" facts,
+  it reports every later read on any path, with rebinds clearing the
+  poison path-sensitively — the PR 4 echo pattern (rebinding to the
+  echoed output) therefore analyzes clean by construction;
+- :class:`DonationRegistry` scans the whole project for
+  ``jax.jit(..., donate_argnums=...)`` bindings (names and
+  ``self.<attr>`` alike), static-argument declarations, and
+  ``ArenaPool`` attributes, so call sites in *other* files resolve by
+  the same conservative name matching the lock graph uses;
+- :class:`CallGraph` is the generic interprocedural reachability graph
+  composed with the same resolution rules as
+  :mod:`tools.analysis.jaxgraph` (exact self/name/import resolution,
+  module-alias attribute calls, name-based method fallback) — CC09's
+  must-reach and MX07's "on the scoring path" are queries against it.
+
+Symbols are plain names (``xp``) or dotted attribute paths
+(``mgr.session_ring``). A method call on — or a call passing — the base
+object of a dotted symbol conservatively clears its poison (the callee
+may rebind the attribute; a missed finding is better than an invented
+one, same stance as jaxgraph).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.analysis.engine import FileContext, ProjectContext, dotted_name
+
+# ---------------------------------------------------------------------------
+# Control-flow graph
+
+
+@dataclass
+class CFGNode:
+    id: int
+    stmt: ast.stmt | None  # anchoring statement (None for entry/exit)
+    exprs: tuple  # AST expressions evaluated AT this node
+    kind: str  # "entry" | "exit" | "stmt" | "branch" | "loop"
+    lineno: int = 0
+    succs: set[int] = field(default_factory=set)
+    preds: set[int] = field(default_factory=set)
+
+
+class CFG:
+    """Statement-level CFG of one function body."""
+
+    def __init__(self) -> None:
+        self.nodes: list[CFGNode] = []
+        self.entry = self._new(None, (), "entry")
+        self.exit = self._new(None, (), "exit")
+
+    def _new(self, stmt, exprs, kind) -> int:
+        node = CFGNode(len(self.nodes), stmt, tuple(exprs), kind,
+                       getattr(stmt, "lineno", 0) or 0)
+        self.nodes.append(node)
+        return node.id
+
+    def _edge(self, a: int, b: int) -> None:
+        self.nodes[a].succs.add(b)
+        self.nodes[b].preds.add(a)
+
+    def _edges(self, frm: list[int], to: int) -> None:
+        for a in frm:
+            self._edge(a, to)
+
+
+@dataclass
+class _LoopCtx:
+    head: int
+    breaks: list[int] = field(default_factory=list)
+
+
+def function_cfg(fn_node: ast.AST) -> CFG:
+    """CFG for a FunctionDef/AsyncFunctionDef/Lambda. Nested function
+    definitions are single opaque nodes (they get their own CFG)."""
+    cfg = CFG()
+    body = fn_node.body
+    if not isinstance(body, list):  # Lambda
+        nid = cfg._new(None, (body,), "stmt")
+        cfg.nodes[nid].lineno = body.lineno
+        cfg._edge(cfg.entry, nid)
+        cfg._edge(nid, cfg.exit)
+        return cfg
+    exits = _build_block(cfg, body, [cfg.entry], [])
+    cfg._edges(exits, cfg.exit)
+    return cfg
+
+
+def _build_block(cfg: CFG, stmts: list[ast.stmt], preds: list[int],
+                 loops: list[_LoopCtx]) -> list[int]:
+    """Wire ``stmts`` after ``preds``; returns the block's live exits."""
+    for stmt in stmts:
+        if not preds:
+            break  # unreachable code after return/raise/break
+        if isinstance(stmt, ast.If):
+            test = cfg._new(stmt, (stmt.test,), "branch")
+            cfg._edges(preds, test)
+            then = _build_block(cfg, stmt.body, [test], loops)
+            els = (_build_block(cfg, stmt.orelse, [test], loops)
+                   if stmt.orelse else [test])
+            preds = then + els
+        elif isinstance(stmt, ast.While):
+            head = cfg._new(stmt, (stmt.test,), "loop")
+            cfg._edges(preds, head)
+            ctx = _LoopCtx(head)
+            body_exits = _build_block(cfg, stmt.body, [head], loops + [ctx])
+            cfg._edges(body_exits, head)  # back edge
+            after = [head] + ctx.breaks
+            if stmt.orelse:
+                after = _build_block(cfg, stmt.orelse, [head], loops) + ctx.breaks
+            preds = after
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            head = cfg._new(stmt, (stmt.iter,), "loop")
+            cfg._edges(preds, head)
+            ctx = _LoopCtx(head)
+            body_exits = _build_block(cfg, stmt.body, [head], loops + [ctx])
+            cfg._edges(body_exits, head)
+            after = [head] + ctx.breaks
+            if stmt.orelse:
+                after = _build_block(cfg, stmt.orelse, [head], loops) + ctx.breaks
+            preds = after
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            nid = cfg._new(stmt, tuple(i.context_expr for i in stmt.items),
+                           "stmt")
+            cfg._edges(preds, nid)
+            preds = _build_block(cfg, stmt.body, [nid], loops)
+        elif isinstance(stmt, ast.Try):
+            first = len(cfg.nodes)
+            body_exits = _build_block(cfg, stmt.body, preds, loops)
+            body_nodes = list(range(first, len(cfg.nodes)))
+            handler_exits: list[int] = []
+            for handler in stmt.handlers:
+                # Conservative: control may jump to the handler from any
+                # point inside the try body (plus from before it).
+                h_preds = list(preds) + body_nodes
+                handler_exits += _build_block(cfg, handler.body, h_preds, loops)
+            if stmt.orelse:
+                body_exits = _build_block(cfg, stmt.orelse, body_exits, loops)
+            preds = body_exits + handler_exits
+            if stmt.finalbody:
+                preds = _build_block(cfg, stmt.finalbody, preds, loops)
+        elif isinstance(stmt, (ast.Return, ast.Raise)):
+            exprs = [e for e in (getattr(stmt, "value", None),
+                                 getattr(stmt, "exc", None)) if e is not None]
+            nid = cfg._new(stmt, exprs, "stmt")
+            cfg._edges(preds, nid)
+            cfg._edge(nid, cfg.exit)
+            preds = []
+        elif isinstance(stmt, ast.Break):
+            nid = cfg._new(stmt, (), "stmt")
+            cfg._edges(preds, nid)
+            if loops:
+                loops[-1].breaks.append(nid)
+            preds = []
+        elif isinstance(stmt, ast.Continue):
+            nid = cfg._new(stmt, (), "stmt")
+            cfg._edges(preds, nid)
+            if loops:
+                cfg._edge(nid, loops[-1].head)
+            preds = []
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            # Opaque: defines a name; body is its own scope/CFG.
+            nid = cfg._new(stmt, (), "stmt")
+            cfg._edges(preds, nid)
+            preds = [nid]
+        else:
+            exprs = [v for v in ast.iter_child_nodes(stmt)
+                     if isinstance(v, ast.expr)]
+            nid = cfg._new(stmt, exprs, "stmt")
+            cfg._edges(preds, nid)
+            preds = [nid]
+    return preds
+
+
+# ---------------------------------------------------------------------------
+# Per-node reads / defs
+
+
+def _sym(node: ast.AST) -> str | None:
+    """Name -> "x"; pure attribute chain -> "a.b.c"; else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return dotted_name(node)
+    return None
+
+
+def node_defs(node: CFGNode) -> set[str]:
+    """Symbols (re)bound at this node: assignment/loop/with targets,
+    imports, ``del``, nested def/class names, walrus targets."""
+    defs: set[str] = set()
+    stmt = node.stmt
+
+    def target(t: ast.AST) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                target(el)
+        elif isinstance(t, ast.Starred):
+            target(t.value)
+        else:
+            s = _sym(t)
+            if s is not None:
+                defs.add(s)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            target(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        target(stmt.target)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        target(stmt.target)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                target(item.optional_vars)
+    elif isinstance(stmt, ast.Delete):
+        for t in stmt.targets:
+            target(t)
+    elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+        for alias in stmt.names:
+            defs.add((alias.asname or alias.name).split(".")[0])
+    elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        defs.add(stmt.name)
+    for expr in node.exprs:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.NamedExpr) and isinstance(sub.target, ast.Name):
+                defs.add(sub.target.id)
+    return defs
+
+
+def node_reads(node: CFGNode) -> set[str]:
+    """Symbols read at this node: Name/attribute loads plus the base of
+    every subscript (``buf[0] = 1`` touches the buffer's memory — a read
+    for use-after purposes even in Store context)."""
+    reads: set[str] = set()
+
+    def visit(expr: ast.AST) -> None:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                reads.add(sub.id)
+            elif isinstance(sub, ast.Attribute):
+                s = dotted_name(sub)
+                if s is not None and isinstance(sub.ctx, ast.Load):
+                    reads.add(s)
+            elif isinstance(sub, ast.Subscript):
+                s = _sym(sub.value)
+                if s is not None:
+                    reads.add(s)
+
+    for expr in node.exprs:
+        visit(expr)
+    stmt = node.stmt
+    if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        for t in targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Subscript):
+                    s = _sym(sub.value)
+                    if s is not None:
+                        reads.add(s)
+        if isinstance(stmt, ast.AugAssign):
+            s = _sym(stmt.target)
+            if s is not None:
+                reads.add(s)
+    return reads
+
+
+def node_calls(node: CFGNode):
+    """Every Call expression evaluated at this node."""
+    for expr in node.exprs:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                yield sub
+
+
+# ---------------------------------------------------------------------------
+# Reaching definitions
+
+
+class ReachingDefs:
+    """Classic forward reaching-definitions over a CFG: for each node,
+    which def sites (CFG node ids) of each name may reach it. Dotted
+    symbols participate like names (an exact rebind kills)."""
+
+    def __init__(self, cfg: CFG):
+        self.cfg = cfg
+        gen: dict[int, set[str]] = {n.id: node_defs(n) for n in cfg.nodes}
+        self._in: dict[int, dict[str, frozenset[int]]] = {
+            n.id: {} for n in cfg.nodes}
+        out: dict[int, dict[str, frozenset[int]]] = {
+            n.id: {} for n in cfg.nodes}
+        work = [n.id for n in cfg.nodes]
+        while work:
+            nid = work.pop(0)
+            node = cfg.nodes[nid]
+            merged: dict[str, set[int]] = {}
+            for p in node.preds:
+                for name, sites in out[p].items():
+                    merged.setdefault(name, set()).update(sites)
+            self._in[nid] = {k: frozenset(v) for k, v in merged.items()}
+            new_out = dict(self._in[nid])
+            for name in gen[nid]:
+                new_out[name] = frozenset({nid})
+            if new_out != out[nid]:
+                out[nid] = new_out
+                for s in node.succs:
+                    if s not in work:
+                        work.append(s)
+
+    def defs_in(self, node_id: int) -> dict[str, frozenset[int]]:
+        """name -> def-site CFG node ids reaching the ENTRY of node_id.
+        A name absent from the dict is only ever bound at function entry
+        (parameter / free variable)."""
+        return self._in[node_id]
+
+
+# ---------------------------------------------------------------------------
+# Poison flow (use-after-X)
+
+
+@dataclass(frozen=True)
+class PoisonRead:
+    node_id: int
+    lineno: int
+    symbol: str
+    source_line: int
+    why: str
+
+
+def poison_flow(cfg: CFG, gens: dict[int, dict[str, tuple[int, str]]]
+                ) -> list[PoisonRead]:
+    """Forward may-analysis. ``gens[node_id]`` maps symbols that become
+    poisoned AFTER that node to ``(source_line, why)``. Returns every
+    read of a poisoned symbol on any path. Transfer order per node:
+    reads are checked against the incoming state (the poisoning call's
+    own arguments are not uses-after), then rebinds and base-object
+    calls clear, then the node's own gens apply."""
+    state_in: dict[int, dict[str, tuple[int, str]]] = {cfg.entry: {}}
+    findings: dict[tuple[int, str], PoisonRead] = {}
+    work = [cfg.entry]
+    seen_state: dict[int, dict] = {}
+    while work:
+        nid = work.pop(0)
+        node = cfg.nodes[nid]
+        state = dict(state_in.get(nid, {}))
+        if state:
+            for sym in node_reads(node) & set(state):
+                line, why = state[sym]
+                key = (node.lineno, sym)
+                if key not in findings:
+                    findings[key] = PoisonRead(nid, node.lineno, sym, line, why)
+            # Rebinds clear (the echo pattern: `out, echo = fn(..., xp, ...)`
+            # rebinding xp — or later `xp = fresh()` — un-poisons it).
+            for d in node_defs(node):
+                state.pop(d, None)
+                prefix = d + "."
+                for sym in [s for s in state if s.startswith(prefix)]:
+                    state.pop(sym)
+            # A call through the base object of a dotted symbol may
+            # rebind the attribute (mgr.adopt(...) rebinds mgr.session_*):
+            # conservatively clear every `base.*` poison.
+            for call in node_calls(node):
+                bases = set()
+                if isinstance(call.func, ast.Attribute):
+                    b = _sym(call.func.value)
+                    if b is not None:
+                        bases.add(b)
+                for arg in call.args:
+                    s = _sym(arg)
+                    if s is not None:
+                        bases.add(s)
+                for base in bases:
+                    prefix = base + "."
+                    for sym in [s for s in state if s.startswith(prefix)]:
+                        state.pop(sym)
+        for sym, tag in gens.get(nid, {}).items():
+            state[sym] = tag
+        for succ in node.succs:
+            prev = state_in.get(succ)
+            merged = dict(prev or {})
+            changed = prev is None
+            for sym, tag in state.items():
+                if sym not in merged:
+                    merged[sym] = tag
+                    changed = True
+            if changed and merged != seen_state.get(succ):
+                state_in[succ] = merged
+                seen_state[succ] = merged
+                if succ not in work:
+                    work.append(succ)
+    return sorted(findings.values(), key=lambda f: (f.lineno, f.symbol))
+
+
+# ---------------------------------------------------------------------------
+# Project-wide registries
+
+
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    return name is not None and name.split(".")[-1] in _JIT_NAMES
+
+
+def _int_elements(node: ast.AST) -> list[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    return []
+
+
+def _str_elements(node: ast.AST) -> list[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+@dataclass
+class DonorInfo:
+    """One jit-wrapped binding, keyed by its bound name (``_packed_fn``
+    for ``self._packed_fn = jax.jit(...)``). Name-keyed on purpose: call
+    sites in other files (``engine._packed_fn(...)``) resolve without
+    type inference, the lock-graph trade-off — but the match respects
+    the binding SHAPE: an attribute binding matches attribute call sites
+    anywhere, while a plain-name binding (a local/module variable) only
+    matches name call sites in the file that bound it — a generic local
+    name like ``fn`` must not poison every ``fn(...)`` in the repo."""
+
+    name: str
+    donate_positions: frozenset[int] = frozenset()
+    donate_names: frozenset[str] = frozenset()
+    static_positions: frozenset[int] = frozenset()
+    static_names: frozenset[str] = frozenset()
+    where: str = ""
+
+
+class DonationRegistry:
+    """Project-wide inventory of jit bindings (with donation/static
+    metadata) and ArenaPool attribute names.
+
+    Attribute bindings (``self._packed_fn = jax.jit(...)``) are keyed by
+    attribute name and match attribute call sites in ANY file — that is
+    what lets serve/pipeline_engine.py recognize scorer donations
+    without type inference. Plain-name bindings (``fn = jax.jit(...)``)
+    are keyed by (file, name) and match name call sites in that file
+    only: a generic local name must not poison every ``fn(...)`` in the
+    repo, and two files binding the same name must not merge metadata.
+    """
+
+    def __init__(self, project: ProjectContext):
+        self.attr_donors: dict[str, DonorInfo] = {}
+        self.name_donors: dict[tuple[str, str], DonorInfo] = {}
+        self.arena_names: set[str] = set()
+        for ctx in project.files:
+            self._scan(ctx)
+
+    def lookup(self, call: ast.Call, relpath: str) -> DonorInfo | None:
+        """The jit binding a call site resolves to, or None."""
+        fn = call.func
+        if isinstance(fn, ast.Attribute):
+            return self.attr_donors.get(fn.attr)
+        if isinstance(fn, ast.Name):
+            return self.name_donors.get((relpath, fn.id))
+        return None
+
+    def any_names(self) -> set[str]:
+        """Every bound name (both kinds) — the cheap prefilter set."""
+        return set(self.attr_donors) | {n for _, n in self.name_donors}
+
+    def _scan(self, ctx: FileContext) -> None:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                call = node.value
+                ctor = dotted_name(call.func)
+                if ctor is not None and ctor.split(".")[-1] == "ArenaPool":
+                    for t in node.targets:
+                        s = _bind_name(t)
+                        if s is not None:
+                            self.arena_names.add(s)
+                if _is_jit_call(call):
+                    for t in node.targets:
+                        s = _bind_name(t)
+                        if s is not None:
+                            kind = ("attr" if isinstance(t, ast.Attribute)
+                                    else "name")
+                            self._register(s, call, ctx, node.lineno,
+                                           kind=kind)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    call = None
+                    if isinstance(dec, ast.Call) and _is_jit_call(dec):
+                        call = dec
+                    elif (isinstance(dec, ast.Call)
+                          and (dotted_name(dec.func) or "").split(".")[-1]
+                          == "partial"
+                          and any(_is_jit_call_ref(a) for a in dec.args)):
+                        call = dec
+                    if call is not None:
+                        self._register(node.name, call, ctx, node.lineno,
+                                       fn_node=node, kind="name")
+
+    def _register(self, name: str, call: ast.Call, ctx: FileContext,
+                  lineno: int, fn_node: ast.AST | None = None,
+                  kind: str = "name") -> None:
+        donate_pos: set[int] = set()
+        donate_names: set[str] = set()
+        static_pos: set[int] = set()
+        static_names: set[str] = set()
+        target_fn = fn_node
+        if target_fn is None and call.args:
+            # jax.jit(step, ...): resolve argnums against `step`'s params
+            # when it is a function defined in the same file.
+            tname = call.args[0].id if isinstance(call.args[0], ast.Name) else None
+            if tname is not None:
+                for sub in ast.walk(ctx.tree):
+                    if (isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                            and sub.name == tname):
+                        target_fn = sub
+                        break
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                donate_pos.update(_int_elements(kw.value))
+            elif kw.arg == "donate_argnames":
+                donate_names.update(_str_elements(kw.value))
+            elif kw.arg == "static_argnums":
+                static_pos.update(_int_elements(kw.value))
+            elif kw.arg == "static_argnames":
+                static_names.update(_str_elements(kw.value))
+        if target_fn is not None and donate_names:
+            donate_pos.update(_positions_of(target_fn, donate_names))
+        if target_fn is not None and static_names:
+            static_pos.update(_positions_of(target_fn, static_names))
+        if kind == "attr":
+            table, key = self.attr_donors, name
+        else:
+            table, key = self.name_donors, (ctx.relpath, name)
+        info = table.get(key)
+        if info is None:
+            info = DonorInfo(name, where=f"{ctx.relpath}:{lineno}")
+        table[key] = DonorInfo(
+            name,
+            donate_positions=info.donate_positions | frozenset(donate_pos),
+            donate_names=info.donate_names | frozenset(donate_names),
+            static_positions=info.static_positions | frozenset(static_pos),
+            static_names=info.static_names | frozenset(static_names),
+            where=info.where,
+        )
+
+
+def _is_jit_call_ref(node: ast.AST) -> bool:
+    name = dotted_name(node)
+    return name is not None and name.split(".")[-1] in _JIT_NAMES
+
+
+def _bind_name(target: ast.AST) -> str | None:
+    """`x = ...` -> "x"; `self.attr = ...` / `obj.attr = ...` -> "attr"
+    (the registry is name-keyed; the attribute name is the stable key)."""
+    if isinstance(target, ast.Name):
+        return target.id
+    if isinstance(target, ast.Attribute):
+        return target.attr
+    return None
+
+
+def _positions_of(fn_node: ast.AST, names: set[str]) -> set[int]:
+    args = fn_node.args
+    pos = [a.arg for a in getattr(args, "posonlyargs", [])] + [
+        a.arg for a in args.args]
+    return {i for i, a in enumerate(pos) if a in names}
+
+
+def callee_key(call: ast.Call) -> str | None:
+    """The registry key a call site is matched under: the rightmost
+    name (``self._packed_fn(...)`` and ``engine._packed_fn(...)`` both
+    key as ``_packed_fn``)."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def donation_registry(project: ProjectContext) -> DonationRegistry:
+    reg = project.caches.get("donation_registry")
+    if reg is None:
+        reg = DonationRegistry(project)
+        project.caches["donation_registry"] = reg
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# Generic call graph (CC09 must-reach / MX07 scoring-path scope)
+
+
+@dataclass
+class FuncRec:
+    key: tuple[str, str]  # (relpath, qualname)
+    ctx: FileContext
+    node: ast.AST
+    cls_name: str | None
+    # (kind: self|name|attr|alias, name, module-or-None, lineno)
+    calls: list[tuple[str, str, str | None, int]] = field(default_factory=list)
+    called_names: set[str] = field(default_factory=set)
+    children: list[tuple[str, str]] = field(default_factory=list)
+
+
+class CallGraph:
+    """Whole-project call graph with the lock-graph resolution rules.
+
+    Edges: exact for ``self.m()`` (same class), plain names (local defs,
+    ``from mod import f``), and ``alias.f()`` through an imported
+    in-project module; name-based fallback for other attribute calls
+    (every scanned class method with that name). Nested defs are
+    children of their parent (executing the parent may invoke them), so
+    a seam call inside a closure still counts for the enclosing path.
+    """
+
+    def __init__(self, project: ProjectContext):
+        self.project = project
+        self.funcs: dict[tuple[str, str], FuncRec] = {}
+        self._methods_by_name: dict[str, list[tuple[str, str]]] = {}
+        self._from_imports: dict[str, dict[str, tuple[str, str]]] = {}
+        self._module_aliases: dict[str, dict[str, str]] = {}
+        for ctx in project.files:
+            self._index_imports(ctx)
+            self._index_functions(ctx)
+
+    # -- indexing ------------------------------------------------------------
+
+    def _index_imports(self, ctx: FileContext) -> None:
+        froms: dict[str, tuple[str, str]] = {}
+        aliases: dict[str, str] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    if alias.name != "*":
+                        froms[alias.asname or alias.name] = (
+                            node.module, alias.name)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    aliases[alias.asname or alias.name.split(".")[0]] = alias.name
+        self._from_imports[ctx.relpath] = froms
+        self._module_aliases[ctx.relpath] = aliases
+
+    def _index_functions(self, ctx: FileContext) -> None:
+        def visit(node: ast.AST, qual: str, cls: str | None,
+                  parent: FuncRec | None) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    q = f"{qual}.{child.name}" if qual else child.name
+                    rec = FuncRec((ctx.relpath, q), ctx, child, cls)
+                    self.funcs[rec.key] = rec
+                    if cls is not None and "." not in q.replace(
+                            f"{cls}.", "", 1):
+                        self._methods_by_name.setdefault(
+                            child.name, []).append(rec.key)
+                    if parent is not None:
+                        parent.children.append(rec.key)
+                    self._collect_calls(rec)
+                    visit(child, q, cls, rec)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{qual}.{child.name}" if qual else child.name,
+                          child.name, None)
+                else:
+                    visit(child, qual, cls, parent)
+
+        visit(ctx.tree, "", None, None)
+
+    def _collect_calls(self, rec: FuncRec) -> None:
+        own = rec.node
+
+        def walk(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)) and node is not own:
+                    continue  # grand-children belong to the child record
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    continue
+                if isinstance(child, ast.Call):
+                    self._record_call(rec, child)
+                walk(child)
+
+        walk(own)
+
+    def _record_call(self, rec: FuncRec, call: ast.Call) -> None:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            rec.calls.append(("name", fn.id, None, call.lineno))
+            rec.called_names.add(fn.id)
+        elif isinstance(fn, ast.Attribute):
+            rec.called_names.add(fn.attr)
+            base = fn.value
+            if isinstance(base, ast.Name):
+                if base.id == "self":
+                    rec.calls.append(("self", fn.attr, None, call.lineno))
+                    return
+                aliases = self._module_aliases.get(rec.key[0], {})
+                froms = self._from_imports.get(rec.key[0], {})
+                module: str | None = None
+                if base.id in aliases:
+                    module = aliases[base.id]
+                elif base.id in froms:
+                    mod, orig = froms[base.id]
+                    module = f"{mod}.{orig}"
+                if module is not None:
+                    rec.calls.append(("alias", fn.attr, module, call.lineno))
+                    return
+            rec.calls.append(("attr", fn.attr, None, call.lineno))
+
+    # -- resolution ----------------------------------------------------------
+
+    def resolve(self, rec: FuncRec, kind: str, name: str,
+                module: str | None) -> list[tuple[str, str]]:
+        if kind == "self" and rec.cls_name is not None:
+            key = (rec.key[0], f"{rec.cls_name}.{name}")
+            if key in self.funcs:
+                return [key]
+            kind = "attr"  # self.<callback>: fall through to name-based
+        if kind == "name":
+            key = (rec.key[0], name)
+            if key in self.funcs:
+                return [key]
+            imported = self._from_imports.get(rec.key[0], {}).get(name)
+            if imported is not None:
+                mod, orig = imported
+                target = self.project.resolve_module(mod)
+                if target is not None and (target.relpath, orig) in self.funcs:
+                    return [(target.relpath, orig)]
+            return []
+        if kind == "alias" and module is not None:
+            target = self.project.resolve_module(module)
+            if target is not None and (target.relpath, name) in self.funcs:
+                return [(target.relpath, name)]
+            kind = "attr"
+        if kind == "attr":
+            return list(self._methods_by_name.get(name, ()))
+        return []
+
+    # -- queries -------------------------------------------------------------
+
+    def lookup(self, relpath_suffix: str, qualname: str
+               ) -> tuple[str, str] | None:
+        for (relpath, qual), _rec in self.funcs.items():
+            if qual == qualname and relpath.endswith(relpath_suffix):
+                return (relpath, qual)
+        return None
+
+    def reachable_from(self, roots: list[tuple[str, str]]
+                       ) -> set[tuple[str, str]]:
+        seen: set[tuple[str, str]] = set()
+        work = [k for k in roots if k in self.funcs]
+        seen.update(work)
+        while work:
+            key = work.pop()
+            rec = self.funcs[key]
+            nxt = list(rec.children)
+            for kind, name, module, _line in rec.calls:
+                nxt.extend(self.resolve(rec, kind, name, module))
+            for callee in nxt:
+                if callee not in seen and callee in self.funcs:
+                    seen.add(callee)
+                    work.append(callee)
+        return seen
+
+    def reaches_name(self, reachable: set[tuple[str, str]],
+                     names: tuple[str, ...] | set[str]) -> bool:
+        wanted = set(names)
+        return any(self.funcs[k].called_names & wanted for k in reachable)
+
+
+def call_graph(project: ProjectContext) -> CallGraph:
+    graph = project.caches.get("callgraph")
+    if graph is None:
+        graph = CallGraph(project)
+        project.caches["callgraph"] = graph
+    return graph

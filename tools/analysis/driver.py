@@ -53,6 +53,64 @@ REPO_CONFIG = {
     "sessionstate_scope": (
         "igaming_platform_tpu/serve/", "benchmarks/", "tools/",
     ),
+    # MX07 bounded-handoff findings stay inside the production serving +
+    # observability code (the reachability walk itself crosses files).
+    "handoff_scope": ("igaming_platform_tpu/serve/", "igaming_platform_tpu/obs/"),
+    # CC09 mandatory-seam contract table (rules/seams.py). Each scoring
+    # PATH is declared as the set of functions one request flows through
+    # — members span thread hand-offs (gRPC handler -> batcher loop ->
+    # engine callbacks; pipeline submit -> stage/readback workers) — and
+    # must-reach of every seam is computed over the union. Degraded /
+    # heuristic tiers are exempt HERE, in config, never silently in
+    # code. Registering a new scoring path: docs/operations.md, "Seam
+    # contracts".
+    "seam_contracts": {
+        "seams": {
+            "ledger": ("note_decisions",),
+            "drift": ("_note_drift", "_note_drift_cached"),
+            "session": ("_note_session_bypass", "prepare_chunk"),
+        },
+        "paths": {
+            "row": (
+                "igaming_platform_tpu/serve/grpc_server.py::RiskGrpcService.ScoreTransaction",
+                "igaming_platform_tpu/serve/batcher.py::ContinuousBatcher._loop",
+                "igaming_platform_tpu/serve/batcher.py::ContinuousBatcher._finalize_batch",
+                "igaming_platform_tpu/serve/scorer.py::TPUScoringEngine._dispatch_requests",
+                "igaming_platform_tpu/serve/scorer.py::TPUScoringEngine._collect_requests",
+            ),
+            "batch": (
+                "igaming_platform_tpu/serve/grpc_server.py::RiskGrpcService.ScoreBatch",
+                "igaming_platform_tpu/serve/scorer.py::TPUScoringEngine.score_batch",
+            ),
+            "wire-lockstep": (
+                "igaming_platform_tpu/serve/scorer.py::TPUScoringEngine.score_batch_wire",
+                "igaming_platform_tpu/serve/scorer.py::TPUScoringEngine.score_batch_wire_bytes",
+                "igaming_platform_tpu/serve/scorer.py::TPUScoringEngine._score_rows_encode",
+            ),
+            "wire-pipelined": (
+                "igaming_platform_tpu/serve/pipeline_engine.py::HostPipeline.score_rows_to_wire",
+                "igaming_platform_tpu/serve/pipeline_engine.py::HostPipeline._stage_loop",
+                "igaming_platform_tpu/serve/pipeline_engine.py::HostPipeline._readback_loop",
+            ),
+            "index": (
+                "igaming_platform_tpu/serve/scorer.py::TPUScoringEngine.score_batch_wire_index",
+                "igaming_platform_tpu/serve/scorer.py::TPUScoringEngine.score_columns_cached",
+                "igaming_platform_tpu/serve/scorer.py::TPUScoringEngine._indexed_outputs",
+            ),
+        },
+        "exempt": (
+            "igaming_platform_tpu/serve/supervisor.py::HeuristicScorer.score_requests",
+            "igaming_platform_tpu/serve/supervisor.py::SupervisedScoringEngine._degraded_rows_to_wire",
+        ),
+        "cover_files": (
+            "igaming_platform_tpu/serve/scorer.py",
+            "igaming_platform_tpu/serve/batcher.py",
+            "igaming_platform_tpu/serve/grpc_server.py",
+            "igaming_platform_tpu/serve/pipeline_engine.py",
+            "igaming_platform_tpu/serve/supervisor.py",
+        ),
+        "terminal_calls": ("encode_score_batch", "ScoreResponse"),
+    },
 }
 
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
@@ -73,7 +131,7 @@ class Report:
 
     def all_findings(self) -> list[Finding]:
         return sorted(self.syntax_errors + self.new + self.baselined,
-                      key=lambda f: (f.path, f.line, f.rule))
+                      key=lambda f: (f.path, f.line, f.rule, f.message))
 
 
 @dataclass
@@ -141,7 +199,15 @@ def build_project(discovery: _Discovery,
 def run_analysis(paths: list[Path] | None = None,
                  baseline_path: Path | None = None,
                  config: dict | None = None,
-                 no_baseline: bool = False) -> Report:
+                 no_baseline: bool = False,
+                 changed_only: set[str] | None = None) -> Report:
+    """``changed_only`` (the --changed-only incremental mode) is a set of
+    scan-root-relative posix paths: the WHOLE project is still parsed —
+    cross-file rules (jit reachability, lock graph, seam contracts) need
+    the full graph to stay sound — but file-scoped rules skip unchanged
+    files and every reported finding is filtered to the changed set. The
+    shrink-only stale-baseline contract is NOT enforced in this mode (a
+    fix in an unchanged file would look stale); full runs enforce it."""
     t0 = time.perf_counter()
     if paths:
         discovery = _discover_paths(paths)
@@ -154,17 +220,53 @@ def run_analysis(paths: list[Path] | None = None,
     if no_baseline:
         entries = []
     project, syntax_errors = build_project(discovery, cfg)
-    findings = run_rules(project)
+    findings = run_rules(project, file_rule_paths=changed_only)
+    if changed_only is not None:
+        findings = [f for f in findings if f.path in changed_only]
+        syntax_errors = [f for f in syntax_errors if f.path in changed_only]
     matched = baseline_mod.match(findings, entries)
     return Report(
-        files=len(discovery.files), new=matched.new,
-        baselined=matched.baselined, stale=matched.stale,
+        files=(len(changed_only) if changed_only is not None
+               else len(discovery.files)),
+        new=matched.new,
+        baselined=matched.baselined,
+        stale=[] if changed_only is not None else matched.stale,
         syntax_errors=syntax_errors,
         elapsed_s=time.perf_counter() - t0)
 
 
+def changed_files(ref: str | None = None) -> set[str]:
+    """Repo-root-relative paths of changed files for --changed-only:
+    unstaged + staged + untracked; when the working tree is clean, the
+    last commit's files (so a post-commit CI lint-changed still checks
+    something). ``ref`` overrides the diff base entirely."""
+    import subprocess
+
+    def _git(*args: str) -> list[str]:
+        res = subprocess.run(
+            ["git", *args], cwd=REPO_ROOT, capture_output=True, text=True)
+        if res.returncode != 0:
+            return []
+        return [line.strip() for line in res.stdout.splitlines() if line.strip()]
+
+    if ref:
+        files = _git("diff", "--name-only", ref)
+    else:
+        files = (_git("diff", "--name-only")
+                 + _git("diff", "--name-only", "--cached")
+                 + _git("ls-files", "--others", "--exclude-standard"))
+        if not files:
+            files = _git("diff", "--name-only", "HEAD~1", "HEAD")
+    return {f for f in files if f.endswith(".py")}
+
+
+def _finding_order(f: Finding):
+    return (f.path, f.line, f.rule, f.message)
+
+
 def _render_text(report: Report) -> str:
-    lines = [f.render() for f in report.syntax_errors + report.new]
+    lines = [f.render() for f in sorted(report.syntax_errors + report.new,
+                                        key=_finding_order)]
     for e in report.stale:
         lines.append(
             f"{e.get('path')}: stale baseline entry {e.get('fingerprint')} "
@@ -183,16 +285,21 @@ def _render_text(report: Report) -> str:
 
 
 def _render_json(report: Report) -> str:
+    # Findings and the rule catalog are emitted in a total, stable order
+    # — (path, line, rule, message) and rule id — so JSON output is
+    # diffable and independent of rule registration order.
     return json.dumps({
         "files": report.files,
         "elapsed_s": round(report.elapsed_s, 3),
-        "findings": [f.to_json() for f in report.syntax_errors + report.new],
-        "baselined": [f.to_json() for f in report.baselined],
+        "findings": [f.to_json() for f in sorted(
+            report.syntax_errors + report.new, key=_finding_order)],
+        "baselined": [f.to_json() for f in sorted(
+            report.baselined, key=_finding_order)],
         "stale_baseline": report.stale,
         "rules": {
             r.id: {"name": r.name, "scope": r.scope,
                    "aliases": sorted(r.aliases)}
-            for r in RULES.values()
+            for r in sorted(RULES.values(), key=lambda r: r.id)
         },
         "exit_code": 1 if report.failed else 0,
     }, indent=2)
@@ -205,7 +312,8 @@ def main(argv: list[str] | None = None) -> int:
                     "docs/static-analysis.md)")
     parser.add_argument("paths", nargs="*", type=Path,
                         help="files/dirs to scan (default: the repo roots)")
-    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text")
     parser.add_argument("--baseline", type=Path, default=None,
                         help="baseline JSON (default: tools/analysis/"
                              "baseline.json in repo mode, none otherwise)")
@@ -214,10 +322,27 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--update-baseline", action="store_true",
                         help="rewrite the baseline to the current findings "
                              "and exit 0")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="incremental mode: report only findings in "
+                             "git-changed files (cross-file rules still see "
+                             "the whole repo; stale-baseline enforcement is "
+                             "skipped)")
+    parser.add_argument("--changed-ref", default=None,
+                        help="diff base for --changed-only (default: working "
+                             "tree, falling back to HEAD~1 when clean)")
     args = parser.parse_args(argv)
 
+    changed: set[str] | None = None
+    if args.changed_only:
+        if args.paths:
+            parser.error("--changed-only only applies to repo mode")
+        changed = changed_files(args.changed_ref)
+        if not changed:
+            print("analysis: --changed-only found no changed python files")
+            return 0
+
     report = run_analysis(args.paths or None, baseline_path=args.baseline,
-                          no_baseline=args.no_baseline)
+                          no_baseline=args.no_baseline, changed_only=changed)
 
     if args.update_baseline:
         target = args.baseline or DEFAULT_BASELINE
@@ -226,6 +351,11 @@ def main(argv: list[str] | None = None) -> int:
               f"entries to {target}")
         return 0
 
-    print(_render_text(report) if args.format == "text"
-          else _render_json(report))
+    if args.format == "sarif":
+        from tools.analysis import sarif
+
+        print(sarif.render(report))
+    else:
+        print(_render_text(report) if args.format == "text"
+              else _render_json(report))
     return 1 if report.failed else 0

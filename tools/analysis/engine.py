@@ -201,13 +201,22 @@ def rule(id: str, name: str, rationale: str, scope: str = "file",
     return deco
 
 
-def run_rules(project: ProjectContext) -> list[Finding]:
-    """Run every registered rule; returns non-suppressed findings sorted
-    by (path, line, rule)."""
+def run_rules(project: ProjectContext,
+              file_rule_paths: set[str] | None = None) -> list[Finding]:
+    """Run every registered rule; returns non-suppressed findings in a
+    TOTAL order — (path, line, rule, message) — so output never depends
+    on rule registration order (the PR 13 ordering bugfix).
+
+    ``file_rule_paths`` (incremental mode) restricts file-scoped rules
+    to those relpaths; project-scoped rules always see the whole parse
+    forest (their graphs must stay complete to be sound)."""
     findings: list[Finding] = []
     for r in RULES.values():
         if r.scope == "file":
             for ctx in project.files:
+                if (file_rule_paths is not None
+                        and ctx.relpath not in file_rule_paths):
+                    continue
                 for line, msg in r.check(ctx):
                     if not ctx.is_suppressed(r, line):
                         findings.append(Finding(r.id, ctx.relpath, line, msg))
@@ -215,7 +224,7 @@ def run_rules(project: ProjectContext) -> list[Finding]:
             for ctx, line, msg in r.check(project):
                 if not ctx.is_suppressed(r, line):
                     findings.append(Finding(r.id, ctx.relpath, line, msg))
-    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return findings
 
 

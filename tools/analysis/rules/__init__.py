@@ -1,10 +1,19 @@
-"""Rule modules — importing this package registers every rule."""
+"""Rule modules — importing this package registers every rule.
 
+Imports are kept sorted. Registration order no longer leaks into any
+output: findings are totally ordered by (path, line, rule, message) and
+the JSON/SARIF rule catalogs sort by rule id (the PR 13 ordering
+bugfix), so two checkouts that import these modules in different orders
+render byte-identical reports.
+"""
+
+from tools.analysis.rules import donation as _donation  # noqa: PY01
 from tools.analysis.rules import hygiene as _hygiene  # noqa: PY01
 from tools.analysis.rules import jax_hotpath as _jax_hotpath  # noqa: PY01
 from tools.analysis.rules import locks as _locks  # noqa: PY01
 from tools.analysis.rules import metrics as _metrics  # noqa: PY01
 from tools.analysis.rules import paramswap as _paramswap  # noqa: PY01
 from tools.analysis.rules import replaydet as _replaydet  # noqa: PY01
-from tools.analysis.rules import sessionstate as _sessionstate  # noqa: PY01
 from tools.analysis.rules import robustness as _robustness  # noqa: PY01
+from tools.analysis.rules import seams as _seams  # noqa: PY01
+from tools.analysis.rules import sessionstate as _sessionstate  # noqa: PY01

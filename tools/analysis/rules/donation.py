@@ -1,0 +1,360 @@
+"""JX05/JX06 — donation-lifetime and retrace/host-sync dataflow rules.
+
+Both rules run on the dataflow layer (tools/analysis/dataflow.py):
+per-function CFGs + reaching definitions, composed with the project-wide
+donation registry so a jit binding donated in one file
+(``self._packed_fn = jax.jit(fn, donate_argnums=(1,))`` in
+serve/scorer.py) is recognized at call sites in another
+(serve/pipeline_engine.py) by the same conservative name matching the
+lock graph uses.
+
+JX05 (use-after-donate): a value passed in a donated argument position —
+or an ArenaPool buffer released back to its pool — is dead to the
+caller; XLA (or the next acquirer) may already be rewriting the memory.
+On the CPU backend jax aliases host memory zero-copy, so the read is a
+silent data race, not a crash. The sanctioned fix is the PR 4 echo
+pattern: the jitted step returns the batch unchanged as an extra output
+and the caller rebinds to the echo — a rebind clears the poison, so the
+pattern analyzes clean by construction.
+
+JX06 (retrace/host-sync hazards): the three ways serving code silently
+re-pays compile or sync cost per step — (a) constructing jit/pjit/
+shard_map wrappers inside a loop or a hot-loop function (every
+construction is a fresh compilation cache), (b) passing a
+Python-varying value in a static argument position (every new value is
+a retrace), and (c) implicit host syncs — ``bool()``/``if``/``len()``/
+iteration/``np.*`` coercion — on values dataflow says are device arrays,
+in hot-loop-marked code outside jit roots (inside traced code that is
+JX02's beat).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.dataflow import (
+    ReachingDefs,
+    callee_key,
+    donation_registry,
+    function_cfg,
+    node_calls,
+    node_defs,
+    poison_flow,
+)
+from tools.analysis.engine import FileContext, ProjectContext, dotted_name, rule
+from tools.analysis.jaxgraph import jax_graph
+from tools.analysis.rules.metrics import _HOT_LOOP_REGISTRY, _has_hot_loop_marker
+
+_JIT_CTORS = {"jit", "pjit", "shard_map"}
+_SYNC_CASTS = {"bool", "int", "float", "len"}
+_NP_ALIASES = {"np", "numpy", "onp"}
+_NP_COERCERS = {"asarray", "array", "copy"}
+
+
+def _scoped_files(project: ProjectContext) -> list[FileContext]:
+    config = project.caches.get("config", {})
+    prefixes = config.get("jx_scope")
+    if not prefixes:
+        return list(project.files)
+    return [f for f in project.files
+            if any(f.relpath.startswith(p) for p in prefixes)]
+
+
+def _functions(ctx: FileContext):
+    """(qualname, node) for every function, class nesting dotted."""
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                yield qual, child
+                yield from walk(child, f"{qual}.")
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.")
+            else:
+                yield from walk(child, prefix)
+
+    yield from walk(ctx.tree, "")
+
+
+def _is_hot_loop(ctx: FileContext, qual: str, node: ast.AST) -> bool:
+    for suffix, quals in _HOT_LOOP_REGISTRY.items():
+        if ctx.relpath.endswith(suffix) and qual in quals:
+            return True
+    return _has_hot_loop_marker(ctx, node)
+
+
+def _receiver_tail(expr: ast.AST) -> str | None:
+    """``self._arena`` -> "_arena", ``pool`` -> "pool"."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _sym_of(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return dotted_name(node)
+    return None
+
+
+@rule("JX05", "use-after-donate",
+      "A buffer passed in a donated argument position of a jitted call "
+      "(donate_argnums/donate_argnames), or released back to an "
+      "ArenaPool, no longer belongs to the caller: XLA aliases the "
+      "memory for outputs (zero-copy on the CPU backend) and the next "
+      "acquirer rewrites it — a later read is a silent data race. "
+      "Rebind to the echoed output (the PR 4 arena/echo pattern) or "
+      "hold the buffer until readback and release it then.",
+      scope="project")
+def use_after_donate(project: ProjectContext):
+    reg = donation_registry(project)
+    for ctx in _scoped_files(project):
+        for qual, fn_node in _functions(ctx):
+            if not _may_donate(fn_node, ctx, reg):
+                continue
+            cfg = function_cfg(fn_node)
+            gens: dict[int, dict[str, tuple[int, str]]] = {}
+            for node in cfg.nodes:
+                facts: dict[str, tuple[int, str]] = {}
+                rebinds = node_defs(node)
+                for call in node_calls(node):
+                    key = callee_key(call)
+                    info = reg.lookup(call, ctx.relpath)
+                    if info is not None and (
+                            info.donate_positions or info.donate_names):
+                        for pos in sorted(info.donate_positions):
+                            if pos < len(call.args):
+                                sym = _sym_of(call.args[pos])
+                                if sym is not None and sym not in rebinds:
+                                    facts[sym] = (call.lineno,
+                                                  f"donated to `{key}`")
+                        for kw in call.keywords:
+                            if kw.arg in info.donate_names:
+                                sym = _sym_of(kw.value)
+                                if sym is not None and sym not in rebinds:
+                                    facts[sym] = (call.lineno,
+                                                  f"donated to `{key}`")
+                    if (isinstance(call.func, ast.Attribute)
+                            and call.func.attr == "release" and call.args
+                            and _receiver_tail(call.func.value)
+                            in reg.arena_names):
+                        sym = _sym_of(call.args[0])
+                        if sym is not None and sym not in rebinds:
+                            facts[sym] = (call.lineno, "released to arena")
+                if facts:
+                    gens[node.id] = facts
+            if not gens:
+                continue
+            for hit in poison_flow(cfg, gens):
+                yield ctx, hit.lineno, (
+                    f"`{hit.symbol}` read after being {hit.why} at "
+                    f"{ctx.relpath}:{hit.source_line} — the buffer no "
+                    "longer belongs to `" + qual + "`; rebind to the "
+                    "echoed output or defer the release past this read")
+
+
+def _may_donate(fn_node: ast.AST, ctx: FileContext, reg) -> bool:
+    """Cheap prefilter: only build a CFG when the function contains a
+    donating call or an arena release."""
+    for sub in ast.walk(fn_node):
+        if not isinstance(sub, ast.Call):
+            continue
+        info = reg.lookup(sub, ctx.relpath)
+        if info is not None and (info.donate_positions or info.donate_names):
+            return True
+        if (isinstance(sub.func, ast.Attribute) and sub.func.attr == "release"
+                and _receiver_tail(sub.func.value) in reg.arena_names):
+            return True
+    return False
+
+
+def _loops_enclosing(fn_node: ast.AST):
+    """(node, innermost enclosing loop | None) for every Call in the
+    function, computed lexically (nested defs stay in — a per-iteration
+    closure constructing a jit is exactly the hazard)."""
+    out: list[tuple[ast.Call, ast.AST | None]] = []
+
+    def walk(node: ast.AST, loop: ast.AST | None) -> None:
+        for child in ast.iter_child_nodes(node):
+            inner = loop
+            if isinstance(child, (ast.For, ast.AsyncFor, ast.While)):
+                inner = child
+            if isinstance(child, ast.Call):
+                out.append((child, inner))
+            walk(child, inner)
+
+    walk(fn_node, None)
+    return out
+
+
+@rule("JX06", "retrace-host-sync-hazard",
+      "Three ways the hot path silently re-pays compile or sync cost "
+      "per step: constructing jax.jit/pjit/shard_map inside a loop or "
+      "hot-loop function (a fresh compilation cache each time), passing "
+      "a Python-varying value in a static argument position (a retrace "
+      "per new value), and implicit host syncs — bool()/if/len()/"
+      "iteration/np.* coercion — on device arrays in hot-loop code. "
+      "Hoist wrapper construction to init, keep static args "
+      "loop-invariant, and read device values back only at the "
+      "sanctioned readback chokepoint.",
+      scope="project")
+def retrace_host_sync_hazard(project: ProjectContext):
+    reg = donation_registry(project)
+    graph = jax_graph(project)
+    traced_nodes = set(graph.reachable)
+    for ctx in _scoped_files(project):
+        for qual, fn_node in _functions(ctx):
+            hot = _is_hot_loop(ctx, qual, fn_node)
+            calls = _loops_enclosing(fn_node)
+            # (a) wrapper construction in loops / hot-loop functions.
+            for call, loop in calls:
+                name = dotted_name(call.func)
+                if name is None or name.split(".")[-1] not in _JIT_CTORS:
+                    continue
+                if loop is not None:
+                    yield ctx, call.lineno, (
+                        f"`{name}` constructed inside a loop in "
+                        f"`{qual}` — every construction starts a fresh "
+                        "compilation cache (a compile per iteration); "
+                        "hoist the wrapper out of the loop")
+                elif hot:
+                    yield ctx, call.lineno, (
+                        f"`{name}` constructed inside hot-loop "
+                        f"`{qual}` — a per-call wrapper recompiles on "
+                        "every invocation; build it once at init")
+            # (b) Python-varying static arguments.
+            static_calls = [
+                (call, loop) for call, loop in calls
+                if loop is not None and (info := reg.lookup(
+                    call, ctx.relpath)) is not None
+                and (info.static_positions or info.static_names)
+            ]
+            if static_calls:
+                cfg = function_cfg(fn_node)
+                rd = ReachingDefs(cfg)
+                call_nodes = {
+                    id(c): n for n in cfg.nodes for c in node_calls(n)}
+                for call, loop in static_calls:
+                    info = reg.lookup(call, ctx.relpath)
+                    cfg_node = call_nodes.get(id(call))
+                    if cfg_node is None:
+                        continue
+                    args = [(pos, call.args[pos])
+                            for pos in sorted(info.static_positions)
+                            if pos < len(call.args)]
+                    args += [(kw.arg, kw.value) for kw in call.keywords
+                             if kw.arg in info.static_names]
+                    for which, expr in args:
+                        if not isinstance(expr, ast.Name):
+                            continue
+                        defs = rd.defs_in(cfg_node.id).get(expr.id, ())
+                        lo, hi = loop.lineno, loop.end_lineno or loop.lineno
+                        if any(lo <= cfg.nodes[d].lineno <= hi for d in defs):
+                            yield ctx, call.lineno, (
+                                f"static argument `{which}` of "
+                                f"`{callee_key(call)}` varies per loop "
+                                f"iteration (`{expr.id}` is assigned "
+                                "inside the loop) — each new value is a "
+                                "full retrace + compile; make it "
+                                "loop-invariant or a traced argument")
+            # (c) implicit syncs on device values in hot-loop code.
+            if hot and id(fn_node) not in traced_nodes:
+                yield from _implicit_syncs(ctx, qual, fn_node, reg)
+
+
+def _implicit_syncs(ctx: FileContext, qual: str, fn_node: ast.AST, reg):
+    cfg = function_cfg(fn_node)
+    # Forward pass: which names hold jitted-call results at each node.
+    state_in: dict[int, frozenset[str]] = {cfg.entry: frozenset()}
+    work = [cfg.entry]
+    hits: dict[int, str] = {}
+    while work:
+        nid = work.pop(0)
+        node = cfg.nodes[nid]
+        state = set(state_in.get(nid, frozenset()))
+        for line, msg in _sync_uses(node, state):
+            hits.setdefault(line, msg)
+        defs = node_defs(node)
+        stmt = node.stmt
+        device_targets: set[str] = set()
+        if (isinstance(stmt, ast.Assign)
+                and isinstance(stmt.value, ast.Call)):
+            key = callee_key(stmt.value)
+            if key == "device_put" or reg.lookup(
+                    stmt.value, ctx.relpath) is not None:
+                device_targets = defs
+        state -= defs - device_targets
+        state |= device_targets
+        out = frozenset(state)
+        for succ in node.succs:
+            prev = state_in.get(succ)
+            merged = out if prev is None else (prev | out)
+            if merged != prev:
+                state_in[succ] = merged
+                if succ not in work:
+                    work.append(succ)
+    for line in sorted(hits):
+        yield ctx, line, hits[line] + (
+            f" — implicit device->host sync in hot-loop `{qual}`; read "
+            "back at the sanctioned readback chokepoint instead")
+
+
+def _sync_uses(node, device: set[str]):
+    """Coercions of device-array names that force a host sync."""
+    if not device:
+        return
+    if node.kind in ("branch", "loop") and node.exprs:
+        test = node.exprs[0]
+        if isinstance(node.stmt, (ast.For, ast.AsyncFor)):
+            if isinstance(test, ast.Name) and test.id in device:
+                yield node.lineno, (
+                    f"iterating over device array `{test.id}` pulls every "
+                    "element to host")
+        else:
+            name = _truth_name(test, device)
+            if name is not None:
+                yield node.lineno, (
+                    f"branching on device array `{name}` blocks on its "
+                    "value")
+    for call in node_calls(node):
+        fn = call.func
+        if (isinstance(fn, ast.Name) and fn.id in _SYNC_CASTS
+                and len(call.args) == 1
+                and isinstance(call.args[0], ast.Name)
+                and call.args[0].id in device):
+            yield call.lineno, (
+                f"{fn.id}({call.args[0].id}) materializes a device array "
+                "on host")
+        elif (isinstance(fn, ast.Attribute)
+              and isinstance(fn.value, ast.Name)
+              and fn.value.id in _NP_ALIASES and fn.attr in _NP_COERCERS
+              and call.args and isinstance(call.args[0], ast.Name)
+              and call.args[0].id in device):
+            yield call.lineno, (
+                f"{fn.value.id}.{fn.attr}({call.args[0].id}) copies a "
+                "device array to host numpy")
+
+
+def _truth_name(test: ast.AST, device: set[str]) -> str | None:
+    """A device name whose truthiness the test takes directly: a bare
+    name, `not name`, a comparison side, or a BoolOp of those. Names
+    inside calls (hasattr(out, ...)) are NOT truthiness uses."""
+    if isinstance(test, ast.Name):
+        return test.id if test.id in device else None
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _truth_name(test.operand, device)
+    if isinstance(test, ast.Compare):
+        for side in [test.left] + list(test.comparators):
+            if isinstance(side, ast.Name) and side.id in device:
+                return side.id
+        return None
+    if isinstance(test, ast.BoolOp):
+        for v in test.values:
+            hit = _truth_name(v, device)
+            if hit is not None:
+                return hit
+    return None

@@ -1,0 +1,485 @@
+"""CC09/MX07 — mandatory-seam coverage and bounded-handoff discipline.
+
+Both rules are must-reach / reachability queries over the generic call
+graph (tools/analysis/dataflow.CallGraph), driven by a declared
+**seam contract table**:
+
+- in repo mode the table lives in ``REPO_CONFIG["seam_contracts"]``
+  (tools/analysis/driver.py): every production scoring path — row,
+  batch, wire-lockstep, wire-pipelined, index — is declared as the set
+  of functions a request flows through (members span thread hand-offs:
+  the gRPC handler, the batcher loop, the stage/readback workers), and
+  every path must reach the ledger seam (``note_decisions``), the drift
+  seam (``_note_drift``/``_note_drift_cached``) and the session seam
+  (``_note_session_bypass``/``prepare_chunk``). Degraded/heuristic
+  tiers are declared exempt IN CONFIG, not in code;
+- in explicit-path mode (the fixture corpus, unit tests) a module
+  declares its own table as a literal ``ANALYSIS_SEAM_CONTRACT = {...}``
+  assignment; member names resolve within the declaring file.
+
+CC09 additionally audits **coverage**: any function in the configured
+``cover_files`` that makes a scoring-terminal call (encodes a score
+response / constructs a ScoreResponse) must be reachable from a
+declared path or listed exempt — a future scoring path that forgets to
+register (and therefore could silently skip the ledger) fails lint at
+its def line.
+
+MX07 checks every queue ``put``/deque ``append`` whose enclosing
+function is reachable from a declared scoring path: the hand-off must
+be bounded and non-blocking with a *counted* drop — the invariant the
+ledger (PR 7), shadow (PR 9), drift (PR 10) and session (PR 12) queues
+each re-implemented by hand. Two compliant shapes are recognized:
+
+- bounded ``queue.Queue`` + ``put_nowait``/``put(block=False)`` inside
+  ``try/except queue.Full`` whose handler counts the drop;
+- the guarded-append idiom: ``if <depth> > <bound>: <count drop>
+  else: <append>`` (what ledger/shadow/drift do under their condition
+  variables).
+
+Deliberate blocking backpressure (the pipeline's bounded in-flight
+window) carries a scoped ``# noqa: MX07`` with a justification — the
+point is that blocking on the scoring path is a *decision*, visibly
+annotated, never an accident.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.analysis.dataflow import CallGraph, call_graph
+from tools.analysis.engine import FileContext, ProjectContext, dotted_name, rule
+
+_CONTRACT_NAME = "ANALYSIS_SEAM_CONTRACT"
+_BOUND_RE = re.compile(r"max|limit|bound|capac|depth|full|budget", re.I)
+_DROP_RE = re.compile(r"drop|shed|reject|spill|evict|discard", re.I)
+
+_QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue"}
+_UNBOUNDED_QUEUE_CTORS = {"SimpleQueue"}
+_PUT_METHODS = {"put", "put_nowait"}
+_APPEND_METHODS = {"append", "appendleft"}
+
+
+# ---------------------------------------------------------------------------
+# Contract acquisition
+
+
+class _Contract:
+    def __init__(self, table: dict, ctx: FileContext | None, lineno: int):
+        self.table = table
+        self.ctx = ctx  # declaring file (None for the config table)
+        self.lineno = lineno
+        self.seams: dict[str, tuple[str, ...]] = {
+            k: tuple(v) for k, v in (table.get("seams") or {}).items()}
+        self.paths: dict[str, tuple[str, ...]] = {
+            k: tuple(v) for k, v in (table.get("paths") or {}).items()}
+        self.exempt: tuple[str, ...] = tuple(table.get("exempt") or ())
+        self.cover_files: tuple[str, ...] = tuple(table.get("cover_files") or ())
+        self.terminal_calls: tuple[str, ...] = tuple(
+            table.get("terminal_calls") or ())
+
+
+def _contracts(project: ProjectContext) -> list[_Contract]:
+    cached = project.caches.get("seam_contracts_parsed")
+    if cached is not None:
+        return cached
+    out: list[_Contract] = []
+    config = project.caches.get("config", {})
+    table = config.get("seam_contracts")
+    if table:
+        out.append(_Contract(table, None, 0))
+    for ctx in project.files:
+        for node in ctx.tree.body:
+            if not (isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == _CONTRACT_NAME
+                    for t in node.targets)):
+                continue
+            try:
+                literal = ast.literal_eval(node.value)
+            except (ValueError, SyntaxError):
+                continue  # malformed contracts surface as unresolved members
+            if isinstance(literal, dict):
+                out.append(_Contract(literal, ctx, node.lineno))
+    project.caches["seam_contracts_parsed"] = out
+    return out
+
+
+def _resolve_member(graph: CallGraph, contract: _Contract,
+                    spec: str) -> tuple[str, str] | None:
+    if "::" in spec:
+        suffix, qual = spec.split("::", 1)
+    elif contract.ctx is not None:
+        suffix, qual = contract.ctx.relpath, spec
+    else:
+        return None
+    return graph.lookup(suffix, qual)
+
+
+def _anchor_for(project: ProjectContext, contract: _Contract,
+                spec: str) -> tuple[FileContext, int] | None:
+    """Where an unresolved member spec is reported: the declaring file
+    (file-local contracts) or the named file (config contracts)."""
+    if contract.ctx is not None:
+        return contract.ctx, contract.lineno
+    suffix = spec.split("::", 1)[0]
+    for ctx in project.files:
+        if ctx.relpath.endswith(suffix):
+            return ctx, 1
+    return None
+
+
+@rule("CC09", "mandatory-seam-coverage",
+      "Every declared scoring path must reach the ledger, drift and "
+      "session seams on its non-degraded route (must-reach over the "
+      "call graph), and every function making a scoring-terminal call "
+      "in the covered files must belong to a declared path or the "
+      "config exempt list. A scoring path that forgets the decision "
+      "ledger produces answers the audit trail cannot defend "
+      "(\"Rethinking LLMOps for Fraud and AML\"): register the path in "
+      "the seam contract table (docs/operations.md, \"Seam contracts\") "
+      "or declare the degraded tier exempt in config.",
+      scope="project")
+def mandatory_seam_coverage(project: ProjectContext):
+    graph = call_graph(project)
+    for contract in _contracts(project):
+        if not contract.paths:
+            continue
+        all_members: list[tuple[str, str]] = []
+        resolved_paths: dict[str, list[tuple[str, str]]] = {}
+        for path_name, specs in sorted(contract.paths.items()):
+            members: list[tuple[str, str]] = []
+            for spec in specs:
+                key = _resolve_member(graph, contract, spec)
+                if key is None:
+                    anchor = _anchor_for(project, contract, spec)
+                    if anchor is not None:
+                        yield anchor[0], anchor[1], (
+                            f"seam contract path `{path_name}` names "
+                            f"unknown function `{spec}` — the contract "
+                            "table has drifted from the code; fix the "
+                            "entry so the must-reach check still means "
+                            "something")
+                    continue
+                members.append(key)
+            resolved_paths[path_name] = members
+            all_members.extend(members)
+        # Per-path must-reach over the call graph.
+        for path_name, members in sorted(resolved_paths.items()):
+            if not members:
+                continue
+            reachable = graph.reachable_from(members)
+            for seam_name, callees in sorted(contract.seams.items()):
+                if graph.reaches_name(reachable, callees):
+                    continue
+                first = graph.funcs[members[0]]
+                yield first.ctx, first.node.lineno, (
+                    f"scoring path `{path_name}` never reaches the "
+                    f"{seam_name} seam ({'/'.join(callees)}) on any "
+                    "route — every non-degraded scoring path must hit "
+                    "it; call the seam or register the tier as exempt "
+                    "in the contract table")
+        # Coverage: terminal calls outside any declared path.
+        if not (contract.cover_files and contract.terminal_calls):
+            continue
+        covered = graph.reachable_from(all_members)
+        exempt_keys: list[tuple[str, str]] = []
+        for spec in contract.exempt:
+            key = _resolve_member(graph, contract, spec)
+            if key is None:
+                anchor = _anchor_for(project, contract, spec)
+                if anchor is not None:
+                    yield anchor[0], anchor[1], (
+                        f"seam contract exempt list names unknown "
+                        f"function `{spec}` — remove or fix the entry")
+                continue
+            exempt_keys.append(key)
+        covered |= graph.reachable_from(exempt_keys)
+        terminals = set(contract.terminal_calls)
+        for suffix in contract.cover_files:
+            for key, rec in graph.funcs.items():
+                if not key[0].endswith(suffix):
+                    continue
+                if rec.called_names & terminals and key not in covered:
+                    yield rec.ctx, rec.node.lineno, (
+                        f"`{key[1]}` makes a scoring-terminal call "
+                        f"({'/'.join(sorted(rec.called_names & terminals))}) "
+                        "but is reachable from no declared scoring path "
+                        "— an unregistered scoring path can silently "
+                        "skip the ledger/drift/session seams; add it to "
+                        "the seam contract table or the exempt list")
+
+
+# ---------------------------------------------------------------------------
+# MX07 — bounded hand-offs on the scoring path
+
+
+class _Receivers:
+    """Project inventory of queue/deque receivers: class attributes
+    (``self.X = queue.Queue(8)``) and module-level names, with
+    boundedness. Local variables resolve per function at check time."""
+
+    def __init__(self, project: ProjectContext):
+        # (relpath, cls, attr) / (relpath, None, name) -> (kind, bounded)
+        self.known: dict[tuple[str, str | None, str], tuple[str, bool]] = {}
+        for ctx in project.files:
+            for node in ctx.tree.body:
+                kb = _ctor_kind_bounded(getattr(node, "value", None))
+                if kb is not None:
+                    for t in _assign_targets(node):
+                        if isinstance(t, ast.Name):
+                            self.known[(ctx.relpath, None, t.id)] = kb
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                for sub in ast.walk(node):
+                    kb = _ctor_kind_bounded(getattr(sub, "value", None))
+                    if kb is None:
+                        continue
+                    for t in _assign_targets(sub):
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            self.known[(ctx.relpath, node.name, t.attr)] = kb
+
+
+def _assign_targets(node: ast.AST) -> list[ast.AST]:
+    if isinstance(node, ast.Assign):
+        return list(node.targets)
+    if isinstance(node, ast.AnnAssign):  # self._q: queue.Queue = queue.Queue(8)
+        return [node.target]
+    return []
+
+
+def _ctor_kind_bounded(value: ast.AST | None) -> tuple[str, bool] | None:
+    if not isinstance(value, ast.Call):
+        return None
+    name = dotted_name(value.func)
+    if name is None:
+        return None
+    last = name.split(".")[-1]
+    if last in _UNBOUNDED_QUEUE_CTORS:
+        return ("queue", False)
+    if last in _QUEUE_CTORS:
+        bounded = bool(value.args) or any(
+            kw.arg == "maxsize" and not (
+                isinstance(kw.value, ast.Constant) and kw.value.value in (0, None))
+            for kw in value.keywords)
+        if value.args and isinstance(value.args[0], ast.Constant) \
+                and value.args[0].value in (0, None):
+            bounded = False
+        return ("queue", bounded)
+    if last == "deque":
+        bounded = len(value.args) >= 2 or any(
+            kw.arg == "maxlen" and not (
+                isinstance(kw.value, ast.Constant) and kw.value.value is None)
+            for kw in value.keywords)
+        return ("deque", bounded)
+    return None
+
+
+def _receivers(project: ProjectContext) -> _Receivers:
+    inv = project.caches.get("handoff_receivers")
+    if inv is None:
+        inv = _Receivers(project)
+        project.caches["handoff_receivers"] = inv
+    return inv
+
+
+def _is_nonblocking_put(call: ast.Call, attr: str) -> bool:
+    if attr == "put_nowait":
+        return True
+    for kw in call.keywords:
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    return False
+
+
+def _parents(root: ast.AST) -> dict[int, ast.AST]:
+    out: dict[int, ast.AST] = {}
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            out[id(child)] = node
+    return out
+
+
+def _mentions(node: ast.AST, pattern: re.Pattern) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and pattern.search(sub.id):
+            return True
+        if isinstance(sub, ast.Attribute) and pattern.search(sub.attr):
+            return True
+    return False
+
+
+def _guarded_with_counted_drop(call: ast.Call, parents: dict) -> bool:
+    """The ledger/shadow/drift idiom: the append sits under an ``if``
+    whose test compares against a bound and whose other branch counts
+    the drop."""
+    node: ast.AST | None = call
+    while node is not None:
+        parent = parents.get(id(node))
+        if isinstance(parent, ast.If):
+            in_body = any(_contains(s, call) for s in parent.body)
+            sibling = parent.orelse if in_body else parent.body
+            test_ok = (_mentions(parent.test, _BOUND_RE)
+                       or any(isinstance(c, ast.Call)
+                              and isinstance(c.func, ast.Name)
+                              and c.func.id == "len"
+                              for sub in ast.walk(parent.test)
+                              if isinstance(sub, ast.Compare)
+                              for c in ast.walk(sub)))
+            drop_ok = any(_mentions(s, _DROP_RE) for s in sibling)
+            if test_ok and drop_ok:
+                return True
+        node = parent
+    return False
+
+
+def _contains(root: ast.AST, target: ast.AST) -> bool:
+    return any(sub is target for sub in ast.walk(root))
+
+
+def _counted_full_handler(call: ast.Call, parents: dict) -> bool:
+    """put_nowait inside try/except <...>Full whose handler body is not
+    just ``pass`` (the drop is counted, or at least acted on)."""
+    node: ast.AST | None = call
+    while node is not None:
+        parent = parents.get(id(node))
+        if isinstance(parent, ast.Try) and any(
+                _contains(s, call) for s in parent.body):
+            for handler in parent.handlers:
+                t = handler.type
+                names = []
+                if t is not None:
+                    if isinstance(t, ast.Tuple):
+                        names = [dotted_name(e) or "" for e in t.elts]
+                    else:
+                        names = [dotted_name(t) or ""]
+                if any(n.split(".")[-1] == "Full" for n in names):
+                    return not all(isinstance(s, ast.Pass)
+                                   for s in handler.body)
+        node = parent
+    return False
+
+
+@rule("MX07", "bounded-handoff",
+      "Every queue.put / deque append reachable from a declared scoring "
+      "path must be a bounded, non-blocking hand-off with a counted "
+      "drop — an unbounded queue turns a slow consumer into unbounded "
+      "memory growth, a blocking put turns it into scoring-path "
+      "latency, and an uncounted drop turns it into silent data loss "
+      "(the invariant the ledger/shadow/drift/session queues each "
+      "implement by hand). Use a bounded queue with put_nowait + a "
+      "counted queue.Full handler, or the guarded-append idiom; "
+      "deliberate backpressure carries a scoped `# noqa: MX07` with a "
+      "justification.",
+      scope="project")
+def bounded_handoff(project: ProjectContext):
+    graph = call_graph(project)
+    members: list[tuple[str, str]] = []
+    for contract in _contracts(project):
+        for specs in contract.paths.values():
+            for spec in specs:
+                key = _resolve_member(graph, contract, spec)
+                if key is not None:
+                    members.append(key)
+    if not members:
+        return
+    reachable = graph.reachable_from(members)
+    config = project.caches.get("config", {})
+    prefixes = config.get("handoff_scope") or config.get("cc_scope")
+    inv = _receivers(project)
+    seen: set[tuple[str, int]] = set()
+    for key in sorted(reachable):
+        rec = graph.funcs[key]
+        relpath = rec.key[0]
+        if prefixes and not any(relpath.startswith(p) for p in prefixes):
+            continue
+        local = _local_receivers(rec.node)
+        parents = _parents(rec.node)
+        for call in _own_calls(rec.node):
+            fn = call.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            attr = fn.attr
+            if attr not in _PUT_METHODS | _APPEND_METHODS:
+                continue
+            kb = _resolve_receiver(fn.value, rec, inv, local)
+            if kb is None:
+                continue
+            kind, bounded = kb
+            if (relpath, call.lineno) in seen:
+                continue
+            msg = _handoff_violation(call, attr, kind, bounded, parents)
+            if msg is not None:
+                seen.add((relpath, call.lineno))
+                yield rec.ctx, call.lineno, (
+                    f"{msg} in `{rec.key[1]}` (on the scoring path) — "
+                    "hand off bounded + non-blocking with a counted "
+                    "drop, or annotate deliberate backpressure")
+
+
+def _handoff_violation(call: ast.Call, attr: str, kind: str, bounded: bool,
+                       parents: dict) -> str | None:
+    if kind == "queue":
+        if attr in _PUT_METHODS and not _is_nonblocking_put(call, attr):
+            return ("blocking queue.put() hand-off"
+                    + ("" if bounded else " on an UNBOUNDED queue"))
+        if not bounded:
+            return "put onto an unbounded queue"
+        if not (_counted_full_handler(call, parents)
+                or _guarded_with_counted_drop(call, parents)):
+            return ("non-blocking put without a counted queue.Full "
+                    "drop handler")
+        return None
+    # deque
+    if bounded:
+        return None  # maxlen deque: bounded + non-blocking by construction
+    if _guarded_with_counted_drop(call, parents):
+        return None
+    return "append onto an unbounded deque without a counted-drop guard"
+
+
+def _own_calls(fn_node: ast.AST):
+    """Calls lexically in this function, excluding nested defs (those
+    have their own graph records)."""
+
+    def walk(node: ast.AST):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            if isinstance(child, ast.Call):
+                yield child
+            yield from walk(child)
+
+    yield from walk(fn_node)
+
+
+def _local_receivers(fn_node: ast.AST) -> dict[str, tuple[str, bool]]:
+    out: dict[str, tuple[str, bool]] = {}
+    for sub in ast.walk(fn_node):
+        kb = _ctor_kind_bounded(getattr(sub, "value", None))
+        if kb is not None:
+            for t in _assign_targets(sub):
+                if isinstance(t, ast.Name):
+                    out[t.id] = kb
+    return out
+
+
+def _resolve_receiver(recv: ast.AST, rec, inv: _Receivers,
+                      local: dict[str, tuple[str, bool]]
+                      ) -> tuple[str, bool] | None:
+    if isinstance(recv, ast.Attribute) and isinstance(recv.value, ast.Name) \
+            and recv.value.id == "self" and rec.cls_name is not None:
+        return inv.known.get((rec.key[0], rec.cls_name, recv.attr))
+    if isinstance(recv, ast.Name):
+        if recv.id in local:
+            kind, bounded = local[recv.id]
+            # A function-local deque is same-thread working state (the
+            # read-one-when-deep in-flight windows), not a hand-off —
+            # hand-offs live on shared state: attributes or globals.
+            return None if kind == "deque" else (kind, bounded)
+        return inv.known.get((rec.key[0], None, recv.id))
+    return None

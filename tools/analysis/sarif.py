@@ -1,0 +1,84 @@
+"""SARIF 2.1.0 rendering for the analyzer (``--format=sarif``).
+
+SARIF is the interchange format CI systems (GitHub code scanning,
+Gitlab, Azure) ingest to annotate findings inline on diffs. The
+rendering is deliberately minimal and DETERMINISTIC — no timestamps, no
+elapsed times, rules and results sorted — so the output is diffable and
+a golden file can pin it (tests/golden/analysis_sarif.json).
+
+Mapping:
+
+- every registered rule becomes a ``tool.driver.rules`` entry (id,
+  name, full description from the rule rationale);
+- new findings and syntax errors are ``error``-level results; baselined
+  findings are emitted at ``note`` level with
+  ``baselineState: "unchanged"`` so CI can show-but-not-fail them;
+- the analyzer's line-drift-stable fingerprint rides in
+  ``partialFingerprints`` under ``analysisFingerprint/v1`` — the same
+  key the shrink-only baseline matches on.
+"""
+
+from __future__ import annotations
+
+import json
+
+from tools.analysis.engine import RULES, Finding
+
+_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+           "master/Schemata/sarif-schema-2.1.0.json")
+_INFO_URI = "docs/static-analysis.md"
+
+
+def _result(f: Finding, level: str, baselined: bool) -> dict:
+    out = {
+        "ruleId": f.rule,
+        "level": level,
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {"startLine": max(1, f.line)},
+            },
+        }],
+        "partialFingerprints": {"analysisFingerprint/v1": f.fingerprint},
+    }
+    if baselined:
+        out["baselineState"] = "unchanged"
+    return out
+
+
+def render(report) -> str:
+    """Report -> SARIF 2.1.0 JSON text (sorted, no volatile fields)."""
+    rules = [
+        {
+            "id": r.id,
+            "name": r.name,
+            "shortDescription": {"text": r.name},
+            "fullDescription": {"text": r.rationale},
+            "helpUri": _INFO_URI,
+        }
+        for r in sorted(RULES.values(), key=lambda r: r.id)
+    ]
+    key = lambda f: (f.path, f.line, f.rule, f.message)  # noqa: E731
+    results = [
+        _result(f, "error", False)
+        for f in sorted(report.syntax_errors + report.new, key=key)
+    ] + [
+        _result(f, "note", True)
+        for f in sorted(report.baselined, key=key)
+    ]
+    doc = {
+        "$schema": _SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "igaming-platform-analysis",
+                    "informationUri": _INFO_URI,
+                    "rules": rules,
+                },
+            },
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2)
