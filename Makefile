@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-fast lint lint-json lint-changed lint-sarif lint-update-baseline bench bench-all bench-paced bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos soak-chaos-ledger soak-slo soak-online soak-drift soak-session soak-deadline replay-verify fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
+.PHONY: all test test-fast lint lint-json lint-changed lint-sarif lint-update-baseline bench bench-all bench-fused bench-paced bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos soak-chaos-ledger soak-slo soak-online soak-drift soak-session soak-deadline replay-verify fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
 
 all: native test
 
@@ -44,6 +44,13 @@ bench:
 # The full benchmark matrix (five BASELINE configs + wallet pipeline).
 bench-all:
 	$(PY) benchmarks/run_all.py
+
+# Fused-graph A/B (PR 14): fused vs split with drift sketching AND an
+# active shadow candidate — honest dispatches/RPC, device-step p99 and
+# open-loop paced e2e p99 per arm -> FUSED_r14.json (gated: fused arm
+# must measure 1.0 dispatches/RPC, latency no worse within noise).
+bench-fused:
+	$(PY) bench.py --fused
 
 # Paced-arrival latency gate (deadline scheduler, PR 11): open-loop
 # Poisson ScoreTransaction load at BENCH_PACED_RATE (default 2000 rps on

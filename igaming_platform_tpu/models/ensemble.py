@@ -174,8 +174,26 @@ def make_score_fn(
             ml = mlp_predict_int8(params["mlp_int8"], xn)
         elif ml_backend == "gbdt":
             ml = gbdt_mod.gbdt_predict(params["gbdt"], xn)
+        elif ml_backend == "gbdt_int8":
+            # Quantized oblivious forest (ops.quantize.quantize_gbdt):
+            # int8 thresholds/leaves, bf16 compares — the GBDT half of
+            # the int8-throughout serving variant.
+            from igaming_platform_tpu.ops.quantize import gbdt_predict_int8
+
+            ml = gbdt_predict_int8(params["gbdt_int8"], xn)
         elif ml_backend == "mlp+gbdt":
             ml = 0.5 * (mlp_mod.mlp_predict(params["mlp"], xn) + gbdt_mod.gbdt_predict(params["gbdt"], xn))
+        elif ml_backend == "mlp+gbdt_int8":
+            # Both ensemble halves quantized (ops.quantize
+            # .quantize_checkpoint): with WIRE_DTYPE=int8 the fused
+            # program runs int8 H2D -> int8/bf16 compute -> f32 scores.
+            from igaming_platform_tpu.ops.quantize import (
+                gbdt_predict_int8,
+                mlp_predict_int8,
+            )
+
+            ml = 0.5 * (mlp_predict_int8(params["mlp_int8"], xn)
+                        + gbdt_predict_int8(params["gbdt_int8"], xn))
         elif ml_backend == "multitask":
             from igaming_platform_tpu.models.multitask import fraud_predict
 

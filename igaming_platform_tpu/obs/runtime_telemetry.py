@@ -15,9 +15,15 @@ stages, but three classes of device-runtime trouble never show up there:
 
 - **Dispatch amplification.** The flight entry shows a slow RPC; it
   does not show that the RPC issued 9 device dispatches instead of 2.
-  Every completed ``score.dispatch`` span bumps a per-request
+  Every jit LAUNCH — not every span — bumps a per-request
   ``dispatches`` attribute on its RPC root (visible in /debug/flightz)
-  plus the global ``risk_device_dispatches_total``.
+  plus the global ``risk_device_dispatches_total``: the launch seam
+  (``serve/scorer._device_dispatch``) calls :func:`note_dispatch`, so
+  side launches a stage span never wrapped (the split drift sketch,
+  the shadow scorer's fallback step, the session-ring admission sync,
+  the cache delta scatter, the abuse sequence model) count honestly.
+  Before PR 14 the counter was span-derived and undercounted exactly
+  those launches.
 
 - **Step-time anomalies.** :class:`StepTimeAnomalyDetector` keeps an
   EWMA + EW-variance of per-stage device step time; a step beyond
@@ -45,10 +51,10 @@ from igaming_platform_tpu.obs import tracing
 
 logger = logging.getLogger(__name__)
 
-# Stage spans that count as device work: dispatch launches the compiled
-# step; readback is the D2H drain; score.device is the fused
-# dispatch+readback of the index-mode path.
-_DISPATCH_STAGES = ("score.dispatch", "score.device")
+# Stage spans whose durations feed the step-time anomaly detectors:
+# dispatch launches the compiled step; readback is the D2H drain;
+# score.device is the fused dispatch+readback of the request paths.
+# (Dispatch COUNTING is launch-driven via note_dispatch, not span-driven.)
 _STEP_STAGES = ("score.dispatch", "score.readback", "score.device")
 
 
@@ -197,6 +203,11 @@ class RuntimeTelemetry:
         self.cooldown_s = cooldown_s
         self.profile_enabled = profile_enabled
         self._lock = threading.Lock()
+        # The dispatch counter gets a dedicated LEAF lock: note_dispatch
+        # is called from launch seams that may hold scoring-path locks
+        # (session ring, cache) — a leaf held only for the increment can
+        # never participate in a lock-order cycle with them.
+        self._dispatch_lock = threading.Lock()
         self._detectors: dict[str, StepTimeAnomalyDetector] = {}
         self._detector_kwargs = dict(
             k_sigma=float(os.environ.get("ANOMALY_K_SIGMA", "4.0")),
@@ -226,14 +237,22 @@ class RuntimeTelemetry:
 
     # -- span sink -----------------------------------------------------------
 
+    def note_dispatch(self, count: int = 1) -> None:
+        """One real jit launch (the ``serve/scorer._device_dispatch``
+        seam). Bumps the global counter, the metric, and the CURRENT
+        root span's ``dispatches`` attribute — launch-driven, so the
+        count equals the true number of device programs started, not the
+        number of ``score.dispatch`` spans that happened to wrap them."""
+        with self._dispatch_lock:
+            self.dispatches_total += count
+        if self.metrics is not None:
+            self.metrics.device_dispatches_total.inc(count)
+        span = tracing.current_span()
+        if span is not None:
+            tracing.bump_root_attribute_of(span, "dispatches", count)
+
     def observe_span(self, span) -> None:
         name = getattr(span, "name", "")
-        if name in _DISPATCH_STAGES:
-            with self._lock:
-                self.dispatches_total += 1
-            if self.metrics is not None:
-                self.metrics.device_dispatches_total.inc()
-            tracing.bump_root_attribute_of(span, "dispatches", 1)
         if name not in _STEP_STAGES:
             return
         with self._lock:
@@ -327,11 +346,13 @@ class RuntimeTelemetry:
                     self.metrics.hbm_bytes.set(float(mem[src]), kind=kind)
 
     def snapshot(self) -> dict:
+        with self._dispatch_lock:
+            dispatches = self.dispatches_total
         with self._lock:
             detectors = {name: det.snapshot()
                          for name, det in self._detectors.items()}
             out = {
-                "dispatches_total": self.dispatches_total,
+                "dispatches_total": dispatches,
                 "anomalies_total": self.anomalies_total,
                 "recent_anomalies": list(self.anomalies),
                 "profile_captures": list(self.profile_captures),
@@ -382,3 +403,11 @@ def note_compile_signature(name: str, shape=None, dtype=None) -> bool:
     if t is None:
         return False
     return t.compile_watcher.note_signature(name, shape, dtype)
+
+
+def note_dispatch(count: int = 1) -> None:
+    """Launch-seam helper (serve/scorer._device_dispatch): one real jit
+    launch on the process-default telemetry. No-op without one."""
+    t = DEFAULT
+    if t is not None:
+        t.note_dispatch(count)

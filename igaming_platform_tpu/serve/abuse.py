@@ -154,6 +154,12 @@ class SequenceAbuseDetector:
         if self._batch_multiple > 1 and n % self._batch_multiple:
             padded = ((n + self._batch_multiple - 1) // self._batch_multiple) * self._batch_multiple
             x = np.concatenate([x, np.zeros((padded - n, *x.shape[1:]), x.dtype)])
+        # The sequence model is a real jit launch: route it through the
+        # honest dispatch seam so CheckBonusAbuse RPCs count their device
+        # work like every scoring path does.
+        from igaming_platform_tpu.serve.scorer import _device_dispatch
+
+        _device_dispatch("abuse_seq_step", x.shape, x.dtype)
         return np.asarray(self._fn(self.params, x))[:n]
 
     def _heuristic_one(self, account_id: str) -> tuple[float, list[str]]:

@@ -77,3 +77,34 @@ class ArenaPool:
         with self._lock:
             idle = sum(len(v) for v in self._free.values())
         return {"allocated": self.allocated, "reused": self.reused, "idle": idle}
+
+
+class StagingHold:
+    """Deferred arena release shared by N consumers of one dispatch's
+    staging buffers.
+
+    The donated step's batch echo may alias the staging memory zero-copy
+    on the CPU backend, so a buffer may only return to the pool once
+    EVERY device-side consumer is done with it: the readback worker
+    (the step has consumed its inputs) AND — on the shadow fallback path
+    — the shadow worker that launches its candidate step directly on the
+    echo (serve/shadow.submit_echo). Each party calls :meth:`release`
+    exactly once; the buffers go back to the pool on the last call.
+    Thread-safe; tolerates release from any thread."""
+
+    __slots__ = ("_pool", "_bufs", "_parties", "_lock")
+
+    def __init__(self, pool: ArenaPool, bufs, parties: int = 2):
+        self._pool = pool
+        self._bufs = [b for b in bufs if b is not None]
+        self._parties = int(parties)
+        self._lock = threading.Lock()
+
+    def release(self) -> None:
+        with self._lock:
+            self._parties -= 1
+            if self._parties != 0:
+                return
+            bufs, self._bufs = self._bufs, []
+        for b in bufs:
+            self._pool.release(b)

@@ -175,6 +175,9 @@ class DeviceFeatureCache:
 
         idxs = self.lookup([account_id])
         with self._lock:
+            from igaming_platform_tpu.serve.scorer import _device_dispatch
+
+            _device_dispatch("cache_flag_set", (1,), np.bool_)
             self.flags = self._apply_flags(
                 self.flags, jnp.asarray(idxs), jnp.asarray([value]))
 
@@ -268,6 +271,12 @@ class DeviceFeatureCache:
                 ids = list(refresh)
                 slots = np.fromiter(refresh.values(), np.int32, deltas)
                 rows = self._gather_base_rows(ids, now)
+                # A real jit launch in the between-steps window: count it
+                # at the honest dispatch seam (fires only when deltas /
+                # admissions are pending, never in steady state).
+                from igaming_platform_tpu.serve.scorer import _device_dispatch
+
+                _device_dispatch("cache_apply_deltas", rows.shape, rows.dtype)
                 self.table = self._apply(
                     self.table, jnp.asarray(slots), jnp.asarray(rows))
                 self._row_ts[slots] = now

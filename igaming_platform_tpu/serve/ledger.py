@@ -1509,11 +1509,13 @@ def note_decisions(
     to it by reference (its own O(1) bounded enqueue) so candidate
     params score the live stream without touching any response."""
     shadow = getattr(engine, "shadow", None)
-    if shadow is not None and n > 0:
-        # Heuristic-tier rows come from a different scorer (not the
-        # compiled graph a candidate would replace) and index-mode rows
-        # have no host snapshot — the shadow counts both as skipped.
-        shadow.submit(out, x=x if tier != "heuristic" else None, bl=bl, n=n)
+    if shadow is not None and n > 0 and tier == "heuristic":
+        # Compiled-tier batches reach the shadow at the LAUNCH seam now
+        # (scorer._note_shadow: fused in-graph outputs, or the
+        # donated-batch echo on the fallback path) — this seam only
+        # counts the heuristic tier, which comes from a different scorer
+        # entirely (not the compiled graph a candidate would replace).
+        shadow.note_skipped(n)
     ledger = getattr(engine, "ledger", None)
     if ledger is None or n <= 0:
         return None

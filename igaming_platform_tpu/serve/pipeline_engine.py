@@ -308,7 +308,7 @@ class HostPipeline:
         use_host = engine._fn_host is not None and n <= engine._host_tier
         if not use_host and engine._wire_encode is not None:
             chunk = engine._wire_encode(chunk)
-        xp_buf = bl_buf = None
+        xp_buf = bl_buf = hold = None
         if n == shape:
             xp, blp = chunk, blc
         else:
@@ -316,9 +316,20 @@ class HostPipeline:
             xp, _ = pad_batch(chunk, shape, out=xp_buf)
             bl_buf = self._arena.acquire((shape,), np.bool_)
             blp, _ = pad_batch(blc, shape, out=bl_buf)
+            if getattr(engine, "shadow", None) is not None:
+                # The shadow's fallback path scores directly from the
+                # donated-batch echo, which may alias these staging
+                # buffers zero-copy: a 2-party hold defers the arena
+                # release until readback AND the shadow worker are both
+                # done. The launch seam releases the shadow party
+                # immediately when the echo isn't taken (fused mode,
+                # drops).
+                from igaming_platform_tpu.serve.arena import StagingHold
+
+                hold = StagingHold(self._arena, (xp_buf, bl_buf), parties=2)
         out = engine._launch_padded(xp, blp, use_host, snap=job.snap,
-                                    n_valid=n)
-        return out, xp_buf, bl_buf
+                                    n_valid=n, staging_hold=hold)
+        return out, xp_buf, bl_buf, hold
 
     def _stage_loop(self) -> None:
         while True:
@@ -339,7 +350,8 @@ class HostPipeline:
             try:
                 with span("score.dispatch", parent=job.parent, batch=hi - lo), \
                         annotate("score_step"):
-                    out, xp_buf, bl_buf = self._dispatch_chunk(job, lo, hi)
+                    out, xp_buf, bl_buf, hold = self._dispatch_chunk(
+                        job, lo, hi)
             except BaseException as exc:  # noqa: BLE001 — belongs to the job
                 job.fail(exc)
                 continue
@@ -351,7 +363,7 @@ class HostPipeline:
             # Blocks at `depth` batches in flight: the device stays <=
             # depth steps ahead of readback (bounded memory, ping-pong).
             self._inflight_q.put(  # noqa: MX07 — the bounded in-flight window IS the ping-pong: blocking at depth is the design, not an accident
-                (job, idx, lo, hi - lo, out, xp_buf, bl_buf, t0))
+                (job, idx, lo, hi - lo, out, xp_buf, bl_buf, hold, t0))
 
     # -- readback worker -----------------------------------------------------
 
@@ -362,7 +374,7 @@ class HostPipeline:
             item = self._inflight_q.get()
             if item is _SENTINEL:
                 return
-            job, idx, lo, n, out, xp_buf, bl_buf, t_dispatch = item
+            job, idx, lo, n, out, xp_buf, bl_buf, hold, t_dispatch = item
             t0 = time.monotonic()
             try:
                 with span("score.readback", parent=job.parent, batch=n):
@@ -383,8 +395,13 @@ class HostPipeline:
                               (time.monotonic() - t_dispatch) * 1000.0)
             # Readback done -> the step has consumed its inputs; only now
             # may the staging buffers be rewritten (CPU zero-copy alias).
-            self._arena.release(xp_buf)
-            self._arena.release(bl_buf)
+            # With a hold, the release waits for the echo-fed shadow
+            # fallback's party too.
+            if hold is not None:
+                hold.release()
+            else:
+                self._arena.release(xp_buf)
+                self._arena.release(bl_buf)
             if job.failed:
                 continue
             job.parts[idx] = {k: host[k][:n] for k in _RESULT_KEYS}

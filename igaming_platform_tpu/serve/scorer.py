@@ -17,6 +17,7 @@ TPU serving:
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -90,6 +91,21 @@ def _row_divisor(mesh, ml_backend: str) -> int:
     return max(1, d)
 
 
+def _stack_packed(out: dict):
+    """Canonical dict-output -> packed int32 [5, B] (score, action,
+    reason_mask, rule_score, ml_score as IEEE-754 bits) — one D2H
+    transfer instead of five."""
+    return jnp.stack([
+        out["score"].astype(jnp.int32),
+        out["action"].astype(jnp.int32),
+        out["reason_mask"].astype(jnp.int32),
+        out["rule_score"].astype(jnp.int32),
+        jax.lax.bitcast_convert_type(
+            out["ml_score"].astype(jnp.float32), jnp.int32
+        ),
+    ])
+
+
 def _pack_outputs(fn, echo_batch: bool = False):
     """Wrap a dict-output score fn into one int32 [5, B] output (one D2H
     transfer). Row order: score, action, reason_mask, rule_score,
@@ -105,16 +121,7 @@ def _pack_outputs(fn, echo_batch: bool = False):
     the donated buffer and the staging slot is recycled in place."""
 
     def packed(params, x, blacklisted, thresholds):
-        out = fn(params, x, blacklisted, thresholds)
-        stacked = jnp.stack([
-            out["score"].astype(jnp.int32),
-            out["action"].astype(jnp.int32),
-            out["reason_mask"].astype(jnp.int32),
-            out["rule_score"].astype(jnp.int32),
-            jax.lax.bitcast_convert_type(
-                out["ml_score"].astype(jnp.float32), jnp.int32
-            ),
-        ])
+        stacked = _stack_packed(fn(params, x, blacklisted, thresholds))
         return (stacked, x) if echo_batch else stacked
 
     return packed
@@ -124,13 +131,20 @@ def _device_dispatch(fn_name: str, shape, dtype) -> None:
     """The launch-side chokepoint, mirroring ``_device_readback``: the
     ``device.dispatch`` chaos seam fires here (inside the dispatch stage
     span, so an injected delay attributes to ``score.dispatch`` in the
-    SLO budget table), and the padded shape signature is noted with the
+    SLO budget table), the padded shape signature is noted with the
     compile watcher (obs/runtime_telemetry.py) — a signature seen for
-    the first time after warmup is the recompile-storm tripwire."""
+    the first time after warmup is the recompile-storm tripwire — and
+    the honest dispatch counter bumps (``risk_device_dispatches_total``
+    + the RPC root's ``dispatches`` attribute). EVERY jit launch on a
+    scoring path must route through here: the split drift sketch, the
+    shadow scorer's fallback step, the session-ring admission sync, the
+    cache delta scatter and the abuse model all count, so the
+    dispatches-per-RPC probe measures launches, not spans."""
     from igaming_platform_tpu.obs import runtime_telemetry as _rt
 
     chaos.fire("device.dispatch")
     _rt.note_compile_signature(fn_name, shape, dtype)
+    _rt.note_dispatch()
 
 
 def _device_readback(out):
@@ -192,6 +206,25 @@ class TPUScoringEngine:
         self._drift_sketch_fn = None
         self._drift_cached_fn = None
         self._drift_lock = threading.Lock()
+        # Fused mega-step (one graph, one dispatch): per path family
+        # (packed / host / cached / session) a single pjit'd program
+        # folds the drift sketch and — when a candidate sits in shadow —
+        # the candidate re-score into the SAME dispatch, sharing the
+        # feature gather and elementwise prologue. Variants are keyed
+        # (family, sketch, shadow), built+AOT-warmed OFF the request
+        # path (bind_drift at boot; _on_shadow_candidate on a daemon
+        # thread), and a launch only selects a variant already in
+        # `_fused_ready` — until then it falls back to the split path,
+        # so neither bind_drift nor set_candidate ever stalls serving.
+        # FUSED=0 keeps the split paths entirely; SHADOW_FUSED=0 keeps
+        # the shadow on its fallback (echo-fed) path.
+        self._fused_enabled = os.environ.get("FUSED", "1") not in ("0", "false")
+        self._shadow_fused_enabled = (
+            os.environ.get("SHADOW_FUSED", "1") not in ("0", "false"))
+        self._fused_lock = threading.Lock()
+        self._fused_fns: dict[tuple, Any] = {}
+        self._fused_ready: set[tuple] = set()
+        self._shadow_warm_thread: threading.Thread | None = None
         self.params_fingerprint = ledger_mod.params_fingerprint(params)
         self.features = feature_store or InMemoryFeatureStore()
         bcfg = batcher_config or BatcherConfig()
@@ -512,6 +545,25 @@ class TPUScoringEngine:
         self.drift = drift_engine
         if self.cache is not None:
             self._ensure_drift_cached_fn()
+        if self._fused_enabled:
+            # Fold the sketch into the scoring program itself: one
+            # dispatch carries score + sketch (+ the shadow branch once
+            # a candidate warms). bind_drift runs at boot / engine
+            # rebuild, so this compile is off the request path; the
+            # split kernels above stay compiled as the FUSED=0 /
+            # warmup-window fallback.
+            self._warm_fused("packed", True, False)
+            if self._fn_host is not None:
+                self._warm_fused("host", True, False)
+            if self.cache is not None:
+                self._warm_fused(
+                    "session" if self.session is not None else "cached",
+                    True, False)
+            shadow = self.shadow
+            if shadow is not None:
+                # Drift bound after a candidate was already in shadow:
+                # re-warm the sketch+shadow variants to match.
+                self._on_shadow_candidate(shadow)
 
     def _ensure_drift_cached_fn(self):
         """Build (once) the index-mode sketch step — the cache rows live
@@ -536,41 +588,344 @@ class TPUScoringEngine:
                 self._drift_cached_fn = fn
         return self._drift_cached_fn
 
-    def _note_drift(self, echo, packed, n: int) -> None:
-        """Dispatch the fused sketch reduction over a just-launched
-        batch (``echo`` is the donated-batch echo output — device
-        resident by construction) and hand the result vector to the
-        drift engine's bounded queue. Never raises, never blocks, never
-        adds a host sync: failures count in the engine's own report."""
+    def _note_drift(self, echo, packed, n: int, sketch=None) -> None:
+        """Hand one batch's sketch to the drift engine's bounded queue.
+        On the fused path ``sketch`` is the vector computed INSIDE the
+        scoring dispatch (int8 wire included — the program dequantizes
+        in-graph before sketching); on the split path the sketch is a
+        separate kernel launch over the donated-batch echo (device
+        resident by construction), routed through the dispatch seam so
+        it counts honestly. Never raises, never blocks, never adds a
+        host sync: failures count in the engine's own report."""
         drift = self.drift
         if drift is None or n <= 0:
             return
         try:
+            if sketch is not None:
+                drift.submit(sketch, n)
+                return
             if echo.dtype == np.int8:
-                # int8 wire compression: the echo carries the QUANTIZED
-                # domain; sketching it would monitor codec artifacts,
-                # not traffic. Counted, not silently missing.
+                # int8 wire compression on the SPLIT path: the echo
+                # carries the QUANTIZED domain; sketching it would
+                # monitor codec artifacts, not traffic. Counted, not
+                # silently missing. (The fused program sketches the
+                # in-graph dequantized rows instead.)
                 drift.note_skipped(n, "compressed")
                 return
+            _device_dispatch("sketch_kernel", echo.shape, echo.dtype)
             drift.submit(self._drift_sketch_fn(echo, packed, np.int32(n)), n)
         except Exception:  # noqa: CC04 — drift observability must never fail scoring; the engine counts its errors
             drift.note_error()
 
-    def _note_drift_cached(self, idxsp, amtp, typp, packed, n: int) -> None:
-        """Index-mode twin of ``_note_drift``: sketch from the
-        device-resident feature table rows (host never materializes
-        them)."""
+    def _note_drift_cached(self, idxsp, amtp, typp, packed, n: int,
+                           sketch=None) -> None:
+        """Index-mode twin of ``_note_drift``: the fused cached/session
+        program computes the sketch in-graph; the split fallback
+        re-gathers the device-resident feature table rows (host never
+        materializes them) with one extra, honestly-counted launch."""
         drift = self.drift
         if drift is None or n <= 0:
             return
         try:
+            if sketch is not None:
+                drift.submit(sketch, n)
+                return
             fn = self._ensure_drift_cached_fn()
             if fn is None:
                 return
+            _device_dispatch("cached_sketch_kernel", idxsp.shape, idxsp.dtype)
             drift.submit(fn(self.cache.table, idxsp, amtp, typp, packed,
                             np.int32(n)), n)
         except Exception:  # noqa: CC04 — drift observability must never fail scoring; the engine counts its errors
             drift.note_error()
+
+    # -- fused mega-step (one graph, one dispatch) ----------------------------
+    #
+    # Per Hummingbird, classical-model serving wins by compiling the whole
+    # prediction pipeline into one tensor program. These variants fold the
+    # drift sketch and the shadow-candidate re-score into the scoring
+    # dispatch itself: the XLA scheduler shares the feature gather and
+    # elementwise prologue between production and candidate, the sketch
+    # consumes the batch in-graph (no echo round-trip), and the sketch /
+    # shadow outputs ride the dispatch's own output handles into the same
+    # bounded queues — the drift worker and ShadowScorer._worker become
+    # pure host-side consumers.
+
+    def _build_fused(self, family: str, sketch: bool, shadow: bool):
+        """Construct + jit one fused-program variant. Outputs are a
+        variable-length tuple: (packed, echo[, ring,cursor,length]
+        [, sketch][, shadow_packed]) — the launch site knows the layout
+        from the (sketch, shadow) key it selected."""
+        from igaming_platform_tpu.obs import drift as drift_mod
+        from igaming_platform_tpu.ops.quantize import wire_dequantize_int8
+
+        core = self._score_fn_f32
+
+        if family in ("packed", "host"):
+            int8_wire = family == "packed" and self._wire_dtype is np.int8
+
+            def fused(params, cand, x, bl, thr, n):
+                xr = wire_dequantize_int8(x) if int8_wire else x
+                out = core(params, xr, bl, thr)
+                packed = _stack_packed(out)
+                res = [packed, x]
+                if sketch:
+                    res.append(drift_mod.sketch_kernel(
+                        jnp.asarray(xr, jnp.float32), packed, n))
+                if shadow:
+                    res.append(_stack_packed(core(cand, xr, bl, thr)))
+                return tuple(res)
+
+            donate = (2,) if family == "packed" else ()
+            if self._mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                row = NamedSharding(self._mesh, P(AXIS_DATA, None))
+                vec = NamedSharding(self._mesh, P(AXIS_DATA))
+                repl = NamedSharding(self._mesh, P())
+                pk = NamedSharding(self._mesh, P(None, AXIS_DATA))
+                outs = [pk, row] + ([repl] if sketch else []) \
+                    + ([pk] if shadow else [])
+                return jax.jit(
+                    fused,
+                    in_shardings=(None, None, row, vec, repl, repl),
+                    out_shardings=tuple(outs),
+                    donate_argnums=donate)
+            return jax.jit(fused, donate_argnums=donate)
+
+        if family == "cached":
+            txa, td, tw, tb = (
+                int(F.TX_AMOUNT), int(F.TX_TYPE_DEPOSIT),
+                int(F.TX_TYPE_WITHDRAW), int(F.TX_TYPE_BET),
+            )
+
+            def fused_cached(params, cand, table, flags, idxs, amounts,
+                             types, bl, thr, n):
+                x = table[idxs]
+                f32 = x.dtype
+                x = x.at[:, txa].set(amounts)
+                x = x.at[:, td].set((types == 0).astype(f32))
+                x = x.at[:, tw].set((types == 1).astype(f32))
+                x = x.at[:, tb].set((types == 2).astype(f32))
+                blv = jnp.logical_or(bl, flags[idxs])
+                out = core(params, x, blv, thr)
+                packed = _stack_packed(out)
+                res = [packed]
+                if sketch:
+                    res.append(drift_mod.sketch_kernel(x, packed, n))
+                if shadow:
+                    res.append(_stack_packed(core(cand, x, blv, thr)))
+                return tuple(res)
+
+            if self._mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                vec = NamedSharding(self._mesh, P(AXIS_DATA))
+                repl = NamedSharding(self._mesh, P())
+                pk = NamedSharding(self._mesh, P(None, AXIS_DATA))
+                outs = [pk] + ([repl] if sketch else []) \
+                    + ([pk] if shadow else [])
+                return jax.jit(
+                    fused_cached,
+                    in_shardings=(None, None, repl, repl, vec, vec, vec,
+                                  vec, repl, repl),
+                    out_shardings=tuple(outs))
+            return jax.jit(fused_cached)
+
+        if family == "session":
+            from igaming_platform_tpu.serve import session_state as session_mod
+
+            mgr = self.session
+            step = session_mod.make_session_step(
+                core, self.config, mgr.head_fn,
+                capacity=self.cache.capacity, n_events=mgr.n_events,
+                min_events=mgr.min_events,
+                flag_threshold=mgr.flag_threshold,
+                sketch=sketch, shadow=shadow)
+            if self._mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                repl = NamedSharding(self._mesh, P())
+                vec = NamedSharding(self._mesh, P(AXIS_DATA))
+                row = NamedSharding(self._mesh, P(AXIS_DATA, None))
+                pk = NamedSharding(self._mesh, P(None, AXIS_DATA))
+                outs = [pk, repl, repl, repl] + ([repl] if sketch else []) \
+                    + ([pk] if shadow else [])
+                return jax.jit(
+                    step,
+                    in_shardings=(None, None, repl, repl, repl, repl, repl,
+                                  vec, vec, vec, vec, vec, row, vec, repl,
+                                  None, repl),
+                    out_shardings=tuple(outs),
+                    donate_argnums=(4, 5, 6))
+            return jax.jit(step, donate_argnums=(4, 5, 6))
+
+        raise ValueError(f"unknown fused family {family!r}")
+
+    def _ensure_fused(self, family: str, sketch: bool, shadow: bool):
+        """Build (once) the jitted fused variant. The memo key is
+        (family, sketch, shadow) ONLY — candidate params enter as a
+        traced argument tree, never the key, so a new candidate reuses
+        the ladder-shape executables (no per-candidate retrace; the
+        JX06 analyzer check pins this discipline)."""
+        key = (family, sketch, shadow)
+        ffn = self._fused_fns.get(key)
+        if ffn is not None:
+            return ffn
+        with self._fused_lock:
+            ffn = self._fused_fns.get(key)
+            if ffn is None:
+                ffn = self._build_fused(family, sketch, shadow)
+                self._fused_fns[key] = ffn
+        return ffn
+
+    def _warm_fused(self, family: str, sketch: bool, shadow: bool,
+                    cand=None):
+        """AOT-compile every ladder shape of one fused variant (always
+        OFF the request path: bind_drift at boot, ensure_cache's build
+        window, or the shadow-candidate warm thread), then mark it
+        launchable. A launch only ever selects a key in
+        ``_fused_ready``, so serving never blocks on these compiles."""
+        ffn = self._ensure_fused(family, sketch, shadow)
+        with self._params_lock:
+            params = self._params
+        if family in ("packed", "host"):
+            host = family == "host"
+            p = self._params_host if host else params
+            thr = self._thresholds_host if host else self._thresholds
+            dt = np.float32 if host else self._wire_dtype
+            for shape in self._shapes:
+                if host and shape > self._pick_shape(self._host_tier):
+                    continue
+                x = np.zeros((shape, NUM_FEATURES), dtype=dt)
+                bl = np.zeros((shape,), dtype=bool)
+                jax.block_until_ready(
+                    ffn(p, cand, x, bl, thr, np.int32(0)))
+        elif family == "cached":
+            cache = self.cache
+            for shape in self._shapes:
+                idxs = np.zeros((shape,), dtype=np.int32)
+                amounts = np.zeros((shape,), dtype=np.float32)
+                types = np.full((shape,), 4, dtype=np.int32)
+                bl = np.zeros((shape,), dtype=bool)
+                jax.block_until_ready(ffn(
+                    params, cand, cache.table, cache.flags, idxs, amounts,
+                    types, bl, self._thresholds, np.int32(0)))
+        elif family == "session":
+            from igaming_platform_tpu.serve import session_state as session_mod
+
+            mgr = self.session
+            cache = self.cache
+            with mgr.lock:
+                for shape in self._shapes:
+                    idxs = np.zeros((shape,), dtype=np.int32)
+                    sidx = np.full((shape,), cache.capacity, dtype=np.int32)
+                    occ = np.arange(shape, dtype=np.int32)
+                    amounts = np.zeros((shape,), dtype=np.float32)
+                    types = np.full((shape,), 4, dtype=np.int32)
+                    events = np.zeros((shape, session_mod.EVENT_WIDTH),
+                                      dtype=np.float32)
+                    bl = np.zeros((shape,), dtype=bool)
+                    res = ffn(
+                        params, mgr.head_params, cache.table, cache.flags,
+                        mgr.session_ring, mgr.session_cursor,
+                        mgr.session_length, idxs, sidx, occ, amounts,
+                        types, events, bl, self._thresholds, cand,
+                        np.int32(0))
+                    jax.block_until_ready(res[0])
+                    mgr.adopt(res[1], res[2], res[3])
+        self._fused_ready.add((family, sketch, shadow))
+        return ffn
+
+    def _select_fused(self, family: str):
+        """Pick the best READY fused variant for a launch: (fn,
+        sketch_in_graph, (generation, candidate_params) | None), or None
+        for the split path. Preference order: sketch+shadow when a
+        candidate is active and its variant warmed; sketch-only (built
+        at bind_drift); else split. Reads of ``_fused_ready`` are
+        lock-free (GIL-atomic membership; a key is added only after all
+        its ladder shapes compiled)."""
+        if not self._fused_enabled:
+            return None
+        sketch = self.drift is not None
+        shadow = self.shadow
+        if shadow is not None and self._shadow_fused_enabled:
+            sstate = shadow.active_state()
+            if (sstate is not None
+                    and (family, sketch, True) in self._fused_ready):
+                return self._fused_fns[(family, sketch, True)], sketch, sstate
+        if sketch and (family, True, False) in self._fused_ready:
+            return self._fused_fns[(family, True, False)], True, None
+        return None
+
+    def _on_shadow_candidate(self, shadow) -> None:
+        """ShadowScorer hook (constructor / set_candidate / supervisor
+        rebind): AOT-build and warm the shadow-branch fused variants on
+        a daemon thread so installing a candidate NEVER stalls serving.
+        Until the warm completes, dispatches ride the sketch-only
+        program and the candidate scores on the echo-fed split path —
+        same numbers, one extra launch."""
+        if not (self._fused_enabled and self._shadow_fused_enabled):
+            return
+        if shadow.active_state() is None:
+            return
+        t = self._shadow_warm_thread
+        if t is not None and t.is_alive():
+            return
+        t = threading.Thread(target=self._warm_shadow_fused, args=(shadow,),
+                             name="fused-shadow-warm", daemon=True)
+        self._shadow_warm_thread = t
+        t.start()
+
+    def _warm_shadow_fused(self, shadow) -> None:
+        try:
+            state = shadow.active_state()
+            if state is None:
+                return
+            cand = state[1]
+            sketch = self.drift is not None
+            fams = ["packed"]
+            if self.cache is not None:
+                fams.append("session" if self.session is not None
+                            else "cached")
+            for fam in fams:
+                if (fam, sketch, True) not in self._fused_ready:
+                    self._warm_fused(fam, sketch, True, cand=cand)
+        except Exception:  # noqa: CC04 — a candidate that cannot trace must not poison serving; the split shadow path counts its own errors
+            logging.getLogger(__name__).warning(
+                "fused shadow warm failed; candidates keep scoring on the "
+                "split (echo-fed) shadow path", exc_info=True)
+
+    def _note_shadow(self, out, echo, blp, n: int, thresholds,
+                     shadow_out=None, gen=None, staging_hold=None) -> None:
+        """The single shadow hand-off chokepoint (CC09 seam). Fused
+        launches hand the candidate outputs computed in-graph
+        (``shadow_out`` — zero extra launches, zero extra H2D); split
+        launches hand the donated-batch echo so the fallback worker
+        re-scores from DEVICE-resident rows instead of re-shipping x
+        host->device. Index-mode split rows have no echo and stay
+        counted-skipped. Never raises. Exactly one party of
+        ``staging_hold`` is released here unless the shadow worker takes
+        ownership of the echo."""
+        shadow = self.shadow
+        try:
+            if shadow is None or n <= 0:
+                return
+            if shadow_out is not None:
+                shadow.submit_scored(out, shadow_out, n, gen)
+                return
+            if echo is None:
+                shadow.note_skipped(n)
+                return
+            if shadow.submit_echo(out, echo, blp, n,
+                                  np.asarray(thresholds, np.int32),
+                                  staging_hold):
+                staging_hold = None  # the worker now owns the release
+        except Exception:  # noqa: CC04 — the shadow must never fail scoring; drops are visible in its own report
+            pass
+        finally:
+            if staging_hold is not None:
+                staging_hold.release()
 
     def _ensure_pipeline(self):
         """Build (once) the staged host pipeline; None when disabled."""
@@ -589,13 +944,17 @@ class TPUScoringEngine:
 
     def _launch_padded(self, xp: np.ndarray, blp: np.ndarray, use_host: bool,
                        snap: tuple | None = None,
-                       n_valid: int | None = None):
+                       n_valid: int | None = None,
+                       staging_hold=None):
         """Dispatch one already-padded staging batch (pipeline dispatch
         worker). The caller owns the staging buffers and must keep them
         alive until readback — jax may alias host memory zero-copy on
         the CPU backend. ``snap`` (params_snapshot) pins the params a
         multi-chunk job scores with across a concurrent hot-swap;
-        ``n_valid`` (rows before padding) masks the drift sketch."""
+        ``n_valid`` (rows before padding) masks the drift sketch.
+        ``staging_hold`` (serve/arena.StagingHold) defers the arena
+        release of the staging buffers until both readback AND the
+        echo-fed shadow fallback (when it takes the echo) are done."""
         if snap is None:
             snap = self.params_snapshot()
         if n_valid is None:
@@ -604,18 +963,43 @@ class TPUScoringEngine:
         # batch (bounded by the bulk lane's aging budget) — the device
         # queue orders interactive steps first under contention.
         self.lane_gate.acquire(LANE_BULK)
+        self._note_session_bypass(n_valid)
+        return self._dispatch_packed(xp, blp, use_host, snap, n_valid,
+                                     staging_hold=staging_hold)
+
+    def _dispatch_packed(self, xp: np.ndarray, blp: np.ndarray,
+                         use_host: bool, snap: tuple, n: int,
+                         staging_hold=None):
+        """The packed/host launch core shared by every row-shaped path.
+        Selects the fused program (score + drift sketch + shadow branch
+        in ONE dispatch — one launch, one readback handle) when a warm
+        variant exists, else the split program with the sketch and the
+        shadow fed off the donated-batch echo."""
+        family = "host" if use_host else "packed"
         params = snap[1] if use_host else snap[0]
         thresholds = self._thresholds_host if use_host else self._thresholds
-        self._note_session_bypass(n_valid)
-        if use_host:
-            _device_dispatch("packed_step_host", xp.shape, xp.dtype)
-            out, echo = self._fn_host(params, xp, blp, thresholds)
-            self._note_drift(echo, out, n_valid)
-            return out
-        _device_dispatch("packed_step", xp.shape, xp.dtype)
-        out, echo = self._packed_fn(params, xp, blp, thresholds)
-        self._note_drift(echo, out, n_valid)
-        if hasattr(out, "copy_to_host_async"):
+        fsel = self._select_fused(family)
+        if fsel is not None:
+            ffn, has_sketch, sstate = fsel
+            cand = sstate[1] if sstate is not None else None
+            _device_dispatch(f"fused_{family}_step", xp.shape, xp.dtype)
+            res = ffn(params, cand, xp, blp, thresholds, np.int32(n))
+            out, echo = res[0], res[1]
+            sk = res[2] if has_sketch else None
+            sh = res[2 + int(has_sketch)] if sstate is not None else None
+            self._note_drift(echo, out, n, sketch=sk)
+            self._note_shadow(out, echo, blp, n, thresholds, shadow_out=sh,
+                              gen=sstate[0] if sstate is not None else None,
+                              staging_hold=staging_hold)
+        else:
+            _device_dispatch("packed_step_host" if use_host
+                             else "packed_step", xp.shape, xp.dtype)
+            fn = self._fn_host if use_host else self._packed_fn
+            out, echo = fn(params, xp, blp, thresholds)
+            self._note_drift(echo, out, n)
+            self._note_shadow(out, echo, blp, n, thresholds,
+                              staging_hold=staging_hold)
+        if not use_host and hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
         return out
 
@@ -811,6 +1195,14 @@ class TPUScoringEngine:
             # A drift engine bound before the cache existed: compile +
             # warm the index-mode sketch now, off the live request path.
             self._ensure_drift_cached_fn()
+            if self._fused_enabled:
+                self._warm_fused(
+                    "session" if self.session is not None else "cached",
+                    True, False)
+        if self.shadow is not None and self._fused_enabled:
+            # A candidate already in shadow gets its cached/session
+            # fused variant warmed off-path too.
+            self._on_shadow_candidate(self.shadow)
         return cache
 
     def _ensure_session(self, cache) -> None:
@@ -899,6 +1291,7 @@ class TPUScoringEngine:
         params = snap[0]
         mgr = self.session
         if mgr is not None and account_ids is not None:
+            fsel = self._select_fused("session")
             # Host-index commit + device dispatch under the session lock:
             # device append order must match host (and therefore ledger /
             # replay) order, and the donated ring buffers are rebound
@@ -918,26 +1311,64 @@ class TPUScoringEngine:
                     # Pad rows all target the scratch slot: distinct
                     # occurrence ranks keep their appends off each other.
                     occp[n:] = np.arange(shape - n, dtype=np.int32)
-                _device_dispatch("session_step", idxsp.shape, idxsp.dtype)
-                out, ring2, cur2, len2 = self._session_fn(
-                    params, mgr.head_params, self.cache.table,
-                    self.cache.flags, mgr.session_ring, mgr.session_cursor,
-                    mgr.session_length, idxsp, sidxp, occp, amtp, typp,
-                    evp, blp, self._thresholds)
+                sk = sh = sstate = None
+                if fsel is not None:
+                    ffn, has_sketch, sstate = fsel
+                    cand = sstate[1] if sstate is not None else None
+                    _device_dispatch("fused_session_step", idxsp.shape,
+                                     idxsp.dtype)
+                    res = ffn(
+                        params, mgr.head_params, self.cache.table,
+                        self.cache.flags, mgr.session_ring,
+                        mgr.session_cursor, mgr.session_length, idxsp,
+                        sidxp, occp, amtp, typp, evp, blp,
+                        self._thresholds, cand, np.int32(n))
+                    out, ring2, cur2, len2 = res[0], res[1], res[2], res[3]
+                    sk = res[4] if has_sketch else None
+                    sh = (res[4 + int(has_sketch)]
+                          if sstate is not None else None)
+                else:
+                    _device_dispatch("session_step", idxsp.shape,
+                                     idxsp.dtype)
+                    out, ring2, cur2, len2 = self._session_fn(
+                        params, mgr.head_params, self.cache.table,
+                        self.cache.flags, mgr.session_ring,
+                        mgr.session_cursor, mgr.session_length, idxsp,
+                        sidxp, occp, amtp, typp, evp, blp,
+                        self._thresholds)
                 mgr.adopt(ring2, cur2, len2)
-            self._note_drift_cached(idxsp, amtp, typp, out, n)
+            self._note_drift_cached(idxsp, amtp, typp, out, n, sketch=sk)
+            self._note_shadow(out, None, blp, n, self._thresholds,
+                              shadow_out=sh,
+                              gen=sstate[0] if sstate is not None else None)
             if hasattr(out, "copy_to_host_async"):
                 out.copy_to_host_async()
             return out, n, {"ts": ts, "lens": post_len, "seqs": seqs,
                             "hashes": audit}
-        _device_dispatch("cached_step", idxsp.shape, idxsp.dtype)
-        out = self._cached_fn(
-            params, self.cache.table, self.cache.flags,
-            idxsp, amtp, typp, blp, self._thresholds)
-        # Index-mode drift sketch: re-gather the scored rows from the
-        # HBM table and reduce on device — the rows never exist on the
+        fsel = self._select_fused("cached")
+        sk = sh = sstate = None
+        if fsel is not None:
+            ffn, has_sketch, sstate = fsel
+            cand = sstate[1] if sstate is not None else None
+            _device_dispatch("fused_cached_step", idxsp.shape, idxsp.dtype)
+            res = ffn(params, cand, self.cache.table, self.cache.flags,
+                      idxsp, amtp, typp, blp, self._thresholds, np.int32(n))
+            out = res[0]
+            sk = res[1] if has_sketch else None
+            sh = res[1 + int(has_sketch)] if sstate is not None else None
+        else:
+            _device_dispatch("cached_step", idxsp.shape, idxsp.dtype)
+            out = self._cached_fn(
+                params, self.cache.table, self.cache.flags,
+                idxsp, amtp, typp, blp, self._thresholds)
+        # Index-mode drift sketch: computed in-graph on the fused path;
+        # the split fallback re-gathers the scored rows from the HBM
+        # table and reduces on device — the rows never exist on the
         # host, and neither does any new sync (obs/drift.py).
-        self._note_drift_cached(idxsp, amtp, typp, out, n)
+        self._note_drift_cached(idxsp, amtp, typp, out, n, sketch=sk)
+        self._note_shadow(out, None, blp, n, self._thresholds,
+                          shadow_out=sh,
+                          gen=sstate[0] if sstate is not None else None)
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
         return out, n, None
@@ -1156,25 +1587,11 @@ class TPUScoringEngine:
             # Snapshot under the lock, dispatch outside it — scoring must
             # never serialize on the params mutex.
             snap = self.params_snapshot()
-        params = snap[1] if use_host else snap[0]
-        thresholds = self._thresholds_host if use_host else self._thresholds
-        if use_host:
-            _device_dispatch("packed_step_host", xp.shape, xp.dtype)
-            out, echo = self._fn_host(params, xp, blp, thresholds)
-            self._note_drift(echo, out, n)
-            return out, n
-        # The echo (the donated staging slot, recycled in place) is
-        # dropped here: this lockstep path pads into fresh arrays. The
-        # pipelined path (serve/pipeline_engine.py) holds its arena
-        # buffers until readback instead. With a drift engine bound, the
-        # echo first feeds ONE extra fused sketch reduction — device to
-        # device, drained off-path (obs/drift.py).
-        _device_dispatch("packed_step", xp.shape, xp.dtype)
-        out, echo = self._packed_fn(params, xp, blp, thresholds)
-        self._note_drift(echo, out, n)
-        if hasattr(out, "copy_to_host_async"):
-            out.copy_to_host_async()
-        return out, n
+        # This lockstep path pads into fresh arrays, so the echo (and the
+        # shadow fallback holding it) needs no staging hold; the
+        # pipelined path (serve/pipeline_engine.py) passes one so its
+        # arena buffers outlive every device-side consumer.
+        return self._dispatch_packed(xp, blp, use_host, snap, n), n
 
     def launch_packed(self, x: np.ndarray, bl: np.ndarray):
         """Dispatch the score step; returns the packed int32 [5, B] device
@@ -1411,4 +1828,5 @@ class TPUScoringEngine:
             x = self._wire_encode(np.asarray(x, np.float32))
         with self._params_lock:
             params = self._params
+        _device_dispatch("score_arrays", x.shape, x.dtype)
         return self._fn(params, x, blacklisted, self._thresholds)

@@ -284,7 +284,8 @@ class SessionChunkAudit:
 
 def make_session_step(score_fn, cfg, head_fn, *, capacity: int,
                       n_events: int, min_events: int,
-                      flag_threshold: float):
+                      flag_threshold: float,
+                      sketch: bool = False, shadow: bool = False):
     """Build the jittable fused session scoring step.
 
     Signature (scorer jits it with the ring state donated)::
@@ -292,6 +293,17 @@ def make_session_step(score_fn, cfg, head_fn, *, capacity: int,
         step(params, sparams, table, flags, ring, cursor, length,
              idxs, sidx, occ, amounts, types, events, bl, thr)
           -> (packed [5, B] int32, ring', cursor', length')
+
+    ``sketch``/``shadow`` select the PR 14 fused-variant layout: the
+    signature gains trailing ``(..., cand, n)`` arguments and the
+    outputs extend to ``(packed, ring', cursor', length'[, sketch]
+    [, shadow_packed])`` — the drift sketch reduces the composed rows
+    in-graph (obs/drift.sketch_kernel over the same gather) and the
+    shadow branch re-scores the identical composition with the
+    CANDIDATE param tree, INCLUDING the session fold (same ``sprob``,
+    same warm/cold semantics): promotion evidence is about exactly the
+    stateful program that would serve. With both flags False the
+    original signature and outputs are returned unchanged.
 
     ``idxs`` indexes the feature table (pad rows -> slot 0, scored and
     discarded, as on the plain cached path); ``sidx`` indexes the ring
@@ -319,24 +331,10 @@ def make_session_step(score_fn, cfg, head_fn, *, capacity: int,
         int(F.TX_TYPE_WITHDRAW), int(F.TX_TYPE_BET),
     )
 
-    def step(params, sparams, table, flags, ring, cursor, length,
-             idxs, sidx, occ, amounts, types, events, bl, thr):
-        # -- feature gather + context columns (the cached step, inlined) --
-        x = table[idxs]
-        f32 = x.dtype
-        x = x.at[:, txa].set(amounts)
-        x = x.at[:, td].set((types == 0).astype(f32))
-        x = x.at[:, tw].set((types == 1).astype(f32))
-        x = x.at[:, tb].set((types == 2).astype(f32))
-        out = score_fn(params, x, jnp.logical_or(bl, flags[idxs]), thr)
-
-        # -- session head over the post-append window ---------------------
-        win, lp = build_windows(ring, cursor, length, sidx, events, n_events)
-        sprob = head_fn(sparams, win, lp).astype(jnp.float32)
-        real = sidx < capacity
-        warm = jnp.logical_and(lp >= min_events, real)
-        fold = jnp.logical_and(warm, sprob >= flag_threshold)
-
+    def _session_fold(out, sprob, fold, cold, thr):
+        """Fold one param tree's base outputs through the session head
+        result — shared bit-for-bit by the production and the shadow
+        branch (``sprob``/``fold``/``cold`` are params-independent)."""
         ml = out["ml_score"].astype(jnp.float32)
         ml2 = jnp.where(fold, jnp.maximum(ml, sprob), ml)
         # Recombine exactly as the base graph did (combine() is pure in
@@ -347,15 +345,35 @@ def make_session_step(score_fn, cfg, head_fn, *, capacity: int,
         final, action, mask = combine(out["rule_score"], ml2, mask_base,
                                       cfg, thr)
         mask = mask | jnp.where(fold, 1 << SESSION_PATTERN_BIT, 0)
-        cold = jnp.logical_and(jnp.logical_not(warm), real)
         mask = mask | jnp.where(cold, 1 << SESSION_COLD_BIT, 0)
-        packed = jnp.stack([
+        return jnp.stack([
             final.astype(jnp.int32),
             action.astype(jnp.int32),
             mask.astype(jnp.int32),
             out["rule_score"].astype(jnp.int32),
             jax.lax.bitcast_convert_type(ml2, jnp.int32),
         ])
+
+    def _body(params, sparams, table, flags, ring, cursor, length,
+              idxs, sidx, occ, amounts, types, events, bl, thr, cand, n):
+        # -- feature gather + context columns (the cached step, inlined) --
+        x = table[idxs]
+        f32 = x.dtype
+        x = x.at[:, txa].set(amounts)
+        x = x.at[:, td].set((types == 0).astype(f32))
+        x = x.at[:, tw].set((types == 1).astype(f32))
+        x = x.at[:, tb].set((types == 2).astype(f32))
+        blv = jnp.logical_or(bl, flags[idxs])
+        out = score_fn(params, x, blv, thr)
+
+        # -- session head over the post-append window ---------------------
+        win, lp = build_windows(ring, cursor, length, sidx, events, n_events)
+        sprob = head_fn(sparams, win, lp).astype(jnp.float32)
+        real = sidx < capacity
+        warm = jnp.logical_and(lp >= min_events, real)
+        fold = jnp.logical_and(warm, sprob >= flag_threshold)
+        cold = jnp.logical_and(jnp.logical_not(warm), real)
+        packed = _session_fold(out, sprob, fold, cold, thr)
 
         # -- in-place append (donated buffers: ring'/cursor'/length' alias
         #    their inputs; the scratch slot soaks up padding rows) --------
@@ -367,7 +385,24 @@ def make_session_step(score_fn, cfg, head_fn, *, capacity: int,
         # The scratch slot stays empty so a pad row can never look warm.
         cursor2 = cursor2.at[capacity].set(0)
         length2 = length2.at[capacity].set(0)
-        return packed, ring2, cursor2, length2
+        res = [packed, ring2, cursor2, length2]
+        if sketch:
+            from igaming_platform_tpu.obs.drift import sketch_kernel
+
+            res.append(sketch_kernel(x, packed, n))
+        if shadow:
+            out_c = score_fn(cand, x, blv, thr)
+            res.append(_session_fold(out_c, sprob, fold, cold, thr))
+        return tuple(res)
+
+    if sketch or shadow:
+        return _body
+
+    def step(params, sparams, table, flags, ring, cursor, length,
+             idxs, sidx, occ, amounts, types, events, bl, thr):
+        return _body(params, sparams, table, flags, ring, cursor, length,
+                     idxs, sidx, occ, amounts, types, events, bl, thr,
+                     None, 0)[:4]
 
     return step
 
@@ -578,6 +613,13 @@ class SessionStateManager:
                     lens[i] = win.shape[0]
                     rehydrated += 1
             cursors = np.mod(lens, self.n_events).astype(np.int32)
+            # The admission sync is a real jit launch in the between-steps
+            # window: it fires the honest dispatch seam so the
+            # dispatches-per-RPC probe counts it (it shows up only when
+            # admissions/rehydrations happen, never in steady state).
+            from igaming_platform_tpu.serve.scorer import _device_dispatch
+
+            _device_dispatch("session_sync", (k, self.n_events), np.float32)
             self.session_ring, self.session_cursor, self.session_length = (
                 self._sync(self.session_ring, self.session_cursor,
                            self.session_length,

@@ -7,29 +7,37 @@ much do its scores diverge. This module provides that evidence with a
 hard guarantee: **the shadow path can never alter, delay, or fail a
 production response.**
 
-Mechanics:
+Mechanics (PR 14 — one fused graph, one dispatch):
 
-- The production scoring paths already funnel every compiled-tier batch
-  through the ledger seam (``serve/ledger.note_decisions``); a bound
-  :class:`ShadowScorer` (``engine.shadow``) taps the same seam with an
-  O(1) bounded enqueue of columnar references — full queue drops the
-  batch (counted), it never blocks.
-- A single shadow worker thread scores queued batches through its OWN
-  jitted copy of the serving graph (same ``make_score_fn`` composition,
-  same padded shape ladder) with the CANDIDATE params — so shadow steps
-  interleave with production steps on the same device budget, the
-  train+serve coexistence this PR exists to stress.
-- Per-batch comparison against the production outputs (carried by
-  reference alongside the inputs) accumulates score divergence,
-  action-flip counts (by direction), and rolling window stats the
-  promotion controller (train/promote.py) reads; ``report()`` is the
-  ``/debug/shadowz`` payload.
+- **Fused mode** (the steady state): the engine's fused program scores
+  the candidate IN the production dispatch (``serve/scorer``'s
+  ``_note_shadow`` seam hands the in-graph candidate outputs here via
+  :meth:`submit_scored`) — zero extra launches, zero extra H2D; the
+  worker is a pure host-side consumer that reads back both packed
+  handles and diffs them.
+- **Fallback mode** (FUSED=0 / SHADOW_FUSED=0 / the warmup window right
+  after ``set_candidate``): the launch seam hands the DONATED-BATCH
+  ECHO (:meth:`submit_echo`) — device-resident by construction — and
+  the worker launches its own jit of the same composition directly on
+  it, so the candidate re-score never re-ships rows host->device (the
+  pre-PR 14 duplicate H2D is gone on every path).
+- Either way, ``submit_*`` is an O(1) bounded enqueue — full queue
+  drops the batch (counted), it never blocks or raises.
+- Per-batch comparison against the production outputs accumulates score
+  divergence, action-flip counts (by direction), and rolling window
+  stats the promotion controller (train/promote.py) reads; ``report()``
+  is the ``/debug/shadowz`` payload.
+- ``set_candidate`` notifies the engine (``_on_shadow_candidate``) so
+  the shadow-branch fused variants AOT-warm on a background thread —
+  installing a candidate never stalls serving.
 
-Bit-exactness contract (pinned by tests/test_online_promotion.py): the
-shadow's outputs for a batch equal offline scoring of the same rows with
-the same candidate params — same graph, same padding, same dtype — so a
-promotion decision based on shadow evidence is a decision about exactly
-the program that will serve.
+Bit-exactness contract (pinned by tests/test_online_promotion.py and
+tests/test_fused_graph.py): the shadow's outputs for a batch equal
+offline scoring of the rows the production program actually scored with
+the same candidate params — same graph, same padding, same dtype (on
+the int8 wire that means the in-graph dequantized rows, identical to
+what production scored) — so a promotion decision based on shadow
+evidence is a decision about exactly the program that will serve.
 """
 
 from __future__ import annotations
@@ -91,6 +99,11 @@ class ShadowScorer:
         # production step spans a mesh; candidate params are host trees.)
         self._fn = jax.jit(_pack_outputs(
             make_score_fn(engine.config, self.backend)))
+        # int8-wire fallback twin: the echo arrives in the QUANTIZED
+        # domain, so this variant dequantizes in-graph first — the same
+        # wrapping the production program uses. Built lazily on the
+        # worker (engines not on the int8 wire never compile it).
+        self._fn_int8 = None
         self._candidate = candidate_params
         self.candidate_fp = ledger_mod.params_fingerprint(candidate_params)
         self.queue_max_rows = queue_max_rows or int(
@@ -117,55 +130,134 @@ class ShadowScorer:
         self._started_at = time.monotonic()
         self._last_scored_at: float | None = None
 
+        self.fused_batches = 0
+
         self._thread = threading.Thread(
             target=self._worker, name="shadow-scorer", daemon=True)
         self._thread.start()
+        if candidate_params is not None:
+            self._notify_engine()
 
-    # -- hot-path entry ------------------------------------------------------
+    # -- hot-path entries ----------------------------------------------------
+
+    def _try_enqueue(self, item: tuple, n: int) -> bool:
+        """Bounded O(1) enqueue shared by every submit flavor: full
+        queue / stopped / no candidate drops (counted), never blocks."""
+        with self._cv:
+            if (self._stopping or self._candidate is None
+                    or self._pending_rows + n > self.queue_max_rows):
+                self.rows_dropped += n
+                dropped = True
+            else:
+                self._pending.append(item)
+                self._pending_rows += n
+                dropped = False
+                self._cv.notify()
+        if dropped and self._metrics is not None:
+            self._metrics.shadow_rows_total.inc(n, outcome="dropped")
+        return not dropped
 
     def submit(self, out: dict, *, x: np.ndarray | None,
                bl: np.ndarray | None, n: int) -> bool:
-        """Enqueue one production-scored batch for shadow scoring. O(1);
-        never raises; returns False when dropped (no snapshot, stopped,
-        queue full, or no candidate yet)."""
+        """Legacy host-rows entry (kept for harnesses/tests): enqueue one
+        production-scored batch with its HOST feature rows — the worker
+        pads and re-ships them. Production paths use submit_scored /
+        submit_echo instead (PR 14). O(1); never raises; returns False
+        when dropped."""
         try:
             if x is None:
                 with self._cv:
                     self.rows_skipped_no_snapshot += n
                 return False
-            with self._cv:
-                if (self._stopping or self._candidate is None
-                        or self._pending_rows + n > self.queue_max_rows):
-                    self.rows_dropped += n
-                    dropped = True
-                else:
-                    thresholds = np.asarray(self._engine._thresholds,
-                                            dtype=np.int32)
-                    self._pending.append(
-                        (self._generation, out, x, bl, n, thresholds))
-                    self._pending_rows += n
-                    dropped = False
-                    self._cv.notify()
-            if dropped and self._metrics is not None:
-                self._metrics.shadow_rows_total.inc(n, outcome="dropped")
-            return not dropped
+            thresholds = np.asarray(self._engine._thresholds, dtype=np.int32)
+            return self._try_enqueue(
+                ("xhost", self._generation, out, x, bl, n, thresholds), n)
         except Exception:  # noqa: CC04 — the shadow must never fail scoring; drops are visible in its own report
             logger.warning("shadow submit failed", exc_info=True)
             return False
 
+    def submit_scored(self, prod_out, cand_out, n: int,
+                      gen: int | None) -> bool:
+        """Fused-mode entry (scorer._note_shadow): the candidate outputs
+        were computed INSIDE the production dispatch; both packed device
+        handles ride the queue and the worker just reads them back and
+        diffs. O(1); never raises."""
+        try:
+            return self._try_enqueue(
+                ("scored", self._generation if gen is None else gen,
+                 prod_out, cand_out, n), n)
+        except Exception:  # noqa: CC04 — the shadow must never fail scoring; drops are visible in its own report
+            logger.warning("shadow submit_scored failed", exc_info=True)
+            return False
+
+    def submit_echo(self, prod_out, echo, blp, n: int,
+                    thresholds: np.ndarray, hold=None) -> bool:
+        """Split-fallback entry (warmup window / SHADOW_FUSED=0): the
+        DONATED-BATCH ECHO — already device-resident, already padded —
+        feeds the worker's own jit directly, killing the pre-PR 14
+        duplicate host->device ship of x. Returns True IFF the worker
+        took ownership of ``hold`` (the arena staging-buffer refcount);
+        on False the caller must release its party. O(1); never
+        raises."""
+        try:
+            taken = self._try_enqueue(
+                ("echo", self._generation, prod_out, echo, blp, n,
+                 thresholds, hold), n)
+            return taken
+        except Exception:  # noqa: CC04 — the shadow must never fail scoring; drops are visible in its own report
+            logger.warning("shadow submit_echo failed", exc_info=True)
+            return False
+
+    def note_skipped(self, n: int) -> None:
+        """Rows a scoring path could not shadow-score (heuristic tier —
+        a different scorer entirely; index-mode rows while the fused
+        cached variant is still warming) — counted, never silent."""
+        with self._cv:
+            self.rows_skipped_no_snapshot += n
+
     # -- candidate management ------------------------------------------------
+
+    def active_state(self) -> tuple[int, Any] | None:
+        """(generation, candidate_params) when a candidate is installed
+        and the scorer is live — the engine's launch seam reads this to
+        pass the candidate tree into the fused program."""
+        with self._cv:
+            if self._stopping or self._candidate is None:
+                return None
+            return self._generation, self._candidate
 
     def set_candidate(self, params: Any) -> str:
         """Install a new candidate param tree; resets the evidence window
-        (old-candidate batches still queued are dropped as stale).
-        Returns the new candidate fingerprint."""
+        (old-candidate batches still queued are dropped as stale) and
+        kicks the engine's off-path fused-variant warm — the recompile
+        key is the shape ladder, NOT the candidate, so only the FIRST
+        candidate ever compiles (JX06 pins this). Returns the new
+        candidate fingerprint."""
         fp = ledger_mod.params_fingerprint(params)
         with self._cv:
             self._candidate = params
             self.candidate_fp = fp
             self._generation += 1
             self.window = _new_stats()
+        if params is not None:
+            self._notify_engine()
         return fp
+
+    def rebind_engine(self, engine) -> None:
+        """Point the shadow at a rebuilt engine (supervisor._rebind) and
+        re-warm its fused shadow variants if a candidate is sitting."""
+        self._engine = engine
+        if self.candidate_params is not None:
+            self._notify_engine()
+
+    def _notify_engine(self) -> None:
+        hook = getattr(self._engine, "_on_shadow_candidate", None)
+        if hook is None:
+            return
+        try:
+            hook(self)
+        except Exception:  # noqa: CC04 — fused warm is an optimization; the split path keeps serving candidates
+            logger.warning("fused shadow warm kick failed", exc_info=True)
 
     @property
     def candidate_params(self) -> Any:
@@ -193,35 +285,105 @@ class ShadowScorer:
                     self._cv.wait(timeout=0.1)
                 if self._stopping and not self._pending:
                     return
-                gen, out, x, bl, n, thresholds = self._pending.popleft()
+                item = self._pending.popleft()
+                n = item[4] if item[0] == "scored" else item[5]
                 self._pending_rows -= n
                 params = self._candidate
                 current_gen = self._generation
                 self._working = True
+            hold = item[7] if item[0] == "echo" else None
             try:
+                kind, gen = item[0], item[1]
                 if gen == current_gen and params is not None:
-                    cand = self._score(params, x, bl, n, thresholds,
-                                       pad_batch)
-                    self._diff(out, cand, n)
+                    if kind == "scored":
+                        cand, prod = self._readback_pair(item[2], item[3], n)
+                        with self._cv:
+                            self.fused_batches += 1
+                    elif kind == "echo":
+                        _k, _g, prod_out, echo, blp, _n, thresholds, _h = item
+                        cand = self._score_echo(params, echo, blp, n,
+                                                thresholds)
+                        prod = self._readback_prod(prod_out, n)
+                    else:
+                        _k, _g, prod, x, bl, _n, thresholds = item
+                        cand = self._score(params, x, bl, n, thresholds,
+                                           pad_batch)
+                    self._diff(prod, cand, n)
                     hook = self.on_result
                     if hook is not None:
-                        hook(cand, out, n)
+                        hook(cand, prod, n)
             except Exception:  # noqa: CC04 — shadow failures are counted below, never surface to serving
                 with self._cv:
                     self.errors += 1
                 logger.warning("shadow scoring failed (batch of %d rows "
                                "skipped)", n, exc_info=True)
             finally:
+                if hold is not None:
+                    # The echo (and the arena staging memory it may alias
+                    # zero-copy) is consumed: release the shadow's party.
+                    hold.release()
                 with self._cv:
                     self._working = False
 
-    def _score(self, params, x, bl, n, thresholds, pad_batch) -> dict:
-        """One candidate device step over the production rows, padded to
-        the engine's compiled shape ladder (same padding discipline as
-        serving — bit-exact vs offline scoring of the same rows)."""
+    @staticmethod
+    def _readback_prod(prod_out, n: int) -> dict:
         import jax
 
         from igaming_platform_tpu.serve.scorer import _unpack_host
+
+        host = _unpack_host(jax.device_get(prod_out))
+        return {k: v[:n] for k, v in host.items()}
+
+    def _readback_pair(self, prod_out, cand_out, n: int) -> tuple[dict, dict]:
+        """Fused mode: both packed handles were computed by the ONE
+        production dispatch — this is a pure readback, no launch."""
+        return (self._readback_prod(cand_out, n),
+                self._readback_prod(prod_out, n))
+
+    def _score_echo(self, params, echo, blp, n, thresholds) -> dict:
+        """Fallback mode: one candidate step launched directly on the
+        donated-batch echo (device-resident, already padded) — no
+        host->device re-ship of the rows. int8 echoes dequantize
+        in-graph, matching what production scored."""
+        import jax
+
+        from igaming_platform_tpu.serve.scorer import (
+            _device_dispatch,
+            _unpack_host,
+        )
+
+        fn = self._fn
+        if getattr(echo, "dtype", None) == np.int8:
+            fn = self._ensure_fn_int8()
+        _device_dispatch("shadow_step", echo.shape, echo.dtype)
+        packed = jax.device_get(fn(params, echo, blp, thresholds))
+        host = _unpack_host(packed)
+        return {k: v[:n] for k, v in host.items()}
+
+    def _ensure_fn_int8(self):
+        if self._fn_int8 is None:
+            import jax
+
+            from igaming_platform_tpu.models.ensemble import make_score_fn
+            from igaming_platform_tpu.ops.quantize import wire_dequantize_int8
+            from igaming_platform_tpu.serve.scorer import _pack_outputs
+
+            core = make_score_fn(self._engine.config, self.backend)
+            self._fn_int8 = jax.jit(_pack_outputs(
+                lambda p, xq, bl, thr: core(
+                    p, wire_dequantize_int8(xq), bl, thr)))
+        return self._fn_int8
+
+    def _score(self, params, x, bl, n, thresholds, pad_batch) -> dict:
+        """Legacy host-rows step: pad to the engine's compiled shape
+        ladder and re-ship (same padding discipline as serving —
+        bit-exact vs offline scoring of the same rows)."""
+        import jax
+
+        from igaming_platform_tpu.serve.scorer import (
+            _device_dispatch,
+            _unpack_host,
+        )
 
         x32 = np.ascontiguousarray(x[:n], dtype=np.float32)
         blv = (np.ascontiguousarray(bl[:n], dtype=bool) if bl is not None
@@ -229,6 +391,7 @@ class ShadowScorer:
         shape = self._engine._pick_shape(n)
         xp, _ = pad_batch(x32, shape)
         blp, _ = pad_batch(blv, shape)
+        _device_dispatch("shadow_step", xp.shape, xp.dtype)
         packed = jax.device_get(self._fn(params, xp, blp, thresholds))
         host = _unpack_host(packed)
         return {k: v[:n] for k, v in host.items()}
@@ -302,6 +465,10 @@ class ShadowScorer:
                 "queue_max_rows": self.queue_max_rows,
                 "rows_dropped": self.rows_dropped,
                 "rows_skipped_no_snapshot": self.rows_skipped_no_snapshot,
+                # Batches whose candidate outputs came out of the FUSED
+                # production dispatch (zero extra launches) vs the
+                # fallback paths — the fused-coverage meter.
+                "fused_batches": self.fused_batches,
                 "errors": self.errors,
                 "uptime_s": round(time.monotonic() - self._started_at, 3),
                 "last_scored_age_s": (

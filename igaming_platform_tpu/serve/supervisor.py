@@ -930,7 +930,10 @@ class SupervisedScoringEngine:
         # the decisions of a freshly-healed engine.
         new.ledger = getattr(old, "ledger", None)
         # So does the shadow scorer — the online loop keeps accumulating
-        # candidate evidence against the rebuilt engine's stream.
+        # candidate evidence against the rebuilt engine's stream. It is
+        # re-pointed at the rebuilt engine (shape ladder, thresholds)
+        # and, if a candidate is sitting, the rebuilt engine re-warms
+        # its fused shadow variants off-path.
         new.shadow = getattr(old, "shadow", None)
         # And the drift observatory: its rolling windows + pinned
         # reference outlive the engine; the rebuilt engine re-jits its
@@ -938,6 +941,10 @@ class SupervisedScoringEngine:
         drift = getattr(old, "drift", None)
         if drift is not None and hasattr(new, "bind_drift"):
             new.bind_drift(drift)
+        # Shadow re-point AFTER the drift rebind so the fused shadow
+        # variants warm with the sketch branch compiled in.
+        if new.shadow is not None and hasattr(new.shadow, "rebind_engine"):
+            new.shadow.rebind_engine(new)
         old_b = getattr(old, "_batcher", None)
         new_b = getattr(new, "_batcher", None)
         if old_b is not None and new_b is not None:

@@ -69,3 +69,40 @@ class SyncEngine:
         # Not a hot loop: debugging/benchmark code may coerce freely.
         out = self._fn(x)
         return bool(out)
+
+
+class BadCandidateScorer:
+    """JX06(d): constructing the jit per candidate — every set_candidate
+    recompiles the whole shape ladder — and keying the memo on the
+    candidate fingerprint, which is the same storm wearing a cache."""
+
+    def __init__(self):
+        self._fns = {}
+
+    def set_candidate(self, params, fp):
+        step = jax.jit(lambda p, x: x)  # expect: JX06
+        self._fns[fp] = step  # expect: JX06
+        return step
+
+
+class GoodCandidateScorer:
+    """The memoized-builder idiom: the recompile key is the VARIANT
+    tuple (static per ladder shape), the candidate tree enters as a
+    traced argument, and construction sits behind a cache-membership
+    guard — the compliant control for JX06(d)."""
+
+    def __init__(self):
+        self._variants = {}
+
+    def _build_variant(self):
+        return jax.jit(lambda params, cand, x: x)
+
+    def _ensure_variant(self, key):
+        fn = self._variants.get(key)
+        if fn is None:
+            fn = self._build_variant()
+            self._variants[key] = fn
+        return fn
+
+    def set_candidate(self, params):
+        return self._ensure_variant(("packed", True))

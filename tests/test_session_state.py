@@ -462,11 +462,16 @@ def test_fused_step_adds_no_dispatches_per_chunk(monkeypatch):
     for key, session in (("off", False), ("on", True)):
         eng = make_engine(batch_size=4, capacity=16, session=session,
                           tiers=())
+        # Warm run FIRST: admissions fire the between-steps scatter (and,
+        # with session on, the ring sync) — real launches the honest
+        # dispatch seam now counts. The fused-step claim is about the
+        # STEADY state: resident accounts, no admissions.
+        eng.score_columns_cached(accts, [90] * 10, ["bet"] * 10, now=NOW0)
         calls = []
         orig = scorer_mod._device_dispatch
         monkeypatch.setattr(scorer_mod, "_device_dispatch",
                             lambda fn, shape, dtype: calls.append(fn))
-        for r in range(3):
+        for r in range(1, 3):
             eng.score_columns_cached(accts, [100 + r] * 10, ["bet"] * 10,
                                      now=NOW0 + 30.0 * r)
         monkeypatch.setattr(scorer_mod, "_device_dispatch", orig)

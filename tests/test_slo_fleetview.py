@@ -418,7 +418,12 @@ def test_anomaly_profile_trigger_respects_cooldown():
     assert telemetry.profile_captures[0]["ok"] is True
 
 
-def test_dispatch_spans_bump_root_and_counter():
+def test_dispatch_launches_bump_root_and_counter():
+    # PR 14: the dispatch counter is LAUNCH-driven (note_dispatch at the
+    # scorer's _device_dispatch seam), not span-driven — a span that
+    # wraps two launches counts 2, a launch outside any dispatch span
+    # still counts 1, and the RPC root's `dispatches` attribute tracks
+    # the same truth.
     from igaming_platform_tpu.obs import runtime_telemetry as rt_mod
     from igaming_platform_tpu.obs import tracing
 
@@ -431,10 +436,17 @@ def test_dispatch_spans_bump_root_and_counter():
     tracing.add_span_sink(telemetry.observe_span)
     try:
         with tracing.span("rpc.ScoreBatch") as root:
-            for _ in range(3):
-                with tracing.span("score.dispatch"):
-                    pass
+            with tracing.span("score.dispatch"):
+                telemetry.note_dispatch()  # the fused step
+                telemetry.note_dispatch()  # a split sketch kernel
+            telemetry.note_dispatch()      # a between-steps scatter
         assert root.attributes.get("dispatches") == 3
+        assert telemetry.dispatches_total == 3
+        # Spans alone no longer count as dispatches.
+        with tracing.span("rpc.ScoreBatch") as root2:
+            with tracing.span("score.dispatch"):
+                pass
+        assert root2.attributes.get("dispatches") is None
         assert telemetry.dispatches_total == 3
     finally:
         tracing.remove_span_sink(telemetry.observe_span)

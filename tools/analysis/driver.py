@@ -69,6 +69,11 @@ REPO_CONFIG = {
             "ledger": ("note_decisions",),
             "drift": ("_note_drift", "_note_drift_cached"),
             "session": ("_note_session_bypass", "prepare_chunk"),
+            # PR 14: the fused program's launch core must still hand its
+            # in-graph shadow/sketch outputs through the declared seams —
+            # _note_shadow is the single shadow hand-off chokepoint
+            # (fused outputs AND the echo-fed fallback both flow here).
+            "shadow": ("_note_shadow",),
         },
         "paths": {
             "row": (

@@ -50,6 +50,20 @@ _SYNC_CASTS = {"bool", "int", "float", "len"}
 _NP_ALIASES = {"np", "numpy", "onp"}
 _NP_COERCERS = {"asarray", "array", "copy"}
 
+# (d) per-candidate recompile discipline (PR 14): functions reachable
+# from a candidate-installation root (shadow set_candidate and the
+# engine's fused-variant warm path) construct jit wrappers once per
+# PROGRAM VARIANT, never once per candidate — the recompile key must be
+# the shape-ladder/variant tuple, with the candidate tree entering as a
+# traced argument. Roots are matched by NAME so thread hand-offs
+# (Thread(target=...)) don't break the reachability walk.
+_PER_CANDIDATE_ROOTS = {"set_candidate", "_on_shadow_candidate",
+                        "_warm_shadow_fused"}
+import re as _re
+
+_CANDIDATE_KEY_RE = _re.compile(r"(^|_)(fp|fingerprint|cand|candidate)s?($|_)",
+                                _re.IGNORECASE)
+
 
 def _scoped_files(project: ProjectContext) -> list[FileContext]:
     config = project.caches.get("config", {})
@@ -264,6 +278,132 @@ def retrace_host_sync_hazard(project: ProjectContext):
             # (c) implicit syncs on device values in hot-loop code.
             if hot and id(fn_node) not in traced_nodes:
                 yield from _implicit_syncs(ctx, qual, fn_node, reg)
+    # (d) per-candidate recompile discipline: a shadow-branch program
+    # must key its recompiles on the shape ladder, not the candidate.
+    yield from _per_candidate_retrace(project)
+
+
+def _has_memo_guard(fn_node: ast.AST, before_line: int | None = None) -> bool:
+    """A cache-membership guard the memoized-builder idiom uses:
+    ``if key in self._cache`` / ``x = cache.get(key)`` (optionally
+    required to appear before ``before_line``)."""
+    for sub in ast.walk(fn_node):
+        line = getattr(sub, "lineno", None)
+        if before_line is not None and (line is None or line >= before_line):
+            continue
+        if isinstance(sub, ast.Compare) and any(
+                isinstance(op, (ast.In, ast.NotIn)) for op in sub.ops):
+            return True
+        if (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in ("get", "setdefault")):
+            return True
+    return False
+
+
+def _candidate_key_names(expr: ast.AST):
+    for sub in ast.walk(expr):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        if name is not None and _CANDIDATE_KEY_RE.search(name):
+            yield name
+
+
+def _per_candidate_retrace(project: ProjectContext):
+    """JX06(d): on any path reachable from a candidate-installation root
+    (set_candidate / the fused warm hooks), a jax.jit/pjit/shard_map
+    construction must sit behind a memo guard — in the constructing
+    function or a calling builder on the same path — and no memo key on
+    the path may involve a candidate-varying value (fingerprint,
+    candidate id): each new candidate would then be a full retrace +
+    compile storm across the shape ladder."""
+    from tools.analysis.dataflow import call_graph
+
+    graph = call_graph(project)
+    scoped = {f.relpath for f in _scoped_files(project)}
+    roots = [k for k in graph.funcs
+             if k[1].split(".")[-1] in _PER_CANDIDATE_ROOTS]
+    if not roots:
+        return
+    reachable = graph.reachable_from(roots)
+    ctx_by_path = {f.relpath: f for f in project.files}
+    # Builder functions: reachable, in scope, containing a jit ctor in
+    # their OWN statements (nested defs are separate records).
+    builders: dict[tuple[str, str], list[int]] = {}
+    for key in reachable:
+        rec = graph.funcs[key]
+        if rec.key[0] not in scoped:
+            continue
+        lines = []
+        for sub in ast.walk(rec.node):
+            if (isinstance(sub, ast.Call)
+                    and (name := dotted_name(sub.func)) is not None
+                    and name.split(".")[-1] in _JIT_CTORS):
+                lines.append(sub.lineno)
+        if lines:
+            builders[key] = lines
+    if not builders:
+        return
+    # Guard resolution: a builder is memoized when itself (before the
+    # ctor line) or any reachable caller that calls it carries the
+    # cache-membership idiom.
+    callers: dict[tuple[str, str], set[tuple[str, str]]] = {}
+    for key in reachable:
+        rec = graph.funcs[key]
+        for kind, name, module, _line in rec.calls:
+            for callee in graph.resolve(rec, kind, name, module):
+                if callee in builders:
+                    callers.setdefault(callee, set()).add(key)
+    emitted: set[tuple[str, int]] = set()
+    for key, lines in sorted(builders.items()):
+        rec = graph.funcs[key]
+        ctx = ctx_by_path[rec.key[0]]
+        guarded = any(_has_memo_guard(rec.node, before_line=line)
+                      for line in lines)
+        if not guarded:
+            guarded = any(_has_memo_guard(graph.funcs[c].node)
+                          for c in callers.get(key, ()))
+        if not guarded:
+            for line in lines:
+                if (rec.key[0], line) not in emitted:
+                    emitted.add((rec.key[0], line))
+                    yield ctx, line, (
+                        f"jit wrapper constructed in `{rec.key[1]}` on a "
+                        "per-candidate path (reachable from "
+                        "set_candidate/the fused shadow warm) without a "
+                        "memo guard — every candidate would recompile "
+                        "the whole shape ladder; cache the built program "
+                        "keyed by variant, with the candidate tree as a "
+                        "traced argument")
+        # Key purity: memo stores on the path must not key on the
+        # candidate (fingerprints etc.) — a guarded-but-per-candidate
+        # cache is still a retrace per candidate.
+        for fkey in {key, *callers.get(key, ())}:
+            frec = graph.funcs[fkey]
+            fctx = ctx_by_path.get(frec.key[0])
+            if fctx is None or frec.key[0] not in scoped:
+                continue
+            for sub in ast.walk(frec.node):
+                if not (isinstance(sub, (ast.Assign, ast.AugAssign))
+                        and isinstance(
+                            getattr(sub, "targets", [None])[0]
+                            if isinstance(sub, ast.Assign) else sub.target,
+                            ast.Subscript)):
+                    continue
+                target = (sub.targets[0] if isinstance(sub, ast.Assign)
+                          else sub.target)
+                for bad in _candidate_key_names(target.slice):
+                    if (frec.key[0], sub.lineno) in emitted:
+                        continue
+                    emitted.add((frec.key[0], sub.lineno))
+                    yield fctx, sub.lineno, (
+                        f"memo key `{bad}` in `{frec.key[1]}` varies per "
+                        "candidate — the shadow-branch recompile key "
+                        "must be static per ladder shape (variant "
+                        "tuple), never a candidate fingerprint; pass "
+                        "the candidate tree as a traced argument")
 
 
 def _implicit_syncs(ctx: FileContext, qual: str, fn_node: ast.AST, reg):
