@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-fast lint lint-json lint-changed lint-sarif lint-update-baseline bench bench-all bench-fused bench-paced bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos soak-chaos-ledger soak-slo soak-online soak-drift soak-session soak-deadline replay-verify fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
+.PHONY: all test test-fast lint lint-json lint-changed lint-sarif lint-update-baseline bench bench-all bench-fused bench-mesh bench-paced bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos soak-chaos-ledger soak-slo soak-online soak-drift soak-session soak-deadline replay-verify fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
 
 all: native test
 
@@ -51,6 +51,16 @@ bench-all:
 # must measure 1.0 dispatches/RPC, latency no worse within noise).
 bench-fused:
 	$(PY) bench.py --fused
+
+# Slot-sharded state A/B (ISSUE 15): sharded vs replicated feature
+# cache + session ring over a forced K-device CPU mesh — bit-exact
+# parity, per-chip capacity/HBM (the 1/K claim, measured), honest
+# dispatches/RPC and paced p99 per arm -> MESH_r15.json. Gated on
+# parity/capacity/dispatches; NEVER on host-side scaling (single-core
+# control-rig caveat recorded in the artifact).
+BENCH_MESH_K ?= 4
+bench-mesh:
+	env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=$(BENCH_MESH_K)" BENCH_MESH_K=$(BENCH_MESH_K) $(PY) bench.py --mesh
 
 # Paced-arrival latency gate (deadline scheduler, PR 11): open-loop
 # Poisson ScoreTransaction load at BENCH_PACED_RATE (default 2000 rps on

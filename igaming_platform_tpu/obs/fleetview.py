@@ -180,6 +180,7 @@ class _ReplicaState:
         self.supervisorz: dict | None = None
         self.sloz: dict | None = None
         self.driftz: dict | None = None
+        self.cachez: dict | None = None
         self.flight: list[dict] = []
         self.last_good_monotonic: float | None = None
         self.consecutive_failures = 0
@@ -248,12 +249,13 @@ class FleetView:
             histograms = parse_histograms(metrics_text)
             # Debug surfaces are best-effort per-endpoint: a replica
             # without a supervisor (404) still contributes histograms.
-            supervisorz = sloz = driftz = None
+            supervisorz = sloz = driftz = cachez = None
             flight: list[dict] = []
             for path, setter in (
                 ("/debug/supervisorz", "supervisorz"),
                 ("/debug/sloz", "sloz"),
                 ("/debug/driftz", "driftz"),
+                ("/debug/cachez", "cachez"),
                 ("/debug/flightz", "flight"),
             ):
                 try:
@@ -266,6 +268,8 @@ class FleetView:
                     sloz = payload
                 elif setter == "driftz":
                     driftz = payload if isinstance(payload, dict) else None
+                elif setter == "cachez":
+                    cachez = payload if isinstance(payload, dict) else None
                 else:
                     flight = payload if isinstance(payload, list) else []
         except Exception as exc:  # noqa: BLE001 — a dead/hung replica must not kill the ticker
@@ -281,6 +285,7 @@ class FleetView:
             state.supervisorz = supervisorz
             state.sloz = sloz
             state.driftz = driftz
+            state.cachez = cachez
             state.flight = flight
             state.last_good_monotonic = time.monotonic()
             state.consecutive_failures = 0
@@ -389,6 +394,15 @@ class FleetView:
                             "budget_attribution", {}).get("top_stage"),
                         "violations_total": slo.get("violations_total"),
                     } if slo else None,
+                    # Slot-sharded state breakdown (/debug/cachez): the
+                    # per-shard occupancy/HBM view the capacity plane
+                    # reads fleet-wide.
+                    "state_shards": ({
+                        "capacity": st.cachez.get("capacity"),
+                        "occupancy": st.cachez.get("occupancy"),
+                        "shards": st.cachez.get("shards"),
+                        "session": st.cachez.get("session_shards"),
+                    } if st.cachez else None),
                 })
                 per_replica_hists.append((st.rid, st.histograms))
                 flights.append((st.rid, st.flight))
@@ -429,10 +443,24 @@ class FleetView:
                 f"drift/{err}" for err in fleet_drift.get("merge_errors", ()))
         except Exception as exc:  # noqa: BLE001 — the drift rollup must not take down the fleet page
             fleet_drift = {"error": repr(exc)[:200]}
+        # Fleet capacity rollup: aggregate admissible slots + state HBM
+        # over the replicas that reported /debug/cachez — the number a
+        # pod-as-unit scheduler sizes admission against.
+        reporting = [s["state_shards"] for s in states
+                     if s.get("state_shards")]
+        fleet_capacity = {
+            "replicas_reporting": len(reporting),
+            "capacity_slots": sum(r.get("capacity") or 0 for r in reporting),
+            "hbm_bytes": sum(
+                sum((r.get("shards") or {}).get("hbm_bytes", []) or [])
+                + sum((r.get("session") or {}).get("hbm_bytes", []) or [])
+                for r in reporting),
+        }
         return {
             "generated_unix_s": round(time.time(), 3),
             "stale_after_s": self.stale_after_s,
             "replicas": states,
+            "fleet_capacity": fleet_capacity,
             "fleet_stage_latency_ms": stage_block,
             "fleet_drift": fleet_drift,
             "histogram_merge_errors": merge_errors,

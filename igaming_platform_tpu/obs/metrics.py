@@ -284,6 +284,19 @@ class ServiceMetrics:
             f"{service}_feature_cache_occupancy",
             "Device feature-table slots currently resident",
         )
+        # Slot-sharded state (parallel/state_sharding.py): per-shard
+        # breakdowns, labels bounded by the mesh data-axis size (<= 8 on
+        # a v5e-8 — MX05-clean).
+        self.cache_shard_occupancy = self.registry.gauge(
+            f"{service}_cache_shard_occupancy",
+            "Resident feature-table slots per mesh shard ({shard} = "
+            "data-axis index; one series when the table is replicated) "
+            "— a skewed spread means the CLOCK hand is fighting a hot "
+            "key range, see docs/operations.md 'Pod-as-unit fleet'",
+        )
+        # Per-shard state bytes ride the existing {service}_hbm_bytes
+        # gauge (registered with the runtime-telemetry block below) as
+        # {shard, table} series beside its backend {kind} series.
         # Business-level series backing the Grafana dashboards the reference
         # README promises (README.md:196-202) but ships no data for: per-type
         # transaction flow (bonus conversion = bonus_grant rate vs deposit
@@ -594,9 +607,12 @@ class ServiceMetrics:
         )
         self.hbm_bytes = self.registry.gauge(
             f"{service}_hbm_bytes",
-            "Device memory by {kind} (in_use/limit/peak) from the "
+            "Device memory: {kind} series (in_use/limit/peak) from the "
             "backend's memory_stats — absent on backends that do not "
-            "report (CPU)",
+            "report (CPU) — plus {shard, table} series for the "
+            "slot-sharded state tables (feature_cache / session_ring "
+            "bytes per mesh shard, the per-chip capacity accounting of "
+            "docs/performance.md 'Sharded state')",
         )
         # Online learning loop (train/online.py, serve/shadow.py,
         # train/promote.py): shadow-scoring evidence, mined training

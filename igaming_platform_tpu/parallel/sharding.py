@@ -58,6 +58,54 @@ def seq_activation_spec(ndim: int = 3) -> P:
     return P(AXIS_DATA, AXIS_SEQ, *([None] * (ndim - 2)))
 
 
+def model_param_specs(ml_backend: str, params: Any) -> Any | None:
+    """Spec tree for a serving checkpoint of ``ml_backend``: the wide
+    ensemble pieces shard over the mesh's MODEL axes — the GBDT forest's
+    tree bank over ``expert`` (margins partial-summed in-graph by the
+    SPMD partitioner), MLP/multitask trunks alternating over ``model``
+    — so aggregate HBM holds one model copy per MESH, not per chip.
+
+    Returns None for backends with nothing to shard (mock has no
+    params; the int8 trees are wire-compression artifacts small enough
+    that splitting them buys noise; routed params ride parallel/ep.py's
+    own shard_map layout and must stay replicated at the jit boundary).
+
+    Numerics note: a sharded reduce (GBDT margin psum, TP matmul
+    all-reduce) may re-associate float adds vs the single-device graph —
+    parity for sharded MODELS is close-not-bitwise, which is why the
+    slot-sharded STATE parity suite (bit-exact) runs the paramless mock
+    backend and the model-sharding tests assert allclose.
+    """
+    if params is None:
+        return None
+    specs: dict[str, Any] = {}
+    if ml_backend in ("mlp", "mlp+gbdt") and "mlp" in params:
+        specs["mlp"] = mlp_param_specs(params["mlp"])
+    if ml_backend in ("gbdt", "mlp+gbdt") and "gbdt" in params:
+        specs["gbdt"] = gbdt_param_specs()
+    if ml_backend == "multitask" and "multitask" in params:
+        from igaming_platform_tpu.models import multitask as mt
+
+        specs["multitask"] = mt.param_specs(params["multitask"])
+    if not specs:
+        return None
+    # Leaves not named above stay replicated.
+    out = {k: (specs[k] if k in specs else jax.tree.map(lambda _: P(), v))
+           for k, v in params.items()}
+    return out
+
+
+def shard_model_params(mesh: Mesh, ml_backend: str, params: Any) -> Any:
+    """Place a serving checkpoint onto the mesh per
+    :func:`model_param_specs`; identity when nothing shards (values are
+    NEVER changed — only layout, so the ledger params fingerprint is
+    unaffected)."""
+    spec_tree = model_param_specs(ml_backend, params)
+    if spec_tree is None:
+        return params
+    return shard_params(mesh, params, spec_tree)
+
+
 def tree_shardings(mesh: Mesh, spec_tree: Any) -> Any:
     """Map a pytree of PartitionSpecs to NamedShardings on ``mesh``."""
     return jax.tree.map(

@@ -33,9 +33,18 @@ Semantics:
   slot, a rotating hand, O(1) amortized per admission;
 - `flags` is a per-slot sticky bool column (e.g. account-level block
   listing) OR'd into the per-request blacklist vector on device;
-- on a multi-device mesh the TABLE is replicated (P()) and the BATCH is
-  sharded along ``data`` — each device gathers its own batch shard
-  locally, so the hot path stays collective-free.
+- on a multi-device mesh the TABLE is **slot-sharded** over the ``data``
+  axis (parallel/state_sharding.py, STATE_SHARDING=1 default): each
+  chip holds a contiguous ``capacity / K`` row block, the between-steps
+  delta scatter lands each row only on its owning shard
+  (``mode='drop'``) and the scoring-step gather runs an exact
+  owner-select collective inside the same single dispatch — per-chip
+  HBM is ~1/K and admissible slots scale with the mesh, which is the
+  capacity half of the 100k-txns/s north star. Capacity rounds UP to a
+  multiple of K; slot -> shard ownership is ``slot // (capacity // K)``
+  so the host CLOCK index attributes every slot (per-shard occupancy
+  gauges + /debug/cachez ride on that). STATE_SHARDING=0 (or a 1-wide
+  data axis) keeps the old replicated layout.
 
 Hit/miss/evict/occupancy counters export through obs.metrics
 (`bind_metrics`); `stats()` returns the same numbers for tests.
@@ -70,6 +79,14 @@ class DeviceFeatureCache:
         import jax
         import jax.numpy as jnp
 
+        from igaming_platform_tpu.parallel import state_sharding
+
+        # Slot sharding (the capacity half of ROADMAP item 2): on a
+        # mesh with a >1 ``data`` axis the table row-shards by slot;
+        # capacity rounds up so every shard holds an equal block.
+        self.plan = state_sharding.plan_for(mesh)
+        if self.plan is not None:
+            capacity = self.plan.round_capacity(int(capacity))
         self.capacity = int(capacity)
         self.features = feature_store
         self.max_age_s = max_age_s
@@ -95,6 +112,12 @@ class DeviceFeatureCache:
         self.evictions = 0
         self.deltas_applied = 0
         self._metrics = metrics
+        # Per-shard occupancy (host-derived: the CLOCK index knows each
+        # slot's owner — slot // rows_per_shard — so no device readback).
+        # One bucket when unsharded, K when slot-sharded.
+        self._n_shards = 1 if self.plan is None else self.plan.n_shards
+        self._shard_rows = self.capacity // self._n_shards
+        self._shard_occ = np.zeros(self._n_shards, dtype=np.int64)
 
         # The resident table: replicated on a mesh (each device gathers
         # its own batch shard locally), plain device arrays otherwise.
@@ -102,7 +125,15 @@ class DeviceFeatureCache:
         flags = jnp.zeros((self.capacity,), dtype=bool)
         scatter = lambda t, i, r: t.at[i].set(r)  # noqa: E731
         flag_set = lambda f, i, v: f.at[i].set(v)  # noqa: E731
-        if mesh is not None:
+        if self.plan is not None:
+            # Slot-sharded layout: each device holds capacity/K rows;
+            # the delta/flag scatters become shard_map programs that
+            # land each row on its owning shard only.
+            table = self.plan.place(table)
+            flags = self.plan.place(flags)
+            self._apply = state_sharding.make_sharded_scatter(self.plan, 2)
+            self._apply_flags = state_sharding.make_sharded_scatter(self.plan, 1)
+        elif mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             repl = NamedSharding(mesh, P())
@@ -119,6 +150,11 @@ class DeviceFeatureCache:
             self._apply_flags = jax.jit(flag_set)
         self.table = table
         self.flags = flags
+        # Per-shard HBM budget is static (fixed shapes): f32 table rows
+        # + bool flag column, per contiguous row block.
+        self._hbm_per_shard = [
+            self._shard_rows * (NUM_FEATURES * 4 + 1)
+        ] * self._n_shards
 
     # -- metrics -------------------------------------------------------------
 
@@ -145,6 +181,10 @@ class DeviceFeatureCache:
         if deltas:
             m.feature_cache_deltas_total.inc(deltas)
         m.feature_cache_occupancy.set(self.capacity - self._free)
+        for s in range(self._n_shards):
+            m.cache_shard_occupancy.set(int(self._shard_occ[s]), shard=str(s))
+            m.hbm_bytes.set(self._hbm_per_shard[s], shard=str(s),
+                            table="feature_cache")
 
     def stats(self) -> dict[str, int]:
         with self._lock:
@@ -155,6 +195,21 @@ class DeviceFeatureCache:
                 "deltas_applied": self.deltas_applied,
                 "occupancy": self.capacity - self._free,
                 "capacity": self.capacity,
+                "shards": self._n_shards,
+            }
+
+    def shard_stats(self) -> dict:
+        """Per-shard breakdown for /debug/cachez and the fleet view:
+        slot ownership is host-derived (contiguous row blocks), HBM
+        bytes are the static per-shard budget — what each chip actually
+        holds, the number the mesh bench arm records."""
+        with self._lock:
+            return {
+                "sharded": self.plan is not None,
+                "shards": self._n_shards,
+                "rows_per_shard": self._shard_rows,
+                "occupancy": [int(v) for v in self._shard_occ],
+                "hbm_bytes": list(self._hbm_per_shard),
             }
 
     # -- write-back hook -----------------------------------------------------
@@ -192,6 +247,9 @@ class DeviceFeatureCache:
                 self._hand = (self._hand + 1) % self.capacity
                 if self._slot_keys[slot] is None:
                     self._free -= 1
+                    # First residency of this slot; evictions reuse the
+                    # same slot, so shard occupancy moves only here.
+                    self._shard_occ[slot // self._shard_rows] += 1
                     return slot
         while True:
             slot = self._hand

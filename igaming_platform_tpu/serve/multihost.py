@@ -655,10 +655,19 @@ def multihost_engine(mesh, follower_ports: list[int], *, batcher_config=None,
             # an unlocked interleave could pair the follower's frame k
             # with the front's step k+1 and rendezvous mismatched shards.
             self._step_lock = threading.Lock()
+            # The front's LOCAL engine compiles against a 1-device mesh
+            # — the mesh=1 SHARDING of the same program, not a separate
+            # replicated executable. That makes loopback mode and the
+            # single-host degraded step structurally the same program
+            # family as a sharded mesh engine, so a supervisor rebuild
+            # can never silently drop sharding from the compiled step.
+            from igaming_platform_tpu.parallel.mesh import single_device_mesh
+
             super().__init__(
                 config=cfg, batcher_config=batcher_config,
                 ml_backend=ml_backend, params=params,
                 feature_store=feature_store, warmup=False,
+                mesh=single_device_mesh(),
             )
             # The HBM feature cache gathers from a LOCAL table inside the
             # jitted step; this engine's step is a lockstep SPMD program

@@ -175,6 +175,51 @@ class HashRing:
             }
 
 
+class PodRing:
+    """Pod-as-unit membership over :class:`HashRing` (the Podracer
+    topology, PAPERS.md): the ring is keyed by POD ids — a mesh-backed
+    replica group advertising aggregate capacity (its slot-sharded
+    feature cache + session ring span the whole mesh,
+    parallel/state_sharding.py) — and replica-level health transitions
+    translate to pod transitions here. A pod leaves the ring only when
+    its LAST in-ring member does: any healthy member is an entry point
+    to the same mesh-resident state, so one dead host must not move the
+    pod's keys. With the default one-replica-per-pod mapping (pod id ==
+    replica id) this degenerates to exactly the PR 6 behavior and the
+    golden ring owners are unchanged."""
+
+    def __init__(self, ring: HashRing, pod_of: dict[str, str],
+                 members: dict[str, tuple[str, ...]]):
+        self._ring = ring
+        self._pod_of = dict(pod_of)
+        self._members = {p: frozenset(ms) for p, ms in members.items()}
+        self._out: set[str] = set()
+        self._lock = threading.Lock()
+
+    def evict(self, rid: str) -> None:
+        pod = self._pod_of.get(rid)
+        if pod is None:
+            self._ring.evict(rid)
+            return
+        with self._lock:
+            self._out.add(rid)
+            if self._members[pod] <= self._out:
+                self._ring.evict(pod)
+
+    def readmit(self, rid: str) -> None:
+        pod = self._pod_of.get(rid)
+        if pod is None:
+            self._ring.readmit(rid)
+            return
+        with self._lock:
+            self._out.discard(rid)
+            self._ring.readmit(pod)
+
+    def out_members(self) -> frozenset[str]:
+        with self._lock:
+            return frozenset(self._out)
+
+
 # ---------------------------------------------------------------------------
 # Replica endpoints + health watching
 
@@ -190,6 +235,12 @@ class ReplicaEndpoint:
         self.state = "serving"
         self.consecutive_failures = 0
         self.last_error: str | None = None
+        # Advertised capacity (advisory, scraped from /debug/cachez on
+        # the deep probe tick): admissible feature-cache slots and the
+        # per-shard HBM budget — summed per pod in the router snapshot.
+        self.capacity_slots: int | None = None
+        self.hbm_bytes: int | None = None
+        self.state_shards: int | None = None
         self._build_stubs()
 
     def _build_stubs(self) -> None:
@@ -247,7 +298,8 @@ class FleetHealthWatcher:
     of waiting out probe ticks.
     """
 
-    def __init__(self, ring: HashRing, replicas: dict[str, ReplicaEndpoint],
+    def __init__(self, ring: HashRing | PodRing,
+                 replicas: dict[str, ReplicaEndpoint],
                  *, interval_s: float = 0.25, failure_threshold: int = 2,
                  probe_timeout_s: float = 0.5, supervisorz_every: int = 4,
                  metrics: ServiceMetrics | None = None,
@@ -364,6 +416,22 @@ class FleetHealthWatcher:
             self._set_state(replica, "degraded", "supervisorz DEGRADED")
         elif state == "serving" and replica.state == "degraded":
             self._set_state(replica, "serving", "supervisorz SERVING")
+        try:
+            # Advertised capacity (advisory, same deep tick): admissible
+            # slots + per-shard HBM from /debug/cachez, summed per pod
+            # in the router snapshot — pod-as-unit scheduling needs the
+            # pod's AGGREGATE capacity, not one chip's.
+            with urllib.request.urlopen(
+                    f"http://{replica.http_addr}/debug/cachez",
+                    timeout=self.probe_timeout_s) as resp:
+                cz = json.loads(resp.read())
+            replica.capacity_slots = cz.get("capacity")
+            shards = cz.get("shards") or {}
+            replica.state_shards = shards.get("shards")
+            hbm = shards.get("hbm_bytes") or []
+            replica.hbm_bytes = int(sum(hbm)) if hbm else None
+        except Exception:  # noqa: CC04 — capacity advertisement is advisory (404 without a cache); the gRPC probe owns failure counting
+            pass
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -489,7 +557,8 @@ class ScoringRouter:
     raw_request_methods = ("ScoreTransaction", "ScoreBatch")
 
     def __init__(self, replicas: dict[str, tuple[str, str | None]] | list[str],
-                 *, metrics: ServiceMetrics | None = None,
+                 *, pods: dict[str, tuple[str, ...] | list[str]] | None = None,
+                 metrics: ServiceMetrics | None = None,
                  vnodes: int = 64, hedge: bool | None = None,
                  max_attempts: int | None = None,
                  forward_timeout_s: float = 30.0,
@@ -505,7 +574,26 @@ class ScoringRouter:
             rid: ReplicaEndpoint(rid, addr, http_addr)
             for rid, (addr, http_addr) in replicas.items()
         }
-        self.ring = HashRing(self.replicas, vnodes=vnodes)
+        # Pod-as-unit topology (Podracer, PAPERS.md): the ring hashes
+        # accounts onto PODS — mesh-backed replica groups whose
+        # slot-sharded state spans the whole mesh — not onto single
+        # chips. ``pods`` maps pod id -> member replica ids; the default
+        # (every replica its own pod, pod id == replica id) reproduces
+        # the PR 6 single-replica mapping bit-for-bit, so existing
+        # fleets and the golden ring owners are unchanged.
+        if pods is None:
+            pods = {rid: (rid,) for rid in self.replicas}
+        self.pods = {p: tuple(ms) for p, ms in pods.items()}
+        unknown = sorted(m for ms in self.pods.values() for m in ms
+                         if m not in self.replicas)
+        if unknown:
+            raise ValueError(f"pod members without endpoints: {unknown}")
+        self.pod_of = {m: p for p, ms in self.pods.items() for m in ms}
+        orphans = sorted(r for r in self.replicas if r not in self.pod_of)
+        if orphans:
+            raise ValueError(f"replicas assigned to no pod: {orphans}")
+        self.ring = HashRing(self.pods, vnodes=vnodes)
+        self.pod_ring = PodRing(self.ring, self.pod_of, self.pods)
         if hedge is None:
             hedge = os.environ.get("ROUTER_HEDGE", "1") != "0"
         self.hedge_enabled = hedge
@@ -524,7 +612,7 @@ class ScoringRouter:
         self._rng = rng or random.Random()
         self._rng_lock = threading.Lock()
         self.watcher = FleetHealthWatcher(
-            self.ring, self.replicas,
+            self.pod_ring, self.replicas,
             interval_s=(health_interval_s if health_interval_s is not None
                         else float(os.environ.get(
                             "ROUTER_HEALTH_INTERVAL_S", "0.25"))),
@@ -628,6 +716,24 @@ class ScoringRouter:
         with self._rng_lock:
             return 0.5 + self._rng.random()
 
+    def _endpoint(self, owner: str) -> ReplicaEndpoint:
+        """Resolve a ring owner (a POD id) to the member endpoint to
+        dial: the first serving member, else the first in-ring
+        (degraded) one, else the first member — a fully-dark pod still
+        yields a dialable endpoint so the retry path produces honest
+        failure evidence instead of a KeyError."""
+        members = self.pods.get(owner)
+        if not members:
+            return self.replicas[owner]
+        fallback = None
+        for rid in members:
+            r = self.replicas[rid]
+            if r.state == "serving":
+                return r
+            if fallback is None and r.state in _IN_RING:
+                fallback = r
+        return fallback or self.replicas[members[0]]
+
     # -- retry/forward core --------------------------------------------------
 
     def _backoff_s(self, exc: grpc.RpcError) -> float:
@@ -680,13 +786,13 @@ class ScoringRouter:
             target = next((o for o in owners if o not in tried), None)
             if target is None:
                 break
-            replica = self.replicas[target]
+            replica = self._endpoint(target)
             self._bump("forwards")
             try:
                 # Each attempt is a trace stage: fleet traces show which
                 # replica answered, which attempts burned time, and the
                 # stage histogram gains a `router.attempt` row.
-                with tracing.span("router.attempt", replica=target,
+                with tracing.span("router.attempt", replica=replica.id,
                                   attempt=attempt):
                     if chaos.fire("router.forward") == "drop":
                         self._bump("link_drops")
@@ -705,7 +811,7 @@ class ScoringRouter:
                 # health probe will classify it; only a hintless failure
                 # (dead socket, refused connection) is death evidence.
                 if _pushback_ms_from(exc) is None:
-                    self.watcher.note_forward_failure(target, exc)
+                    self.watcher.note_forward_failure(replica.id, exc)
                 if attempt + 1 >= self.max_attempts:
                     break
                 time.sleep(self._backoff_s(exc))
@@ -734,7 +840,7 @@ class ScoringRouter:
         if len(owners) < 2:
             return self._forward("score_txn", payload, key, timeout_s,
                                  metadata, ddl)
-        primary, secondary = self.replicas[owners[0]], self.replicas[owners[1]]
+        primary, secondary = self._endpoint(owners[0]), self._endpoint(owners[1])
         t0 = time.monotonic()
         self._bump("forwards")
         fut_primary = primary.score_txn.future(
@@ -1026,8 +1132,25 @@ class ScoringRouter:
     def snapshot(self) -> dict:
         with self.stats_lock:
             stats = dict(self.stats)
+        out_members = self.pod_ring.out_members()
+        pods = {}
+        for pod, members in self.pods.items():
+            caps = [self.replicas[m].capacity_slots for m in members]
+            hbms = [self.replicas[m].hbm_bytes for m in members]
+            pods[pod] = {
+                "members": {m: self.replicas[m].state for m in members},
+                "in_ring": not set(members) <= out_members,
+                # Aggregate advertisement: the pod's mesh holds ONE
+                # slot-sharded state image, so capacity sums over the
+                # members that reported (None until first deep scrape).
+                "capacity_slots": (sum(c for c in caps if c is not None)
+                                   or None),
+                "hbm_bytes": (sum(b for b in hbms if b is not None)
+                              or None),
+            }
         return {
             "ring": self.ring.snapshot(),
+            "pods": pods,
             "watcher": self.watcher.snapshot(),
             "stats": stats,
             "hedge_deadline_ms": round(

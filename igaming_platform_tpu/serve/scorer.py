@@ -247,6 +247,37 @@ class TPUScoringEngine:
             [self.config.block_threshold, self.config.review_threshold], dtype=np.int32
         )
         self._mesh = mesh
+        # Slot-sharded device state (parallel/state_sharding.py,
+        # ROADMAP item 2): on a mesh with a >1 ``data`` axis the HBM
+        # feature table and session ring row-shard by slot and the
+        # cached/session programs compile as shard_map bodies — same
+        # outputs bit-for-bit, ~1/K per-chip HBM, still one dispatch.
+        from igaming_platform_tpu.parallel import state_sharding
+
+        self._state_plan = state_sharding.plan_for(mesh)
+        # Model parallelism over the SAME mesh (MODEL_SHARDING=1
+        # default): wide ensemble pieces — the GBDT tree bank over
+        # ``expert`` (margins partial-summed in-graph by the SPMD
+        # partitioner), MLP/multitask trunks over ``model`` — so
+        # aggregate HBM holds one model copy per mesh, not per chip.
+        # Values never change, only layout; the routed backend owns its
+        # own expert-parallel layout in parallel/ep.py and is excluded.
+        self._model_sharded = False
+        if (mesh is not None and params is not None
+                and ml_backend != "routed"
+                and os.environ.get("MODEL_SHARDING", "1") not in ("0", "false")):
+            from igaming_platform_tpu.parallel.mesh import (
+                AXIS_EXPERT,
+                AXIS_MODEL,
+                mesh_axis_size,
+            )
+            from igaming_platform_tpu.parallel.sharding import shard_model_params
+
+            if (mesh_axis_size(mesh, AXIS_MODEL) > 1
+                    or mesh_axis_size(mesh, AXIS_EXPERT) > 1):
+                params = shard_model_params(mesh, ml_backend, params)
+                self._params = params
+                self._model_sharded = True
 
         # WIRE_DTYPE=bf16 (opt-in): ship feature batches to the device as
         # bfloat16 — half the host->device bytes; the graph casts back to
@@ -372,8 +403,12 @@ class TPUScoringEngine:
         # route bulk traffic to the host), while a near-empty flush — even
         # at the stock batch_size=256 where no smaller tier compiles —
         # skips the device link entirely.
+        # A MULTI-device mesh disables the tier (its step is a
+        # collective program a lone CPU executable can't impersonate); a
+        # 1-device mesh — the loopback/degraded shape multihost_engine
+        # builds so rebuilds never silently drop sharding — keeps it.
         self._host_tier = (
-            0 if mesh is not None
+            0 if (mesh is not None and mesh.devices.size > 1)
             else max(0, min(bcfg.host_tier_rows, self.batch_size - 1))
         )
         self._fn_host = None
@@ -698,6 +733,44 @@ class TPUScoringEngine:
                 int(F.TX_AMOUNT), int(F.TX_TYPE_DEPOSIT),
                 int(F.TX_TYPE_WITHDRAW), int(F.TX_TYPE_BET),
             )
+            plan = self._state_plan
+            if plan is not None:
+                # Slot-sharded fused step: the sharded gather feeds the
+                # same score + in-graph sketch + shadow composition —
+                # one shard_map body, one jit dispatch.
+                from jax.sharding import PartitionSpec as P
+
+                from igaming_platform_tpu.core.compat import shard_map
+                from igaming_platform_tpu.parallel import state_sharding as ss
+
+                def fused_cached_sharded(params, cand, table_l, flags_l,
+                                         idxs, amounts, types, bl, thr, n):
+                    x = ss.gather_slots(table_l, idxs)
+                    f32 = x.dtype
+                    x = x.at[:, txa].set(amounts)
+                    x = x.at[:, td].set((types == 0).astype(f32))
+                    x = x.at[:, tw].set((types == 1).astype(f32))
+                    x = x.at[:, tb].set((types == 2).astype(f32))
+                    blv = jnp.logical_or(bl, ss.gather_slots(flags_l, idxs))
+                    out = core(params, x, blv, thr)
+                    packed = _stack_packed(out)
+                    res = [packed]
+                    if sketch:
+                        res.append(drift_mod.sketch_kernel(x, packed, n))
+                    if shadow:
+                        res.append(_stack_packed(core(cand, x, blv, thr)))
+                    return tuple(res)
+
+                outs = [P()] + ([P()] if sketch else []) \
+                    + ([P()] if shadow else [])
+                return jax.jit(shard_map(
+                    fused_cached_sharded,
+                    mesh=self._mesh,
+                    in_specs=(P(), P(), plan.spec(2), plan.spec(1), P(),
+                              P(), P(), P(), P(), P()),
+                    out_specs=tuple(outs),
+                    check_vma=False,
+                ))
 
             def fused_cached(params, cand, table, flags, idxs, amounts,
                              types, bl, thr, n):
@@ -741,7 +814,9 @@ class TPUScoringEngine:
                 capacity=self.cache.capacity, n_events=mgr.n_events,
                 min_events=mgr.min_events,
                 flag_threshold=mgr.flag_threshold,
-                sketch=sketch, shadow=shadow)
+                sketch=sketch, shadow=shadow, plan=self._state_plan)
+            if self._state_plan is not None:
+                return jax.jit(step, donate_argnums=(4, 5, 6))
             if self._mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -1012,6 +1087,13 @@ class TPUScoringEngine:
         write to the served tree outside it, because a bare rebind skips
         the fingerprint refresh (breaking ledger attribution + replay)
         and the host-tier copy (splitting the tiers' models)."""
+        if self._model_sharded:
+            # Hot-swapped checkpoints take the same mesh layout as the
+            # boot params (layout only — values and therefore the
+            # fingerprint are unchanged).
+            from igaming_platform_tpu.parallel.sharding import shard_model_params
+
+            params = shard_model_params(self._mesh, self.ml_backend, params)
         params_host = (
             jax.device_put(params, self._host_cpu) if self._fn_host is not None else None
         )
@@ -1165,7 +1247,37 @@ class TPUScoringEngine:
                 x = x.at[:, tb].set((types == 2).astype(f32))
                 return packed(params, x, jnp.logical_or(bl, flags[idxs]), thr)
 
-            if self._mesh is not None:
+            plan = self._state_plan
+            if plan is not None:
+                # Slot-sharded table: the gather becomes an exact
+                # owner-select collective inside a shard_map body —
+                # still one jit dispatch, identical outputs, per-chip
+                # table bytes ~1/K.
+                from jax.sharding import PartitionSpec as P
+
+                from igaming_platform_tpu.core.compat import shard_map
+                from igaming_platform_tpu.parallel import state_sharding as ss
+
+                def cached_step_sharded(params, table_l, flags_l, idxs,
+                                        amounts, types, bl, thr):
+                    x = ss.gather_slots(table_l, idxs)
+                    f32 = x.dtype
+                    x = x.at[:, txa].set(amounts)
+                    x = x.at[:, td].set((types == 0).astype(f32))
+                    x = x.at[:, tw].set((types == 1).astype(f32))
+                    x = x.at[:, tb].set((types == 2).astype(f32))
+                    blv = jnp.logical_or(bl, ss.gather_slots(flags_l, idxs))
+                    return packed(params, x, blv, thr)
+
+                self._cached_fn = jax.jit(shard_map(
+                    cached_step_sharded,
+                    mesh=self._mesh,
+                    in_specs=(P(), plan.spec(2), plan.spec(1), P(), P(),
+                              P(), P(), P()),
+                    out_specs=P(),
+                    check_vma=False,
+                ))
+            elif self._mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
                 repl = NamedSharding(self._mesh, P())
@@ -1223,8 +1335,13 @@ class TPUScoringEngine:
         step = session_mod.make_session_step(
             self._score_fn_f32, self.config, mgr.head_fn,
             capacity=cache.capacity, n_events=mgr.n_events,
-            min_events=mgr.min_events, flag_threshold=mgr.flag_threshold)
-        if self._mesh is not None:
+            min_events=mgr.min_events, flag_threshold=mgr.flag_threshold,
+            plan=self._state_plan)
+        if self._state_plan is not None:
+            # shard_map specs already constrain the layout; the ring
+            # state donates shard-for-shard (outputs alias inputs).
+            self._session_fn = jax.jit(step, donate_argnums=(4, 5, 6))
+        elif self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             repl = NamedSharding(self._mesh, P())

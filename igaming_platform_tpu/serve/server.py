@@ -554,6 +554,23 @@ class RiskServer:
                         self._send(404, '{"error":"drift observatory disabled"}')
                         return
                     self._send(200, json.dumps(drift_engine.snapshot()))
+                elif self.path == "/debug/cachez":
+                    # Device feature cache incl. the slot-shard
+                    # breakdown (per-shard occupancy + HBM budget) —
+                    # what each mesh chip actually holds; the router's
+                    # pod capacity advertisement scrapes this.
+                    inner = getattr(server_ref.engine, "inner",
+                                    server_ref.engine)
+                    cache = getattr(inner, "cache", None)
+                    if cache is None:
+                        self._send(404, '{"error":"feature cache disabled"}')
+                        return
+                    snap = cache.stats()
+                    snap["shards"] = cache.shard_stats()
+                    sess = getattr(inner, "session", None)
+                    if sess is not None:
+                        snap["session_shards"] = sess.shard_stats()
+                    self._send(200, json.dumps(snap))
                 elif self.path == "/debug/sessionz":
                     # Stateful sequence scoring: session-ring occupancy,
                     # warm/cold/bypass row accounting, HBM budget and

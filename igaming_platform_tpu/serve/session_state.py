@@ -206,27 +206,37 @@ def transformer_scores(sparams, window, lengths):
 # The fused step: feature gather + score + session head + in-place append
 
 
-def build_windows(ring, cursor, length, sidx, events, n_events: int):
-    """Gather each row's POST-APPEND window from the ring: the last
+def windows_from_state(ring_rows, cur, ln, events, n_events: int):
+    """Post-append window construction from PRE-GATHERED per-row ring
+    state (``ring_rows`` [B, N, D], ``cur``/``ln`` [B]): the last
     ``min(length, N-1)`` stored events in chronological order, then the
-    new event, zero-padded to [B, N, D]. Duplicate accounts within one
-    batch see the BATCH-START state (batch-snapshot semantics — the host
-    index and replay apply the same rule), while their appends land at
-    distinct cursor offsets."""
+    new event, zero-padded to [B, N, D]. Split out of
+    :func:`build_windows` so the slot-sharded fused step
+    (parallel/state_sharding.py gathers the rows with an exact
+    owner-select collective) reuses the identical window math — one
+    implementation, bitwise-shared by the replicated and sharded
+    programs."""
     import jax.numpy as jnp
 
-    cur = cursor[sidx]
-    ln = length[sidx]
     lp = jnp.minimum(ln + 1, n_events)  # post-append window length
     hist = lp - 1                       # historical events kept
     k = jnp.arange(n_events)[None, :]
     pos = jnp.mod(cur[:, None] - hist[:, None] + k, n_events)
-    win = ring[sidx[:, None], pos]      # [B, N, D]
+    win = jnp.take_along_axis(ring_rows, pos[..., None], axis=1)  # [B, N, D]
     keep = (k < hist[:, None])[..., None]
     win = jnp.where(keep, win, 0.0)
     at_event = (k == hist[:, None])[..., None]
     win = jnp.where(at_event, events[:, None, :], win)
     return win, lp
+
+
+def build_windows(ring, cursor, length, sidx, events, n_events: int):
+    """Gather each row's POST-APPEND window from the ring. Duplicate
+    accounts within one batch see the BATCH-START state (batch-snapshot
+    semantics — the host index and replay apply the same rule), while
+    their appends land at distinct cursor offsets."""
+    return windows_from_state(
+        ring[sidx], cursor[sidx], length[sidx], events, n_events)
 
 
 def occurrence_rank_host(uidx: np.ndarray) -> np.ndarray:
@@ -285,7 +295,8 @@ class SessionChunkAudit:
 def make_session_step(score_fn, cfg, head_fn, *, capacity: int,
                       n_events: int, min_events: int,
                       flag_threshold: float,
-                      sketch: bool = False, shadow: bool = False):
+                      sketch: bool = False, shadow: bool = False,
+                      plan=None):
     """Build the jittable fused session scoring step.
 
     Signature (scorer jits it with the ring state donated)::
@@ -319,6 +330,18 @@ def make_session_step(score_fn, cfg, head_fn, *, capacity: int,
     threshold a warm row's outputs are bit-identical to the session-off
     path. COLD rows never fold (honest stateless fallback): they carry
     the ``SESSION_COLD`` reason bit instead.
+
+    ``plan`` (parallel/state_sharding.SlotShardingPlan) selects the
+    SLOT-SHARDED twin: the feature table and the ring state arrive as
+    per-shard row blocks inside a ``shard_map`` body — gathers become
+    exact owner-select collectives, the donated append lands only on
+    the owning shard (``mode='drop'``; padding rows at
+    ``sidx == capacity`` are owned by nobody, replacing the scratch
+    row), and the window/fold math is the SAME code
+    (:func:`windows_from_state` / ``_session_fold``), so sharded
+    outputs are bit-identical to the replicated program. The returned
+    callable is the shard_map-wrapped program with the same external
+    signature — still ONE jit dispatch once the scorer jits it.
     """
     import jax
     import jax.numpy as jnp
@@ -394,6 +417,79 @@ def make_session_step(score_fn, cfg, head_fn, *, capacity: int,
             out_c = score_fn(cand, x, blv, thr)
             res.append(_session_fold(out_c, sprob, fold, cold, thr))
         return tuple(res)
+
+    def _sharded_body(params, sparams, table_l, flags_l, ring_l, cur_l,
+                      len_l, idxs, sidx, occ, amounts, types, events, bl,
+                      thr, cand, n):
+        from igaming_platform_tpu.parallel import state_sharding as ss
+
+        # -- sharded feature gather (exact owner-select) ------------------
+        x = ss.gather_slots(table_l, idxs)
+        f32 = x.dtype
+        x = x.at[:, txa].set(amounts)
+        x = x.at[:, td].set((types == 0).astype(f32))
+        x = x.at[:, tw].set((types == 1).astype(f32))
+        x = x.at[:, tb].set((types == 2).astype(f32))
+        blv = jnp.logical_or(bl, ss.gather_slots(flags_l, idxs))
+        out = score_fn(params, x, blv, thr)
+
+        # -- sharded window gather + the SAME fold math -------------------
+        rows = ss.gather_slots(ring_l, sidx)
+        cur = ss.gather_slots(cur_l, sidx)
+        ln = ss.gather_slots(len_l, sidx)
+        win, lp = windows_from_state(rows, cur, ln, events, n_events)
+        sprob = head_fn(sparams, win, lp).astype(jnp.float32)
+        real = sidx < capacity
+        warm = jnp.logical_and(lp >= min_events, real)
+        fold = jnp.logical_and(warm, sprob >= flag_threshold)
+        cold = jnp.logical_and(jnp.logical_not(warm), real)
+        packed = _session_fold(out, sprob, fold, cold, thr)
+
+        # -- owned-only donated append (padding drops: no scratch row) ----
+        li, _ = ss.local_slot_index(ring_l.shape[0], sidx)
+        wpos = jnp.mod(cur + occ, n_events)
+        ring2 = ring_l.at[li, wpos].set(events, mode="drop")
+        adds = jnp.zeros((ring_l.shape[0],), jnp.int32).at[li].add(
+            1, mode="drop")
+        cursor2 = jnp.mod(cur_l + adds, n_events)
+        length2 = jnp.minimum(len_l + adds, n_events)
+        res = [packed, ring2, cursor2, length2]
+        if sketch:
+            from igaming_platform_tpu.obs.drift import sketch_kernel
+
+            res.append(sketch_kernel(x, packed, n))
+        if shadow:
+            out_c = score_fn(cand, x, blv, thr)
+            res.append(_session_fold(out_c, sprob, fold, cold, thr))
+        return tuple(res)
+
+    if plan is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from igaming_platform_tpu.core.compat import shard_map
+
+        outs = ([P(), plan.spec(3), plan.spec(1), plan.spec(1)]
+                + ([P()] if sketch else []) + ([P()] if shadow else []))
+        sharded = shard_map(
+            _sharded_body,
+            mesh=plan.mesh,
+            in_specs=(P(), P(), plan.spec(2), plan.spec(1), plan.spec(3),
+                      plan.spec(1), plan.spec(1), P(), P(), P(), P(), P(),
+                      P(), P(), P(), P(), P()),
+            out_specs=tuple(outs),
+            check_vma=False,
+        )
+        if sketch or shadow:
+            return sharded
+
+        def sharded_step(params, sparams, table, flags, ring, cursor,
+                         length, idxs, sidx, occ, amounts, types, events,
+                         bl, thr):
+            return sharded(params, sparams, table, flags, ring, cursor,
+                           length, idxs, sidx, occ, amounts, types, events,
+                           bl, thr, None, 0)[:4]
+
+        return sharded_step
 
     if sketch or shadow:
         return _body
@@ -504,16 +600,33 @@ class SessionStateManager:
         self.cold_rows = 0
         self.bypass_rows = 0
 
-        ring = jnp.zeros((self.capacity + 1, self.n_events, EVENT_WIDTH),
+        from igaming_platform_tpu.parallel import state_sharding
+
+        # Slot-sharded ring (parallel/state_sharding.py): the SAME plan
+        # the feature cache derived (capacity arrives pre-rounded from
+        # cache.capacity), so one slot id owns the same shard in both
+        # tables. The sharded layout drops the scratch row: padding rows
+        # target sidx == capacity, which no shard owns — reads clamp
+        # into discarded outputs, appends scatter with mode='drop'.
+        self.plan = state_sharding.plan_for(mesh)
+        self.n_shards = 1 if self.plan is None else self.plan.n_shards
+        ring_rows = self.capacity if self.plan is not None else self.capacity + 1
+        self._ring_rows = ring_rows
+        ring = jnp.zeros((ring_rows, self.n_events, EVENT_WIDTH),
                          dtype=jnp.float32)
-        cursor = jnp.zeros((self.capacity + 1,), dtype=jnp.int32)
-        length = jnp.zeros((self.capacity + 1,), dtype=jnp.int32)
+        cursor = jnp.zeros((ring_rows,), dtype=jnp.int32)
+        length = jnp.zeros((ring_rows,), dtype=jnp.int32)
 
         def sync(ring, cur, ln, slots, w, c, l):  # noqa: E741
             return (ring.at[slots].set(w), cur.at[slots].set(c),
                     ln.at[slots].set(l))
 
-        if mesh is not None:
+        if self.plan is not None:
+            ring = self.plan.place(ring)
+            cursor = self.plan.place(cursor)
+            length = self.plan.place(length)
+            self._sync = state_sharding.make_sharded_ring_sync(self.plan)
+        elif mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             repl = NamedSharding(mesh, P())
@@ -554,10 +667,26 @@ class SessionStateManager:
         if rehydrations:
             m.session_rehydrations_total.inc(rehydrations)
         m.session_hbm_bytes.set(self.hbm_bytes())
+        for s, b in enumerate(self.hbm_bytes_per_shard()):
+            m.hbm_bytes.set(b, shard=str(s), table="session_ring")
 
     def hbm_bytes(self) -> int:
-        return ((self.capacity + 1) * self.n_events * EVENT_WIDTH * 4
-                + 2 * (self.capacity + 1) * 4)
+        return (self._ring_rows * self.n_events * EVENT_WIDTH * 4
+                + 2 * self._ring_rows * 4)
+
+    def hbm_bytes_per_shard(self) -> list[int]:
+        """Static per-shard ring budget (equal contiguous row blocks)."""
+        per = self.hbm_bytes() // self.n_shards
+        return [per] * self.n_shards
+
+    def shard_stats(self) -> dict:
+        """Per-shard breakdown for /debug/sessionz + the fleet view."""
+        return {
+            "sharded": self.plan is not None,
+            "shards": self.n_shards,
+            "rows_per_shard": self._ring_rows // self.n_shards,
+            "hbm_bytes": self.hbm_bytes_per_shard(),
+        }
 
     def snapshot(self) -> dict:
         """/debug/sessionz payload (docs/operations.md 'Session state')."""
@@ -576,6 +705,7 @@ class SessionStateManager:
                 "admissions": self.admissions,
                 "rows": {"warm": self.warm_rows, "cold": self.cold_rows,
                          "bypass": self.bypass_rows},
+                "sharding": self.shard_stats(),
             }
 
     def note_bypass(self, n: int) -> None:

@@ -1,0 +1,57 @@
+"""Seeded JX07 violations: jit programs closing over big device state
+(the feature table / session ring / served params) instead of taking it
+as a traced argument with an explicit sharding. The capture-by-argument
+siblings are compliant controls and must stay quiet."""
+
+import jax
+import jax.numpy as jnp
+
+TABLE = jnp.zeros((64, 30))
+
+
+def module_capture():
+    # Bare-name capture of a module-level table: baked into the
+    # executable as a replicated constant.
+    step = jax.jit(lambda idxs: TABLE[idxs])  # expect: JX07
+    return step
+
+
+@jax.jit
+def decorated_capture(idxs):
+    return TABLE[idxs] * 2.0  # expect: JX07
+
+
+class CacheHolder:
+    def __init__(self):
+        self.table = jnp.zeros((64, 30))
+        self.session_ring = jnp.zeros((64, 16, 12))
+        self._params = {"w": jnp.zeros((30, 1))}
+
+    def bad_attr_capture(self):
+        # Attribute capture through self: the jit body reads the live
+        # engine state as a closure constant.
+        return jax.jit(lambda i: self.table[i])  # expect: JX07
+
+    def bad_named_fn(self):
+        def step(i):
+            win = self.session_ring[i]  # expect: JX07
+            return win @ self._params["w"][:12]  # expect: JX07
+
+        return jax.jit(step)
+
+    def good_argument(self):
+        from jax.sharding import PartitionSpec as P  # noqa: PY01
+
+        def step(table, i):
+            return table[i]
+
+        # Compliant: state enters as a traced argument; layout pinned
+        # at the jit boundary.
+        return jax.jit(step, in_shardings=(P("data", None), P()))
+
+    def good_local_rebind(self):
+        def step(i):
+            table = jnp.zeros((4, 4))  # locally bound, not a capture
+            return table[i]
+
+        return jax.jit(step)

@@ -47,9 +47,9 @@ def test_repo_analyzes_clean_and_fast():
 
 
 def test_rule_catalog_is_wellformed():
-    assert {"JX01", "JX02", "JX03", "JX04", "JX05", "JX06", "CC01", "CC02",
-            "CC03", "CC04", "CC05", "CC06", "CC07", "CC08", "CC09", "MX01",
-            "MX02", "MX03", "MX04", "MX05", "MX06", "MX07", "PY01",
+    assert {"JX01", "JX02", "JX03", "JX04", "JX05", "JX06", "JX07", "CC01",
+            "CC02", "CC03", "CC04", "CC05", "CC06", "CC07", "CC08", "CC09",
+            "MX01", "MX02", "MX03", "MX04", "MX05", "MX06", "MX07", "PY01",
             "PY06"} <= set(RULES)
     for rid, r in RULES.items():
         assert r.category in ("JX", "CC", "MX", "PY"), rid
@@ -95,9 +95,9 @@ def test_fixture_corpus_fires_exactly_where_seeded():
         f"{sorted(unexpected)}")
     # Every new analyzer rule is exercised by the corpus.
     covered = {r for _, _, r in expected} | {"CC01"}
-    assert {"JX01", "JX02", "JX03", "JX04", "JX05", "JX06", "CC01", "CC02",
-            "CC03", "CC04", "CC05", "CC06", "CC07", "CC08", "CC09", "MX01",
-            "MX02", "MX03", "MX04", "MX05", "MX06", "MX07"} <= covered
+    assert {"JX01", "JX02", "JX03", "JX04", "JX05", "JX06", "JX07", "CC01",
+            "CC02", "CC03", "CC04", "CC05", "CC06", "CC07", "CC08", "CC09",
+            "MX01", "MX02", "MX03", "MX04", "MX05", "MX06", "MX07"} <= covered
 
 
 def test_lock_cycle_report_names_both_acquisition_sites():

@@ -40,6 +40,12 @@ REPO_CONFIG = {
         "igaming_platform_tpu/ops/", "igaming_platform_tpu/parallel/",
     ),
     "cc_scope": ("igaming_platform_tpu/serve/", "igaming_platform_tpu/obs/"),
+    # JX07 sharding discipline: jit roots must take the big state tables
+    # (feature table / session ring / served params) as traced arguments
+    # with explicit layouts — scoped to where those tables live.
+    "jx07_scope": (
+        "igaming_platform_tpu/serve/", "igaming_platform_tpu/models/",
+    ),
     # CC07 param-mutation discipline: anywhere a served param tree could
     # be rebound — the serving layer, the training/promotion side, and
     # the harnesses that assemble engines.

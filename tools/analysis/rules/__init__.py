@@ -10,6 +10,7 @@ render byte-identical reports.
 from tools.analysis.rules import donation as _donation  # noqa: PY01
 from tools.analysis.rules import hygiene as _hygiene  # noqa: PY01
 from tools.analysis.rules import jax_hotpath as _jax_hotpath  # noqa: PY01
+from tools.analysis.rules import jax_sharding as _jax_sharding  # noqa: PY01
 from tools.analysis.rules import locks as _locks  # noqa: PY01
 from tools.analysis.rules import metrics as _metrics  # noqa: PY01
 from tools.analysis.rules import paramswap as _paramswap  # noqa: PY01

@@ -1,0 +1,178 @@
+"""JX07 — sharding discipline: big device state enters jit as an argument.
+
+The slot-sharded state plane (parallel/state_sharding.py) only works
+because the HBM feature table, the session ring and the served param
+tree reach every jit/pjit program as TRACED ARGUMENTS whose layout is
+pinned — either by an explicit ``in_shardings``/``PartitionSpec`` or by
+a ``shard_map`` body's in_specs. A program that instead CLOSES OVER one
+of those arrays bakes it into the executable as a constant: XLA
+replicates the full table into every device's image (silently undoing
+the 1/K per-chip HBM budget the mesh was provisioned for), and every
+rebind of the state (delta scatter, donated ring append, param swap)
+either retraces the program or — worse — keeps serving the stale
+captured copy.
+
+This rule flags jit/pjit roots in the sharding scope (serve/ + models/)
+whose body references big-state names it does not bind:
+
+- attribute form — ``self.cache.table``, ``mgr.session_ring``,
+  ``self._params`` read inside the traced body while the base object is
+  not a parameter;
+- bare-name form — a free variable named like the state tables
+  (``table``/``TABLE``, ``session_ring``, ...) captured from an
+  enclosing scope.
+
+Compliant code passes the array as a parameter (the capture-by-argument
+idiom every scorer program uses) and declares its layout at the jit
+boundary. Fixture corpus: tests/fixtures/static_analysis/jx/sharding.py.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.engine import FileContext, ProjectContext, dotted_name, rule
+
+_JIT_NAMES = {"jit", "pjit"}
+
+# Attribute names that identify the big device-state arrays when read
+# through an object (closure capture of engine/cache/manager state).
+_STATE_ATTRS = {"table", "session_ring", "session_cursor", "session_length",
+                "_params", "_params_host"}
+
+# Free-variable spellings of the same state (case-insensitive).
+_STATE_NAMES = {"table", "feature_table", "session_ring", "session_cursor",
+                "session_length"}
+
+
+def _scoped_files(project: ProjectContext) -> list[FileContext]:
+    config = project.caches.get("config", {})
+    prefixes = config.get("jx07_scope")
+    if not prefixes:
+        return list(project.files)
+    return [f for f in project.files
+            if any(f.relpath.startswith(p) for p in prefixes)]
+
+
+def _is_jit_ref(expr: ast.AST) -> bool:
+    name = dotted_name(expr)
+    return name is not None and name.split(".")[-1] in _JIT_NAMES
+
+
+def _local_defs(ctx: FileContext) -> dict[str, ast.AST]:
+    """name -> nearest def/lambda assignment in the file (jit targets
+    resolve file-locally; a miss costs a finding, not a false one)."""
+    out: dict[str, ast.AST] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, node)
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Lambda):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.setdefault(t.id, node.value)
+    return out
+
+
+def _bound_names(fn: ast.AST) -> set[str]:
+    """Names the function binds anywhere inside: parameters (incl.
+    nested defs/lambdas/comprehensions) and local assignments — the
+    conservative complement of 'captured from an enclosing scope'."""
+    bound: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            a = node.args
+            for grp in (getattr(a, "posonlyargs", []), a.args, a.kwonlyargs):
+                bound.update(p.arg for p in grp)
+            if a.vararg:
+                bound.add(a.vararg.arg)
+            if a.kwarg:
+                bound.add(a.kwarg.arg)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bound.add(node.name)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            bound.add(node.id)
+        elif isinstance(node, (ast.comprehension,)):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    bound.add(t.id)
+    return bound
+
+
+def _root_name(node: ast.AST) -> str | None:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _captures(fn: ast.AST):
+    """(line, description) for every big-state capture in the body."""
+    bound = _bound_names(fn)
+    seen: set[tuple[int, str]] = set()
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.attr in _STATE_ATTRS):
+                base = _root_name(node.value)
+                if base is not None and base not in bound:
+                    key = (node.lineno, f"{base}...{node.attr}")
+                    if key not in seen:
+                        seen.add(key)
+                        yield node.lineno, f"`{dotted_name(node) or node.attr}`"
+            elif (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id.lower() in _STATE_NAMES
+                    and node.id not in bound):
+                key = (node.lineno, node.id)
+                if key not in seen:
+                    seen.add(key)
+                    yield node.lineno, f"`{node.id}`"
+
+
+def _jit_targets(ctx: FileContext, defs: dict[str, ast.AST]):
+    """Every (wrapped function, jit site line) in the file: decorator
+    and wrap-call forms, named defs and inline lambdas."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                callee = dec.func if isinstance(dec, ast.Call) else dec
+                if _is_jit_ref(callee):
+                    yield node, dec.lineno
+                    break
+        elif (isinstance(node, ast.Call) and _is_jit_ref(node.func)
+                and node.args):
+            target = node.args[0]
+            if isinstance(target, ast.Lambda):
+                yield target, node.lineno
+            elif isinstance(target, ast.Name) and target.id in defs:
+                yield defs[target.id], node.lineno
+
+
+@rule("JX07", "sharding-discipline",
+      "A jit/pjit program in the serving/model scope closes over a big "
+      "device-state array (feature table / session ring / served "
+      "params) instead of taking it as a traced argument. The capture "
+      "bakes the array into the executable: XLA replicates the full "
+      "table into every device image — silently undoing the slot-"
+      "sharded 1/K per-chip HBM layout (parallel/state_sharding.py) — "
+      "and state rebinds (delta scatter, donated append, param swap) "
+      "retrace or go stale. Pass the array as an argument and pin its "
+      "layout with an explicit in_shardings/PartitionSpec (or a "
+      "shard_map body's in_specs).",
+      scope="project")
+def sharding_discipline(project: ProjectContext):
+    for ctx in _scoped_files(project):
+        defs = _local_defs(ctx)
+        reported: set[tuple[int, str]] = set()
+        for fn, site in _jit_targets(ctx, defs):
+            for line, what in _captures(fn):
+                if (line, what) in reported:
+                    continue
+                reported.add((line, what))
+                yield ctx, line, (
+                    f"jit root (wrapped at line {site}) closes over device "
+                    f"state {what} — implicit full replication of the big "
+                    "table on every device and a retrace/stale-copy hazard "
+                    "on rebind; pass it as a traced argument with an "
+                    "explicit in_shardings/PartitionSpec")
