@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-fast lint lint-json lint-changed lint-sarif lint-update-baseline bench bench-all bench-fused bench-mesh bench-paced bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos soak-chaos-ledger soak-slo soak-online soak-drift soak-session soak-deadline replay-verify fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
+.PHONY: all test test-fast lint lint-json lint-changed lint-sarif lint-update-baseline bench bench-all bench-fused bench-mesh bench-hostprof bench-trend bench-paced bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos soak-chaos-ledger soak-slo soak-online soak-drift soak-session soak-deadline replay-verify fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
 
 all: native test
 
@@ -61,6 +61,24 @@ bench-fused:
 BENCH_MESH_K ?= 4
 bench-mesh:
 	env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=$(BENCH_MESH_K)" BENCH_MESH_K=$(BENCH_MESH_K) $(PY) bench.py --mesh
+
+# Host-plane cost observatory (ISSUE 16): the stateful serving path
+# (index wire, device feature cache, session plane) profiled end to end
+# — per-stage µs/row table, interval-union stage coverage, folded-stack
+# flamegraph (speedscope at /debug/hostprofz), GC pause accounting with
+# in-flight-RPC attribution, and a profiler-on/off/off A/B/A ->
+# HOSTPROF_r16.json. Gated on coverage >= 0.90, flamegraph content
+# (session bookkeeping + RPC decode named), GC accounting, and the
+# on/off ratio >= HOSTPROF_AB_BAR (default 0.90).
+bench-hostprof:
+	$(PY) bench.py --hostprof
+
+# Perf-trajectory table over every committed *_rNN.json artifact:
+# flat-out txns/s + paced/e2e p99 per revision with within-noise
+# regression flags (same family+source series only). `--gate` (the
+# BENCH_TREND_GATE=1 form) makes flags fatal for CI.
+bench-trend:
+	$(PY) tools/benchtrend.py $(if $(BENCH_TREND_GATE),--gate,)
 
 # Paced-arrival latency gate (deadline scheduler, PR 11): open-loop
 # Poisson ScoreTransaction load at BENCH_PACED_RATE (default 2000 rps on

@@ -831,6 +831,7 @@ def run_single_txn_probe(addr: str, n: int = 150) -> dict:
 def start_inprocess_server(
     *, batch_size: int = 4096, ml_backend: str = "multitask",
     seed_accounts: int = 512, ledger_dir: str | None = None,
+    feature_cache: int | None = None, session_state: bool | None = None,
 ):
     """Production wiring on a free port: native feature store, multitask
     backend, native wire codec. Returns (addr, shutdown_fn, engine) —
@@ -840,7 +841,13 @@ def start_inprocess_server(
     ``ledger_dir`` (or the LEDGER_DIR env) binds a durable decision
     ledger (serve/ledger.py) so load runs measure the audit pipeline's
     hot-path cost — ``engine.ledger.stats_block()`` lands in artifacts
-    as ``ledger_block``."""
+    as ``ledger_block``.
+
+    ``feature_cache``/``session_state`` enable the device-resident
+    feature table and the session plane, so index-mode load
+    (``run_grpc_load(wire_mode='index')``) exercises the stateful
+    scoring path — the host-cost observatory arm profiles exactly
+    this wiring."""
     import jax
 
     from igaming_platform_tpu.core.config import BatcherConfig, ScoringConfig
@@ -858,6 +865,8 @@ def start_inprocess_server(
         params=params,
         batcher_config=BatcherConfig(batch_size=batch_size, max_wait_ms=1.0),
         feature_store=best_feature_store(),
+        feature_cache=feature_cache,
+        session_state=session_state,
     )
     ledger = None
     ledger_dir = ledger_dir or os.environ.get("LEDGER_DIR", "")

@@ -722,6 +722,9 @@ class DriftEngine:
         return int(now / self.config.bucket_s)
 
     def _worker(self) -> None:
+        from igaming_platform_tpu.obs import hostprof
+
+        hostprof.register_scoring_thread("drift")
         while True:
             with self._cv:
                 while not self._pending and not self._stopping:

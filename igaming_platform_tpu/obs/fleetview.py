@@ -167,6 +167,53 @@ def merge_histograms(
 
 
 # ---------------------------------------------------------------------------
+# Host-cost rollup (/debug/hostprofz -> fleet view)
+
+
+def fleet_host_stage_block(
+        hostprofzs: list[tuple[str, dict | None]]) -> dict:
+    """Merge per-replica hostprofz stage tables into one fleet view:
+    summed spans/rows/µs per stage (totals and rows are additive; the
+    fleet mean µs/row is total µs over total rows — exact, unlike
+    averaging per-replica means), plus the fleet-wide hottest stage by
+    total host µs and each replica's own hottest stage."""
+    stages: dict[str, dict] = {}
+    per_replica_hottest: dict[str, str | None] = {}
+    reporting = 0
+    for rid, payload in hostprofzs:
+        table = (payload or {}).get("stages")
+        if not isinstance(table, dict):
+            continue
+        reporting += 1
+        hottest = None
+        hottest_us = -1.0
+        for stage, row in table.items():
+            if not isinstance(row, dict):
+                continue
+            agg = stages.setdefault(stage, {
+                "spans": 0, "rows": 0, "total_us": 0.0})
+            agg["spans"] += int(row.get("spans") or 0)
+            agg["rows"] += int(row.get("rows") or 0)
+            total_us = float(row.get("total_us") or 0.0)
+            agg["total_us"] += total_us
+            if total_us > hottest_us:
+                hottest, hottest_us = stage, total_us
+        per_replica_hottest[rid] = hottest
+    for agg in stages.values():
+        agg["total_us"] = round(agg["total_us"], 1)
+        agg["us_per_row_mean"] = (
+            round(agg["total_us"] / agg["rows"], 4) if agg["rows"] else None)
+    fleet_hottest = max(
+        stages.items(), key=lambda kv: kv[1]["total_us"])[0] if stages else None
+    return {
+        "replicas_reporting": reporting,
+        "stages": dict(sorted(stages.items())),
+        "hottest_stage": fleet_hottest,
+        "per_replica_hottest": per_replica_hottest,
+    }
+
+
+# ---------------------------------------------------------------------------
 # The scraping plane
 
 
@@ -181,6 +228,7 @@ class _ReplicaState:
         self.sloz: dict | None = None
         self.driftz: dict | None = None
         self.cachez: dict | None = None
+        self.hostprofz: dict | None = None
         self.flight: list[dict] = []
         self.last_good_monotonic: float | None = None
         self.consecutive_failures = 0
@@ -249,13 +297,14 @@ class FleetView:
             histograms = parse_histograms(metrics_text)
             # Debug surfaces are best-effort per-endpoint: a replica
             # without a supervisor (404) still contributes histograms.
-            supervisorz = sloz = driftz = cachez = None
+            supervisorz = sloz = driftz = cachez = hostprofz = None
             flight: list[dict] = []
             for path, setter in (
                 ("/debug/supervisorz", "supervisorz"),
                 ("/debug/sloz", "sloz"),
                 ("/debug/driftz", "driftz"),
                 ("/debug/cachez", "cachez"),
+                ("/debug/hostprofz", "hostprofz"),
                 ("/debug/flightz", "flight"),
             ):
                 try:
@@ -270,6 +319,8 @@ class FleetView:
                     driftz = payload if isinstance(payload, dict) else None
                 elif setter == "cachez":
                     cachez = payload if isinstance(payload, dict) else None
+                elif setter == "hostprofz":
+                    hostprofz = payload if isinstance(payload, dict) else None
                 else:
                     flight = payload if isinstance(payload, list) else []
         except Exception as exc:  # noqa: BLE001 — a dead/hung replica must not kill the ticker
@@ -286,6 +337,7 @@ class FleetView:
             state.sloz = sloz
             state.driftz = driftz
             state.cachez = cachez
+            state.hostprofz = hostprofz
             state.flight = flight
             state.last_good_monotonic = time.monotonic()
             state.consecutive_failures = 0
@@ -367,6 +419,7 @@ class FleetView:
             per_replica_hists: list[tuple[str, dict]] = []
             flights: list[tuple[str, list[dict]]] = []
             driftzs: list[tuple[str, dict | None]] = []
+            hostprofzs: list[tuple[str, dict | None]] = []
             merge_errors: list[str] = []
             for st in replicas:
                 age = (None if st.last_good_monotonic is None
@@ -407,6 +460,7 @@ class FleetView:
                 per_replica_hists.append((st.rid, st.histograms))
                 flights.append((st.rid, st.flight))
                 driftzs.append((st.rid, st.driftz))
+                hostprofzs.append((st.rid, st.hostprofz))
         # Merge OUTSIDE the lock (pure compute over snapshotted refs).
         stages: dict[str, HistogramSnapshot] = {}
         for rid, hists in per_replica_hists:
@@ -462,6 +516,7 @@ class FleetView:
             "replicas": states,
             "fleet_capacity": fleet_capacity,
             "fleet_stage_latency_ms": stage_block,
+            "fleet_host_stage": fleet_host_stage_block(hostprofzs),
             "fleet_drift": fleet_drift,
             "histogram_merge_errors": merge_errors,
             "slowest_traces": self._slowest_traces(flights),

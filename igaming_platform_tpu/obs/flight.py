@@ -49,6 +49,22 @@ class FlightRecorder:
             return
         busy_ms = sum((span.stage_totals or {}).values())
         wall_ms = tracing.union_duration_ms(span.stage_windows)
+        # Host-cost join (obs/hostprof.py's per-RPC face): the same
+        # decomposition as stages_ms, but in µs and — when the handler
+        # stamped a `rows` root attribute — per row, so one decision id
+        # joins trace, flight, ledger AND cost.
+        rows = span.attributes.get("rows")
+        rows = rows if isinstance(rows, int) and rows > 0 else None
+        stage_us = {
+            k: round(v * 1000.0, 1) for k, v in (span.stage_totals or {}).items()
+        }
+        host_cost = {
+            "rows": rows,
+            "stage_us": stage_us,
+            "us_per_row": (
+                {k: round(us / rows, 3) for k, us in stage_us.items()}
+                if rows else None),
+        }
         self.record({
             "method": span.name[4:],
             "trace_id": span.trace_id,
@@ -64,6 +80,7 @@ class FlightRecorder:
             "stage_overlap_ratio": (
                 round(max(0.0, 1.0 - wall_ms / busy_ms), 4) if busy_ms > 0 else 0.0
             ),
+            "host_cost": host_cost,
             **{k: v for k, v in span.attributes.items()},
         })
 

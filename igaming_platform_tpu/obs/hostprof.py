@@ -1,0 +1,581 @@
+"""Host-plane cost observatory — where the microseconds went.
+
+The device side of the serving stack is accounted to death (telemetry,
+step models, MFU); the HOST side only had span-level p50/p99. This
+module closes the gap with two tiers, both off the hot path:
+
+Tier A (always on): per-stage **µs/row** accounting. Every completed
+``score.*`` stage span (decode, gather, cache_lookup, pad, dispatch,
+readback, session, ledger_note, encode, ...) is folded — via a tracing
+span sink, so the serving code is untouched — into per-stage
+cost-per-row distributions: cumulative totals plus a bounded reservoir
+of recent per-span samples. Durations ride the spans' monotonic clock
+(``perf_counter``; MX06 enforces this in obs/). The same tier watches
+the collector: a ``gc.callbacks`` hook records collection counts and
+pause-ms per generation, attributing each pause to the rpc.* roots in
+flight when it hit (read off the tracing thread-active table), plus
+heap gauges (allocated blocks, per-generation counts, peak RSS).
+
+Tier B (on demand / ``HOSTPROF_HZ``): a threading stack sampler over an
+explicit scoring-path thread registry. Handler threads auto-register on
+their first completed rpc.* root; pipeline stage workers, readback /
+ledger / drift / shadow workers call ``register_scoring_thread(role)``.
+The sampler reads ``sys._current_frames()`` at HOSTPROF_HZ, keys each
+registered thread's stack by its ACTIVE SPAN (so a frame inside
+``prepare_chunk`` folds under ``span:score.session``), and accumulates
+collapsed-stack (flamegraph) counts exportable as folded text or
+speedscope JSON at ``/debug/hostprofz?format=...``. Sampling a thread
+NOT in the registry is an analyzer violation (MX08): the registry is
+the contract that keeps profiling hooks off jit roots and hot loops.
+
+Overhead contract: Tier A is one dict update per completed stage span
+(the bench artifact's profiler-on/off A/B holds the e2e ratio ≥ 0.90);
+Tier B costs only while running and only for registered threads.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+from igaming_platform_tpu.obs import tracing
+
+_STAGE_PREFIX = "score."
+# Per-stage reservoir of recent per-span µs/row samples — the "rolling
+# window" the distributions are computed over. Bounded so an unbounded
+# soak cannot grow the profiler.
+_SAMPLE_RESERVOIR = 2048
+# Folded-stack table bound: pathological stack diversity aggregates
+# into the "<other>" key instead of growing without limit.
+_MAX_FOLDED_KEYS = 20000
+_MAX_STACK_DEPTH = 48
+# Bounded ring of recent GC pauses (generation, pause_ms, in-flight
+# rpc count, trace ids) for the hostprofz page.
+_GC_PAUSE_RING = 256
+
+
+# ---------------------------------------------------------------------------
+# Scoring-path thread registry (Tier B's sampling contract)
+
+_REGISTRY_LOCK = threading.Lock()
+_THREAD_ROLES: dict[int, str] = {}
+
+
+def register_scoring_thread(role: str, ident: int | None = None) -> int:
+    """Register the calling (or given) thread as a scoring-path thread
+    the sampler may profile. ``role`` is a short bounded label
+    (``grpc_handler``, ``pipeline_stage``, ``readback``, ``ledger``,
+    ``drift``, ``shadow``, ...) that prefixes its folded stacks.
+    Idempotent; returns the registered ident."""
+    if ident is None:
+        ident = threading.get_ident()
+    with _REGISTRY_LOCK:
+        _THREAD_ROLES[ident] = str(role)
+    return ident
+
+
+def unregister_scoring_thread(ident: int | None = None) -> None:
+    if ident is None:
+        ident = threading.get_ident()
+    with _REGISTRY_LOCK:
+        _THREAD_ROLES.pop(ident, None)
+
+
+def registered_threads() -> dict[int, str]:
+    """Snapshot of {thread ident: role}."""
+    with _REGISTRY_LOCK:
+        return dict(_THREAD_ROLES)
+
+
+# ---------------------------------------------------------------------------
+# Tier B: the stack sampler
+
+
+def _format_frame(frame) -> str:
+    code = frame.f_code
+    base = os.path.basename(code.co_filename)
+    if base.endswith(".py"):
+        base = base[:-3]
+    return f"{base}.{code.co_name}"
+
+
+class StackSampler:
+    """HOSTPROF_HZ stack sampler over the registered scoring threads.
+
+    Folds each sample into ``role;span:<active span>;frame;...;leaf``
+    collapsed-stack form. Start/stop on demand (the /debug/profilez
+    pattern): one sampler at a time, 409-style refusal handled by the
+    caller. The sampler thread itself is a daemon and never touches
+    unregistered threads.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._folded: dict[str, int] = {}
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.hz = 0.0
+        self.samples_total = 0
+        self.threads_seen: set[str] = set()
+        self._started_mono: float | None = None
+        self.last_duration_s = 0.0
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self, hz: float) -> bool:
+        """Begin sampling at ``hz``. False if already running."""
+        if not hz > 0:
+            return False
+        with self._lock:
+            if self.running:
+                return False
+            self._stop.clear()
+            self.hz = float(hz)
+            self._started_mono = time.monotonic()
+            self._thread = threading.Thread(
+                target=self._run, name="hostprof-sampler", daemon=True)
+            self._thread.start()
+        return True
+
+    def stop(self) -> dict:
+        """Stop sampling; returns a summary block."""
+        thread = self._thread
+        self._stop.set()
+        if thread is not None:
+            thread.join(timeout=2.0)
+        with self._lock:
+            if self._started_mono is not None:
+                self.last_duration_s = time.monotonic() - self._started_mono
+                self._started_mono = None
+            self._thread = None
+        return self.snapshot()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._folded.clear()
+            self.samples_total = 0
+            self.threads_seen.clear()
+
+    def _run(self) -> None:
+        interval = 1.0 / self.hz
+        while not self._stop.is_set():
+            t0 = time.monotonic()
+            try:
+                self._sample_once()
+            except Exception:  # noqa: BLE001 — a sampler bug must never hurt serving
+                pass
+            elapsed = time.monotonic() - t0
+            # A sampling profiler WANTS a fixed cadence — jitter here
+            # would bias the stack histogram toward quiet periods.
+            self._stop.wait(max(0.001, interval - elapsed))  # noqa: CC05 — deliberate fixed-cadence sampler
+
+    def _sample_once(self) -> None:
+        roles = registered_threads()
+        if not roles:
+            return
+        # Sampling seam: reading every thread's frame is the documented,
+        # GIL-atomic profiling hook; it runs on the SAMPLER thread only
+        # and touches registered scoring threads' frames read-only.
+        frames = sys._current_frames()  # noqa: MX08 — the registry-gated sampler itself
+        actives = tracing.active_spans_by_thread()
+        with self._lock:
+            for ident, role in roles.items():
+                frame = frames.get(ident)
+                if frame is None:
+                    continue
+                parts: list[str] = []
+                depth = 0
+                while frame is not None and depth < _MAX_STACK_DEPTH:
+                    parts.append(_format_frame(frame))
+                    frame = frame.f_back
+                    depth += 1
+                parts.reverse()  # root-first, flamegraph convention
+                span = actives.get(ident)
+                span_name = span.name if span is not None else "idle"
+                key = ";".join([role, f"span:{span_name}", *parts])
+                if key not in self._folded and len(self._folded) >= _MAX_FOLDED_KEYS:
+                    key = "<other>"
+                self._folded[key] = self._folded.get(key, 0) + 1
+                self.samples_total += 1
+                self.threads_seen.add(role)
+
+    # -- exports ------------------------------------------------------------
+
+    def folded(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._folded)
+
+    def top_stacks(self, n: int = 20) -> list[dict]:
+        folded = self.folded()
+        total = sum(folded.values()) or 1
+        ranked = sorted(folded.items(), key=lambda kv: kv[1], reverse=True)
+        return [{"stack": k, "samples": v, "share": round(v / total, 4)}
+                for k, v in ranked[:n]]
+
+    def to_folded_text(self) -> str:
+        """Classic collapsed-stack format (``stack count`` per line) —
+        pipe straight into flamegraph.pl / inferno."""
+        folded = self.folded()
+        return "\n".join(
+            f"{stack} {count}" for stack, count in sorted(folded.items()))
+
+    def to_speedscope(self) -> dict:
+        """speedscope.app 'sampled' profile of the folded table."""
+        folded = self.folded()
+        frame_index: dict[str, int] = {}
+        frames: list[dict] = []
+        samples: list[list[int]] = []
+        weights: list[int] = []
+        for stack, count in sorted(folded.items()):
+            idxs: list[int] = []
+            for name in stack.split(";"):
+                idx = frame_index.get(name)
+                if idx is None:
+                    idx = frame_index[name] = len(frames)
+                    frames.append({"name": name})
+                idxs.append(idx)
+            samples.append(idxs)
+            weights.append(count)
+        total = sum(weights)
+        return {
+            "$schema": "https://www.speedscope.app/file-format-schema.json",
+            "shared": {"frames": frames},
+            "profiles": [{
+                "type": "sampled",
+                "name": "hostprof",
+                "unit": "none",
+                "startValue": 0,
+                "endValue": total,
+                "samples": samples,
+                "weights": weights,
+            }],
+            "exporter": "igaming-platform-tpu hostprof",
+        }
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            distinct = len(self._folded)
+        return {
+            "running": self.running,
+            "hz": self.hz,
+            "samples_total": self.samples_total,
+            "distinct_stacks": distinct,
+            "roles_seen": sorted(self.threads_seen),
+            "registered_threads": len(registered_threads()),
+            "last_duration_s": round(self.last_duration_s, 3),
+            "top_stacks": self.top_stacks(20),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Tier A: µs/row stage accounting + GC/heap watch
+
+
+class _StageAcc:
+    __slots__ = ("spans", "rows", "total_us", "samples")
+
+    def __init__(self):
+        self.spans = 0
+        self.rows = 0
+        self.total_us = 0.0
+        # Recent per-span µs/row samples (rolling window).
+        self.samples: deque = deque(maxlen=_SAMPLE_RESERVOIR)
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class HostProfiler:
+    """Always-on Tier A accounting + the Tier B sampler, one object.
+
+    Installed once (``install()``/``get_default()``): rides the tracing
+    module's extra span sink — never wraps serving code — and a
+    ``gc.callbacks`` hook. ``HOSTPROF=0`` disables Tier A entirely;
+    ``HOSTPROF_HZ>0`` starts the sampler at boot.
+    """
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._stages: dict[str, _StageAcc] = {}
+        self._rpc = _StageAcc()
+        self.sampler = StackSampler()
+        self.metrics = None
+        self._installed = False
+        self._gc_installed = False
+        # GC accounting (all guarded by _lock except the start stamp,
+        # which only the collecting thread touches while it holds the GIL).
+        self._gc_start_ns: dict[int, int] = {}
+        self._gc_collections: dict[int, int] = {}
+        self._gc_pause_ms_total: dict[int, float] = {}
+        self._gc_pauses: deque = deque(maxlen=_GC_PAUSE_RING)
+        self._gc_pauses_in_rpc = 0
+        self._gc_pause_in_rpc_ms = 0.0
+
+    # -- install -------------------------------------------------------------
+
+    def install(self, metrics=None) -> "HostProfiler":
+        if metrics is not None:
+            self.bind_metrics(metrics)
+        if not self.enabled or self._installed:
+            return self
+        self._installed = True
+        tracing.add_span_sink(self._on_span)
+        self.install_gc_watch()
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed:
+            tracing.remove_span_sink(self._on_span)
+            self._installed = False
+        if self._gc_installed:
+            try:
+                gc.callbacks.remove(self._gc_callback)
+            except ValueError:
+                pass
+            self._gc_installed = False
+        if self.sampler.running:
+            self.sampler.stop()
+
+    def bind_metrics(self, metrics) -> None:
+        """Attach a ServiceMetrics so stage costs / GC pauses land on
+        /metrics next to the rest of the serving series."""
+        self.metrics = metrics
+
+    def install_gc_watch(self) -> None:
+        if self._gc_installed:
+            return
+        self._gc_installed = True
+        gc.callbacks.append(self._gc_callback)
+
+    # -- Tier A intake -------------------------------------------------------
+
+    def _on_span(self, span) -> None:
+        """Extra span sink (tracing): every completed span lands here.
+        Must stay O(1) and never raise — it runs on serving threads."""
+        name = span.name
+        us = span.duration_ms * 1000.0
+        if name.startswith("rpc."):
+            # Auto-register the handler thread for the sampler: the span
+            # completes on the thread that served the RPC.
+            ident = threading.get_ident()
+            if ident not in _THREAD_ROLES:
+                register_scoring_thread("grpc_handler", ident)
+            rows = span.attributes.get("rows")
+            with self._lock:
+                self._rpc.spans += 1
+                self._rpc.total_us += us
+                if isinstance(rows, int) and rows > 0:
+                    self._rpc.rows += rows
+                    self._rpc.samples.append(us / rows)
+            return
+        if not name.startswith(_STAGE_PREFIX):
+            return
+        stage = name[len(_STAGE_PREFIX):]
+        rows = span.attributes.get("batch")
+        per_row = None
+        if isinstance(rows, int) and rows > 0:
+            per_row = us / rows
+        with self._lock:
+            acc = self._stages.get(stage)
+            if acc is None:
+                acc = self._stages[stage] = _StageAcc()
+            acc.spans += 1
+            acc.total_us += us
+            if per_row is not None:
+                acc.rows += rows
+                acc.samples.append(per_row)
+        m = self.metrics
+        if m is not None and per_row is not None:
+            m.host_stage_us_per_row.observe(
+                per_row, exemplar=span.trace_id, stage=stage)
+
+    # -- GC watch ------------------------------------------------------------
+
+    def _gc_callback(self, phase: str, info: dict) -> None:
+        try:
+            gen = int(info.get("generation", 0))
+            if phase == "start":
+                self._gc_start_ns[gen] = time.perf_counter_ns()
+                return
+            start_ns = self._gc_start_ns.pop(gen, None)
+            if start_ns is None:
+                return
+            pause_ms = (time.perf_counter_ns() - start_ns) / 1e6
+            # Attribute the pause: which rpc.* roots were in flight when
+            # the world stopped? (The GIL is held during collection, so
+            # every in-flight RPC ate this pause.)
+            inflight: dict[str, str] = {}
+            for span in tracing.active_spans_by_thread().values():
+                root = span.root if span.root is not None else span
+                if root.name.startswith("rpc."):
+                    inflight[root.span_id] = root.trace_id
+            with self._lock:
+                self._gc_collections[gen] = self._gc_collections.get(gen, 0) + 1
+                self._gc_pause_ms_total[gen] = (
+                    self._gc_pause_ms_total.get(gen, 0.0) + pause_ms)
+                self._gc_pauses.append({
+                    "generation": gen,
+                    "pause_ms": round(pause_ms, 4),
+                    "collected": info.get("collected"),
+                    "inflight_rpcs": len(inflight),
+                    "trace_ids": sorted(inflight.values())[:4],
+                })
+                if inflight:
+                    self._gc_pauses_in_rpc += 1
+                    self._gc_pause_in_rpc_ms += pause_ms
+            m = self.metrics
+            if m is not None:
+                m.gc_collections_total.inc(generation=str(gen))
+                m.gc_pause_ms.observe(pause_ms, generation=str(gen))
+        except Exception:  # noqa: BLE001 — a GC hook must never break collection
+            pass
+
+    # -- snapshots -----------------------------------------------------------
+
+    @staticmethod
+    def _heap_block() -> dict:
+        block = {
+            "allocated_blocks": sys.getallocatedblocks(),
+            "gc_counts": list(gc.get_count()),
+            "gc_thresholds": list(gc.get_threshold()),
+        }
+        try:
+            import resource
+
+            block["ru_maxrss_kb"] = int(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+        except Exception:  # noqa: BLE001 — resource is POSIX-only
+            block["ru_maxrss_kb"] = None
+        return block
+
+    def _stage_block(self) -> dict:
+        with self._lock:
+            snap = {
+                stage: (acc.spans, acc.rows, acc.total_us, list(acc.samples))
+                for stage, acc in self._stages.items()
+            }
+            rpc = (self._rpc.spans, self._rpc.rows, self._rpc.total_us,
+                   list(self._rpc.samples))
+        out: dict[str, dict] = {}
+        for stage, (spans, rows, total_us, samples) in sorted(snap.items()):
+            samples.sort()
+            out[stage] = {
+                "spans": spans,
+                "rows": rows,
+                "total_us": round(total_us, 1),
+                "us_per_row": ({
+                    "mean": round(total_us / rows, 4),
+                    "p50": round(_percentile(samples, 0.50), 4),
+                    "p99": round(_percentile(samples, 0.99), 4),
+                } if rows > 0 else None),
+            }
+        spans, rows, total_us, samples = rpc
+        samples.sort()
+        rpc_block = {
+            "rpcs": spans,
+            "rows": rows,
+            "total_us": round(total_us, 1),
+            "us_per_row": ({
+                "mean": round(total_us / rows, 4),
+                "p50": round(_percentile(samples, 0.50), 4),
+                "p99": round(_percentile(samples, 0.99), 4),
+            } if rows > 0 else None),
+        }
+        return {"stages": out, "rpc": rpc_block}
+
+    def gc_snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "collections": {str(g): n for g, n
+                                in sorted(self._gc_collections.items())},
+                "pause_ms_total": {str(g): round(v, 3) for g, v
+                                   in sorted(self._gc_pause_ms_total.items())},
+                "pauses_in_rpc": self._gc_pauses_in_rpc,
+                "pause_in_rpc_ms": round(self._gc_pause_in_rpc_ms, 3),
+                "recent_pauses": list(self._gc_pauses)[-20:],
+            }
+
+    def snapshot(self) -> dict:
+        block = self._stage_block()
+        return {
+            "enabled": self.enabled,
+            **block,
+            "gc": self.gc_snapshot(),
+            "heap": self._heap_block(),
+            "sampler": self.sampler.snapshot(),
+        }
+
+    def reset(self) -> None:
+        """Zero the accounting (bench arms isolate their windows)."""
+        with self._lock:
+            self._stages.clear()
+            self._rpc = _StageAcc()
+            self._gc_collections.clear()
+            self._gc_pause_ms_total.clear()
+            self._gc_pauses.clear()
+            self._gc_pauses_in_rpc = 0
+            self._gc_pause_in_rpc_ms = 0.0
+        self.sampler.reset()
+
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot())
+
+
+# ---------------------------------------------------------------------------
+# Module default (the /debug/hostprofz + bench singleton)
+
+_DEFAULT: HostProfiler | None = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def get_default() -> HostProfiler:
+    """The process-wide profiler. Created on first use; Tier A installs
+    unless HOSTPROF=0, and the sampler starts at boot when HOSTPROF_HZ
+    is set to a positive rate."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            enabled = os.environ.get("HOSTPROF", "1") != "0"
+            _DEFAULT = HostProfiler(enabled=enabled).install()
+            try:
+                boot_hz = float(os.environ.get("HOSTPROF_HZ", "0"))
+            except ValueError:
+                boot_hz = 0.0
+            if enabled and boot_hz > 0:
+                _DEFAULT.sampler.start(boot_hz)
+        return _DEFAULT
+
+
+def install(metrics=None) -> HostProfiler:
+    """Idempotent: bind (or rebind) metrics onto the default profiler."""
+    return get_default().install(metrics)
+
+
+def reinstall_from_env() -> HostProfiler:
+    """Tear down and rebuild the default from the current ``HOSTPROF`` /
+    ``HOSTPROF_HZ`` environment — the bench A/B arms flip these between
+    arms and need the flip to actually take (the default is otherwise
+    created once per process)."""
+    _reset_default_for_tests()
+    return get_default()
+
+
+def _reset_default_for_tests() -> None:
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is not None:
+            _DEFAULT.uninstall()
+        _DEFAULT = None

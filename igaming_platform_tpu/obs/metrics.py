@@ -756,6 +756,33 @@ class ServiceMetrics:
             "lengths) — budget it against the feature table "
             "(docs/operations.md 'Session state')",
         )
+        # Host-plane cost observatory (obs/hostprof.py): per-stage
+        # µs/row cost distributions and the GC pause accounting — the
+        # capacity-math series ("what does one row cost on the host, by
+        # stage") behind /debug/hostprofz.
+        self.host_stage_us_per_row = self.registry.histogram(
+            f"{service}_host_stage_us_per_row",
+            "Host cost per row (µs/row) by serving {stage} (decode/"
+            "gather/cache_lookup/pad/dispatch/readback/session/"
+            "ledger_note/encode), from the monotonic span clock; bucket "
+            "lines carry trace-id exemplars — the per-row capacity "
+            "figure docs/performance.md 'Reading a host flamegraph' "
+            "explains",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250),
+        )
+        self.gc_collections_total = self.registry.counter(
+            f"{service}_gc_collections_total",
+            "Python GC collections by {generation} — a hot gen-2 rate "
+            "on a scoring replica means allocation churn is reaching "
+            "the old generation and paying full-heap pauses",
+        )
+        self.gc_pause_ms = self.registry.histogram(
+            f"{service}_gc_pause_ms",
+            "Python GC stop-the-world pause (ms) by {generation}; the "
+            "hostprofz page attributes each pause to the rpc.* roots "
+            "in flight when it hit",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100),
+        )
         self.spans_dropped_total = self.registry.counter(
             f"{service}_spans_dropped_total",
             "Host spans evicted from the bounded span ring before export "

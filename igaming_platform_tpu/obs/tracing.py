@@ -40,6 +40,14 @@ class Span:
     name: str
     start: float
     end: float = 0.0
+    # Monotonic companion clock (time.perf_counter). ``start``/``end``
+    # are wall stamps for display ("when did this happen"); DURATIONS
+    # come from the monotonic pair — time.time() steps under NTP, and a
+    # slew mid-span would mint a negative or inflated stage cost that
+    # the µs/row accounting (obs/hostprof.py) would then publish as
+    # fact. MX06 (obs scope) enforces this split going forward.
+    mono_start: float = 0.0
+    mono_end: float = 0.0
     trace_id: str = ""
     span_id: str = ""
     parent_id: str = ""
@@ -47,7 +55,8 @@ class Span:
     # Root spans only: summed child-stage durations (ms) by span name —
     # the per-request decomposition the flight recorder snapshots.
     stage_totals: dict | None = field(default=None, repr=False, compare=False)
-    # Root spans only: (start, end) of each completed descendant stage.
+    # Root spans only: (start, end) of each completed descendant stage,
+    # on the MONOTONIC clock (same epoch as mono_start/mono_end).
     # With pipelined serving, stages of one request run CONCURRENTLY on
     # different worker threads, so the busy-time sum (stage_totals) can
     # exceed the request's wall time; the interval union of these
@@ -58,6 +67,8 @@ class Span:
 
     @property
     def duration_ms(self) -> float:
+        if self.mono_end:
+            return (self.mono_end - self.mono_start) * 1000.0
         return (self.end - self.start) * 1000.0
 
 
@@ -90,6 +101,25 @@ def format_traceparent(trace_id: str, span_id: str) -> str:
 # batcher's launcher/collector threads each carry their own chain).
 _CURRENT: contextvars.ContextVar[Span | None] = contextvars.ContextVar(
     "igaming_current_span", default=None)
+
+# Cross-thread mirror of the active span per thread ident. A contextvar
+# is only readable from its own thread; the hostprof stack sampler
+# (obs/hostprof.py) needs to ask "what span is thread T inside right
+# now?" from the SAMPLER thread to key folded stacks by stage, and the
+# GC watch uses it to count rpc.* roots in flight during a pause.
+# Plain dict with GIL-atomic get/set/del per key; entries are removed on
+# span exit so an idle thread holds no stale span.
+_ACTIVE_BY_THREAD: dict[int, Span] = {}
+
+
+def active_span_of_thread(ident: int) -> Span | None:
+    """The span thread ``ident`` is currently inside, from any thread."""
+    return _ACTIVE_BY_THREAD.get(ident)
+
+
+def active_spans_by_thread() -> dict[int, Span]:
+    """Snapshot of every thread's active span (sampler/GC attribution)."""
+    return dict(_ACTIVE_BY_THREAD)
 
 # Roots accumulate stage_totals/stage_windows from EVERY thread their
 # stages run on (pipeline workers included) — one cheap module lock
@@ -276,7 +306,8 @@ def span(name: str, collector: SpanCollector | None = None, *,
             trace_id, parent_id = parsed
     if not trace_id:
         trace_id = uuid.uuid4().hex
-    s = Span(name=name, start=time.time(), trace_id=trace_id,
+    s = Span(name=name, start=time.time(), mono_start=time.perf_counter(),
+             trace_id=trace_id,
              span_id=uuid.uuid4().hex[:16], parent_id=parent_id,
              attributes=attributes)
     if parent is None:
@@ -286,10 +317,18 @@ def span(name: str, collector: SpanCollector | None = None, *,
     else:
         s.root = parent.root if parent.root is not None else parent
     token = _CURRENT.set(s)
+    ident = threading.get_ident()
+    prior_active = _ACTIVE_BY_THREAD.get(ident)
+    _ACTIVE_BY_THREAD[ident] = s
     try:
         yield s
     finally:
         _CURRENT.reset(token)
+        if prior_active is not None:
+            _ACTIVE_BY_THREAD[ident] = prior_active
+        else:
+            _ACTIVE_BY_THREAD.pop(ident, None)
+        s.mono_end = time.perf_counter()
         s.end = time.time()
         collector.add(s)
         root = s.root
@@ -299,7 +338,7 @@ def span(name: str, collector: SpanCollector | None = None, *,
                     root.stage_totals.get(s.name, 0.0) + s.duration_ms)
                 if (root.stage_windows is not None
                         and len(root.stage_windows) < _MAX_STAGE_WINDOWS):
-                    root.stage_windows.append((s.start, s.end))
+                    root.stage_windows.append((s.mono_start, s.mono_end))
         if _SPAN_SINK is not None:
             try:
                 _SPAN_SINK(s)
@@ -335,10 +374,17 @@ def carry(parent: "Span | None"):
         yield
         return
     token = _CURRENT.set(parent)
+    ident = threading.get_ident()
+    prior = _ACTIVE_BY_THREAD.get(ident)
+    _ACTIVE_BY_THREAD[ident] = parent
     try:
         yield
     finally:
         _CURRENT.reset(token)
+        if prior is not None:
+            _ACTIVE_BY_THREAD[ident] = prior
+        else:
+            _ACTIVE_BY_THREAD.pop(ident, None)
 
 
 @contextlib.contextmanager

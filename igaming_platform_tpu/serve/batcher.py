@@ -87,6 +87,9 @@ class CollectorPipeline:
         self._thread.start()
 
     def _loop(self) -> None:
+        from igaming_platform_tpu.obs import hostprof
+
+        hostprof.register_scoring_thread("batch_collector")
         while True:
             item = self._queue.get()
             if item is _SENTINEL:
@@ -268,6 +271,9 @@ class ContinuousBatcher:
     # -- internals -----------------------------------------------------------
 
     def _loop(self) -> None:
+        from igaming_platform_tpu.obs import hostprof
+
+        hostprof.register_scoring_thread("batcher")
         while not self._stop.is_set():
             first = self.scheduler.poll(timeout=0.05)
             if first is None:

@@ -29,6 +29,7 @@ import grpc
 
 from igaming_platform_tpu.core.enums import ReasonCode
 from igaming_platform_tpu.obs import flight as _flight
+from igaming_platform_tpu.obs import hostprof as _hostprof
 from igaming_platform_tpu.obs import drift as _drift
 from igaming_platform_tpu.obs import runtime_telemetry as _runtime_telemetry
 from igaming_platform_tpu.obs import slo as _slo
@@ -52,6 +53,10 @@ from igaming_platform_tpu.serve.supervisor import (
 # Always-on flight recorder: every completed rpc.* root span lands in the
 # bounded ring served at /debug/flightz (obs/flight.py).
 _flight.install()
+# Host-plane cost observatory: Tier A µs/row stage accounting + GC watch
+# ride the tracing span sinks from boot (HOSTPROF=0 disables); metrics
+# bind at service construction below.
+_hostprof.install()
 from igaming_platform_tpu.serve.wire import (
     INDEX_WIRE_MAGIC,
     RawProtoMessage,
@@ -372,6 +377,7 @@ class RiskGrpcService:
         self.ltv_source = ltv_source
         self.abuse_detector = abuse_detector
         self.metrics = metrics or ServiceMetrics("risk")
+        _hostprof.install(self.metrics)
         self._rate_limiter = _FixedWindowRateLimiter(rate_limit_per_minute)
         # Server-side overload control: bulk ScoreBatch work is admitted
         # through a bounded gate. Beyond BULK_MAX_INFLIGHT concurrent bulk

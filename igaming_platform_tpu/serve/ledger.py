@@ -1049,6 +1049,9 @@ class DecisionLedger:
     # -- writer thread ------------------------------------------------------
 
     def _writer_loop(self) -> None:
+        from igaming_platform_tpu.obs import hostprof
+
+        hostprof.register_scoring_thread("ledger")
         last_fsync = time.monotonic()
         fsync_dirty = False
         while True:
@@ -1241,6 +1244,9 @@ class DecisionLedger:
         return out, cur
 
     def _drain_loop(self) -> None:
+        from igaming_platform_tpu.obs import hostprof
+
+        hostprof.register_scoring_thread("ledger_sink")
         while True:
             if not self._drain_once():
                 return

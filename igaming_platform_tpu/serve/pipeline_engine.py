@@ -332,6 +332,9 @@ class HostPipeline:
         return out, xp_buf, bl_buf, hold
 
     def _stage_loop(self) -> None:
+        from igaming_platform_tpu.obs import hostprof
+
+        hostprof.register_scoring_thread("pipeline_stage")
         while True:
             item = self._stage_q.get()
             if item is _SENTINEL:
@@ -368,8 +371,10 @@ class HostPipeline:
     # -- readback worker -----------------------------------------------------
 
     def _readback_loop(self) -> None:
+        from igaming_platform_tpu.obs import hostprof
         from igaming_platform_tpu.serve.scorer import _device_readback, _unpack_host
 
+        hostprof.register_scoring_thread("readback")
         while True:
             item = self._inflight_q.get()
             if item is _SENTINEL:

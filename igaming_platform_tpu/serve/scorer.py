@@ -1399,10 +1399,11 @@ class TPUScoringEngine:
         session hashes for the ledger (None on the plain path)."""
         n = idxs.shape[0]
         shape = self._pick_shape(n)
-        idxsp, _ = pad_batch(idxs, shape)
-        amtp, _ = pad_batch(amounts, shape)
-        typp, _ = pad_batch(types, shape)
-        blp, _ = pad_batch(bl, shape)
+        with span("score.pad", batch=n):
+            idxsp, _ = pad_batch(idxs, shape)
+            amtp, _ = pad_batch(amounts, shape)
+            typp, _ = pad_batch(types, shape)
+            blp, _ = pad_batch(bl, shape)
         if snap is None:
             snap = self.params_snapshot()
         params = snap[0]
@@ -1415,10 +1416,15 @@ class TPUScoringEngine:
             # before anyone else can dispatch against them.
             with mgr.lock:
                 ts = now if now is not None else ledger_mod.wall_clock()
-                events, occ, post_len, seqs, audit = mgr.prepare_chunk(
-                    account_ids, amounts, types, ts)
-                evp, _ = pad_batch(events, shape)
-                occp, _ = pad_batch(occ, shape)
+                # Session bookkeeping seam: the ~µs/row host cost that
+                # drove the SESSION_r13 0.67 A/B rides its own span so
+                # the hostprof µs/row table can name it.
+                with span("score.session", batch=n):
+                    events, occ, post_len, seqs, audit = mgr.prepare_chunk(
+                        account_ids, amounts, types, ts)
+                with span("score.pad", batch=n):
+                    evp, _ = pad_batch(events, shape)
+                    occp, _ = pad_batch(occ, shape)
                 # Fresh per-chunk buffer by design: jax may alias host
                 # memory zero-copy on the CPU backend, so a pooled
                 # buffer could be read by an in-flight dispatch.
@@ -1544,13 +1550,15 @@ class TPUScoringEngine:
                 # (including duplicate accounts within the chunk) from
                 # ledger order + the recorded session fields.
                 chunk = {k: host[k][:n] for k in keys}
-                ledger_mod.note_decisions(
-                    self, chunk, n=n, wire_mode="index", tier="device",
-                    bl=bl[lo:lo + n], account_ids=account_ids[lo:lo + n],
-                    amounts=amounts32[lo:lo + n], tx_codes=types32[lo:lo + n],
-                    params_fp=snap[2], ts=smeta["ts"],
-                    session_lens=smeta["lens"], session_seqs=smeta["seqs"],
-                    session_hashes=smeta["hashes"], mark_root=(lo == 0))
+                with span("score.ledger_note", batch=n):
+                    ledger_mod.note_decisions(
+                        self, chunk, n=n, wire_mode="index", tier="device",
+                        bl=bl[lo:lo + n], account_ids=account_ids[lo:lo + n],
+                        amounts=amounts32[lo:lo + n],
+                        tx_codes=types32[lo:lo + n],
+                        params_fp=snap[2], ts=smeta["ts"],
+                        session_lens=smeta["lens"], session_seqs=smeta["seqs"],
+                        session_hashes=smeta["hashes"], mark_root=(lo == 0))
 
         for lo in range(0, total, self.batch_size):
             hi = min(lo + self.batch_size, total)
@@ -1580,10 +1588,11 @@ class TPUScoringEngine:
         # With session state on, the per-chunk notes above already carried
         # every row (plus its session fields) — no second note here.
         if not session_on:
-            ledger_mod.note_decisions(
-                self, cat, n=total, wire_mode="index", tier="device",
-                bl=bl, account_ids=account_ids, amounts=amounts32,
-                tx_codes=types32, params_fp=snap[2])
+            with span("score.ledger_note", batch=total):
+                ledger_mod.note_decisions(
+                    self, cat, n=total, wire_mode="index", tier="device",
+                    bl=bl, account_ids=account_ids, amounts=amounts32,
+                    tx_codes=types32, params_fp=snap[2])
         return cat, rtms
 
     def score_columns_cached(
@@ -1616,8 +1625,11 @@ class TPUScoringEngine:
         )
 
         start = time.monotonic()
-        with span("score.decode"):
+        with span("score.decode") as dsp:
             ids, amounts, codes, ips, devices, fingerprints = decode_index_batch(payload)
+            # Row count is only known post-decode: stamp it so the host
+            # profiler (obs/hostprof.py) can report decode in µs/row.
+            dsp.attributes["batch"] = len(ids)
         if len(ids) == 0:
             return b"", 0
         self.ensure_cache()
@@ -1658,13 +1670,14 @@ class TPUScoringEngine:
         back onto the responses. No-op without a bound ledger or shadow."""
         if self.ledger is None and self.shadow is None:
             return
-        prefix = ledger_mod.note_decisions(
-            self, out, n=len(responses), wire_mode=wire_mode,
-            x=x, bl=bl, params_fp=params_fp,
-            account_ids=[r.account_id for r in reqs],
-            amounts=[r.amount for r in reqs],
-            tx_codes=[r.tx_type for r in reqs],
-        )
+        with span("score.ledger_note", batch=len(responses)):
+            prefix = ledger_mod.note_decisions(
+                self, out, n=len(responses), wire_mode=wire_mode,
+                x=x, bl=bl, params_fp=params_fp,
+                account_ids=[r.account_id for r in reqs],
+                amounts=[r.amount for r in reqs],
+                tx_codes=[r.tx_type for r in reqs],
+            )
         if prefix is not None:
             for i, resp in enumerate(responses):
                 resp.decision_id = f"{prefix}.{i}"
@@ -1698,8 +1711,9 @@ class TPUScoringEngine:
             # pad copy is already compressed (bf16 halves H2D bytes,
             # int8 quarters them; zero pads survive both exactly).
             x = self._wire_encode(x)
-        xp, _ = pad_batch(x, shape)
-        blp, _ = pad_batch(bl, shape)
+        with span("score.pad", batch=n):
+            xp, _ = pad_batch(x, shape)
+            blp, _ = pad_batch(bl, shape)
         if snap is None:
             # Snapshot under the lock, dispatch outside it — scoring must
             # never serialize on the params mutex.
@@ -1835,8 +1849,10 @@ class TPUScoringEngine:
         start = time.monotonic()
         if not hasattr(self.features, "decode_gather"):
             raise RuntimeError("feature store has no native wire decoder")
-        with span("score.decode"):
+        with span("score.decode") as dsp:
             x, bl = self.features.decode_gather(payload)
+            # Row count is only known post-decode (µs/row accounting).
+            dsp.attributes["batch"] = int(x.shape[0])
         return self._score_rows_to_wire(x, bl, include_features, start), x.shape[0]
 
     def _score_rows_to_wire(
@@ -1912,9 +1928,10 @@ class TPUScoringEngine:
                         "score_observer failed; score histogram will be "
                         "empty for wire batches", exc_info=True,
                     )
-        ledger_mod.note_decisions(
-            self, cat, n=total, wire_mode="wire_row", x=x, bl=bl,
-            account_ids=account_ids, params_fp=snap[2])
+        with span("score.ledger_note", batch=total):
+            ledger_mod.note_decisions(
+                self, cat, n=total, wire_mode="wire_row", x=x, bl=bl,
+                account_ids=account_ids, params_fp=snap[2])
         with span("score.encode", batch=total):
             return encode_score_batch(
                 cat["score"], cat["action"], cat["reason_mask"], cat["rule_score"],

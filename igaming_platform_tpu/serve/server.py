@@ -642,6 +642,28 @@ class RiskServer:
                     # stop when investigating a slow request.
                     from igaming_platform_tpu.obs.flight import DEFAULT_RECORDER
                     self._send(200, DEFAULT_RECORDER.to_json())
+                elif self.path.startswith("/debug/hostprofz"):
+                    # Host-plane cost observatory: per-stage µs/row
+                    # table, GC pause accounting, heap gauges and the
+                    # sampler's folded stacks. ?format=folded returns
+                    # collapsed-stack text (flamegraph.pl/inferno);
+                    # ?format=speedscope returns a speedscope.app
+                    # profile; default is the JSON snapshot (runbook:
+                    # docs/operations.md "Host cost observatory").
+                    from urllib.parse import parse_qs, urlparse
+
+                    from igaming_platform_tpu.obs import hostprof as _hostprof_mod
+
+                    hp = _hostprof_mod.get_default()
+                    q = parse_qs(urlparse(self.path).query)
+                    fmt = q.get("format", ["json"])[0]
+                    if fmt == "folded":
+                        self._send(200, hp.sampler.to_folded_text(),
+                                   "text/plain")
+                    elif fmt == "speedscope":
+                        self._send(200, json.dumps(hp.sampler.to_speedscope()))
+                    else:
+                        self._send(200, hp.to_json())
                 elif self.path.startswith("/debug/profilez"):
                     from urllib.parse import parse_qs, urlparse
 
@@ -762,6 +784,41 @@ class RiskServer:
                     self._send(200, json.dumps({
                         "ok": True, "reference": ref.meta(),
                         "alerts": drift_engine.alerts_active()}))
+                elif self.path == "/debug/hostprofz":
+                    # Sampler control (the profilez on-demand pattern):
+                    # {"action": "start", "hz": 97} begins stack
+                    # sampling over the registered scoring threads;
+                    # {"action": "stop"} halts it and returns the
+                    # summary; {"action": "reset"} zeros the folded
+                    # table and Tier A accounting. A second start while
+                    # running is a 409, like a busy profilez capture.
+                    from igaming_platform_tpu.obs import hostprof as _hostprof_mod
+
+                    hp = _hostprof_mod.get_default()
+                    action = str(payload.get("action", ""))
+                    if action == "start":
+                        try:
+                            hz = float(payload.get("hz", 97.0))
+                        except (TypeError, ValueError):
+                            self._send(400, '{"error":"bad hz"}')
+                            return
+                        if not hp.sampler.start(hz):
+                            self._send(409, json.dumps({
+                                "error": "sampler already running or bad hz",
+                                "sampler": hp.sampler.snapshot()}))
+                            return
+                        self._send(200, json.dumps(
+                            {"ok": True, "sampler": hp.sampler.snapshot()}))
+                    elif action == "stop":
+                        self._send(200, json.dumps(
+                            {"ok": True, "sampler": hp.sampler.stop()}))
+                    elif action == "reset":
+                        hp.reset()
+                        self._send(200, '{"ok":true}')
+                    else:
+                        self._send(400, json.dumps({
+                            "error": f"unknown hostprofz action {action!r} "
+                                     "(use start|stop|reset)"}))
                 elif self.path == "/debug/outcomes":
                     # Label backfill (the v2 ledger side-record): the
                     # operational entry for ground-truth outcomes —

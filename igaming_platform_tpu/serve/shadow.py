@@ -277,8 +277,10 @@ class ShadowScorer:
     # -- worker --------------------------------------------------------------
 
     def _worker(self) -> None:
+        from igaming_platform_tpu.obs import hostprof
         from igaming_platform_tpu.serve.batcher import pad_batch
 
+        hostprof.register_scoring_thread("shadow")
         while True:
             with self._cv:
                 while not self._pending and not self._stopping:

@@ -1,0 +1,42 @@
+"""MX08 seed: profiling hooks in all three banned placements.
+
+Process-global hooks (sys/threading setprofile-settrace, tracemalloc)
+are banned in production code outright; stack snapshots and GC callbacks
+are banned outside the sanctioned obs/hostprof.py seam; and ANY hook
+inside a jit root or a registered hot loop profiles the scoring path
+from the inside."""
+
+import gc
+import sys
+import threading
+import tracemalloc
+
+import jax
+
+
+def install_call_hook(cb) -> None:
+    sys.setprofile(cb)  # expect: MX08
+    threading.setprofile(cb)  # expect: MX08
+
+
+def start_alloc_tracing() -> None:
+    tracemalloc.start(25)  # expect: MX08
+
+
+def snapshot_stacks() -> dict:
+    return dict(sys._current_frames())  # expect: MX08
+
+
+def watch_gc(cb) -> None:
+    gc.callbacks.append(cb)  # expect: MX08
+
+
+def score_rows(rows):  # analysis: hot-loop
+    frames = sys._current_frames()  # expect: MX08
+    return len(frames), rows
+
+
+@jax.jit
+def traced_with_hook(x):
+    sys.settrace(None)  # expect: MX08
+    return x

@@ -1,0 +1,18 @@
+"""MX08-compliant sibling: this file's relpath ends with the sanctioned
+``igaming_platform_tpu/obs/hostprof.py`` seam, so the registry-gated
+sampler's stack snapshot and the single GC-watch callback stay quiet.
+(Process-global hooks would still fire even here, as would any hook
+inside a jit root or hot loop — the seam only covers the sampling
+shapes the observatory actually uses.)"""
+
+import gc
+import sys
+
+
+def sample_once(registry: dict) -> dict:
+    frames = sys._current_frames()
+    return {ident: frames.get(ident) for ident in registry}
+
+
+def install_gc_watch(cb) -> None:
+    gc.callbacks.append(cb)

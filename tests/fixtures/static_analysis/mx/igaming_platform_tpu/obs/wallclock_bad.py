@@ -1,0 +1,28 @@
+"""MX06 seed (obs/ scope): wall-clock duration/cost arithmetic on the
+measurement plane.
+
+Every marked line computes a duration or per-row cost from time.time(),
+which steps under NTP — the phantom-cost-spike violation the obs/ scope
+of the rule exists to catch. Profiler arithmetic anchors to
+time.perf_counter() (tracing.Span's mono_start/mono_end)."""
+
+import time
+
+
+def span_duration(start_wall: float) -> float:
+    duration_ms = (time.time() - start_wall) * 1000.0  # expect: MX06
+    return duration_ms
+
+
+def gc_pause(t0: float) -> float:
+    pause_ms = (time.time() - t0) * 1e3  # expect: MX06
+    return pause_ms
+
+
+def per_row_cost(t0: float, rows: int) -> float:
+    stage_us = (time.time() - t0) * 1e6 / max(rows, 1)  # expect: MX06
+    return stage_us
+
+
+def stale(sample_ts: float, elapsed_budget_s: float) -> bool:
+    return time.time() - sample_ts > elapsed_budget_s  # expect: MX06

@@ -1,0 +1,23 @@
+"""MX06-compliant sibling (obs/ scope): durations anchor to
+time.perf_counter(); time.time() appears only to RECORD an event's wall
+timestamp — including right next to an already-computed ``*_ms`` field,
+the record-statement shape the rule's arithmetic requirement exists to
+keep quiet."""
+
+import time
+
+
+def span_duration(mono_start: float) -> float:
+    duration_ms = (time.perf_counter() - mono_start) * 1000.0
+    return duration_ms
+
+
+def record_event(duration_ms: float) -> dict:
+    # Wall timestamp recorded NEXT TO a computed duration: the wall
+    # clock is not in the arithmetic, so this must stay quiet.
+    return {"t_unix": round(time.time(), 3), "duration_ms": duration_ms}
+
+
+def event_timestamp() -> float:
+    created_unix = time.time()
+    return created_unix

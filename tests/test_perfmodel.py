@@ -1,0 +1,111 @@
+"""OnlineStepModel (obs/perfmodel.py) edge cases: cold start, EWMA
+outlier damping, rejection of garbage observations, and the shape-ladder
+prediction rules the deadline scheduler and hedged re-dispatch plan
+against (never-faster-with-more-rows, bounded-above-by-larger-shape)."""
+
+from __future__ import annotations
+
+import math
+import threading
+
+from igaming_platform_tpu.obs.perfmodel import OnlineStepModel
+
+
+def test_cold_start_predicts_none():
+    m = OnlineStepModel()
+    assert m.predict_ms(1024) is None
+    assert m.stall_threshold_ms(1024) is None
+    assert m.snapshot() == {"observations": 0, "ewma_ms": {}}
+
+
+def test_first_observation_seeds_exactly():
+    m = OnlineStepModel(alpha=0.2)
+    m.observe(1024, 10.0)
+    assert m.predict_ms(1024) == 10.0
+    assert m.snapshot()["ewma_ms"] == {"1024": 10.0}
+
+
+def test_outlier_damping():
+    m = OnlineStepModel(alpha=0.2)
+    m.observe(512, 10.0)
+    # A single 10x outlier moves the estimate by alpha of the delta,
+    # not to the outlier.
+    m.observe(512, 100.0)
+    assert m.predict_ms(512) == 10.0 + 0.2 * 90.0
+    # Sustained observations converge back.
+    for _ in range(50):
+        m.observe(512, 10.0)
+    assert abs(m.predict_ms(512) - 10.0) < 0.5
+
+
+def test_rejects_nan_and_negative():
+    m = OnlineStepModel()
+    m.observe(256, float("nan"))
+    m.observe(256, -1.0)
+    assert m.predict_ms(256) is None
+    assert m.observations == 0
+    m.observe(256, 0.0)  # zero is a legal (very fast) observation
+    assert m.observations == 1
+
+
+def test_shape_ladder_prediction_rules():
+    m = OnlineStepModel()
+    m.observe(256, 5.0)
+    m.observe(4096, 50.0)
+    # Exact hit wins.
+    assert m.predict_ms(256) == 5.0
+    # A smaller never-observed shape is bounded above by the nearest
+    # LARGER observation (more rows can't be faster, so scaling down
+    # from 256 would be optimistic).
+    assert m.predict_ms(128) == 5.0
+    # Between two rungs: the nearest larger rung, not interpolation.
+    assert m.predict_ms(1024) == 50.0
+    # Above the ladder: extrapolate UP from the largest rung by row
+    # ratio (linear-in-rows is the conservative upper bound).
+    assert m.predict_ms(8192) == 50.0 * (8192 / 4096)
+
+
+def test_ladder_switch_tracks_live_link_not_seed():
+    """When traffic switches rungs, the new rung's observations win
+    immediately — the model must track the link actually serving."""
+    m = OnlineStepModel(alpha=0.5)
+    m.observe(4096, 50.0)
+    assert m.predict_ms(1024) == 50.0  # bounded by the only rung
+    m.observe(1024, 8.0)  # the ladder switches to the 1024 tier
+    assert m.predict_ms(1024) == 8.0
+    # And the large rung's estimate is untouched by small-rung traffic.
+    assert m.predict_ms(4096) == 50.0
+
+
+def test_stall_threshold_floor_and_variance_guard():
+    m = OnlineStepModel(alpha=0.2)
+    m.observe(512, 10.0)
+    # Zero variance after the seed: max(4x mean, mean + 5ms slack).
+    assert m.stall_threshold_ms(512) == 40.0
+    # Noisy observations widen the trip-wire via the 3-sigma term so
+    # noise does not hedge the median batch.
+    for ms in (10.0, 30.0, 10.0, 30.0, 10.0, 30.0):
+        m.observe(512, ms)
+    mean = m.predict_ms(512)
+    thr = m.stall_threshold_ms(512)
+    assert thr >= mean * 4.0
+    assert not math.isnan(thr)
+    # Never-observed shapes fall back to the prediction ladder.
+    assert m.stall_threshold_ms(128) is not None
+
+
+def test_thread_safe_observe():
+    m = OnlineStepModel(alpha=0.1)
+
+    def pump(shape):
+        for _ in range(500):
+            m.observe(shape, 10.0)
+
+    threads = [threading.Thread(target=pump, args=(s,))
+               for s in (256, 512, 1024, 256)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert m.observations == 2000
+    assert m.predict_ms(256) == 10.0

@@ -49,8 +49,8 @@ def test_repo_analyzes_clean_and_fast():
 def test_rule_catalog_is_wellformed():
     assert {"JX01", "JX02", "JX03", "JX04", "JX05", "JX06", "JX07", "CC01",
             "CC02", "CC03", "CC04", "CC05", "CC06", "CC07", "CC08", "CC09",
-            "MX01", "MX02", "MX03", "MX04", "MX05", "MX06", "MX07", "PY01",
-            "PY06"} <= set(RULES)
+            "MX01", "MX02", "MX03", "MX04", "MX05", "MX06", "MX07", "MX08",
+            "PY01", "PY06"} <= set(RULES)
     for rid, r in RULES.items():
         assert r.category in ("JX", "CC", "MX", "PY"), rid
         assert r.rationale and r.name, rid
@@ -97,7 +97,8 @@ def test_fixture_corpus_fires_exactly_where_seeded():
     covered = {r for _, _, r in expected} | {"CC01"}
     assert {"JX01", "JX02", "JX03", "JX04", "JX05", "JX06", "JX07", "CC01",
             "CC02", "CC03", "CC04", "CC05", "CC06", "CC07", "CC08", "CC09",
-            "MX01", "MX02", "MX03", "MX04", "MX05", "MX06", "MX07"} <= covered
+            "MX01", "MX02", "MX03", "MX04", "MX05", "MX06", "MX07",
+            "MX08"} <= covered
 
 
 def test_lock_cycle_report_names_both_acquisition_sites():

@@ -14,7 +14,8 @@ from __future__ import annotations
 import ast
 import re
 
-from tools.analysis.engine import FileContext, call_name, rule
+from tools.analysis.engine import (FileContext, ProjectContext, call_name,
+                                   dotted_name, rule)
 
 _CLOCK_CALLS = {"perf_counter", "monotonic", "perf_counter_ns", "monotonic_ns"}
 
@@ -261,8 +262,21 @@ def metric_label_cardinality(ctx: FileContext):
 # revive an expired request or expire a live one (and breaks CC06 replay
 # determinism when the result is ledgered). The serving path's deadline
 # discipline (serve/deadline.py) is monotonic-only.
-_MX06_SCOPE_PART = "serve"
-_MX06_NAME = re.compile(r"deadline|timeout|expir|remaining|time_left", re.I)
+#
+# Scoped per package: serve/ keys on deadline vocabulary; obs/ (the
+# measurement plane — tracing spans, the host profiler, cost
+# accounting) additionally keys on duration/cost vocabulary, because a
+# span duration or µs/row figure computed from two time.time() reads
+# inherits every NTP step as a phantom cost spike. Recording a wall
+# TIMESTAMP (`created_unix`, `start_unix_s`, exemplar ts) stays quiet in
+# both scopes — those names don't match, and tracing.Span carries the
+# perf_counter companion clock (mono_start/mono_end) for arithmetic.
+_MX06_SCOPES: dict[str, re.Pattern[str]] = {
+    "serve": re.compile(r"deadline|timeout|expir|remaining|time_left", re.I),
+    "obs": re.compile(
+        r"deadline|timeout|expir|remaining|time_left"
+        r"|duration|elapsed|pause|latency|(^|_)(ms|us|ns)$", re.I),
+}
 
 
 def _is_wall_clock_call(node: ast.AST) -> bool:
@@ -273,32 +287,56 @@ def _is_wall_clock_call(node: ast.AST) -> bool:
             and node.func.value.id == "time")
 
 
-def _mx06_deadline_mention(stmt: ast.stmt) -> str | None:
-    """A deadline-ish identifier anywhere in the statement: assignment
-    targets, names, attributes, or keyword-argument names."""
+def _wall_clock_in_arithmetic(stmt: ast.stmt) -> bool:
+    """True when a time.time() call sits inside arithmetic or a
+    comparison — computing WITH the wall clock rather than recording it.
+    Distinguishes `duration_ms = (time.time() - t0) * 1e3` (bad) from
+    `{"t_unix": round(time.time(), 3), "duration_ms": dur}` (a record
+    statement that merely sits next to a duration field)."""
     for sub in ast.walk(stmt):
-        if isinstance(sub, ast.Name) and _MX06_NAME.search(sub.id):
+        if isinstance(sub, (ast.BinOp, ast.Compare, ast.AugAssign)):
+            if any(_is_wall_clock_call(s) for s in ast.walk(sub)):
+                return True
+    return False
+
+
+def _mx06_deadline_mention(stmt: ast.stmt, name_re: re.Pattern[str]) -> str | None:
+    """A deadline-ish (or, in obs/, duration/cost-ish) identifier
+    anywhere in the statement: assignment targets, names, attributes, or
+    keyword-argument names."""
+    for sub in ast.walk(stmt):
+        if isinstance(sub, ast.Name) and name_re.search(sub.id):
             return sub.id
-        if isinstance(sub, ast.Attribute) and _MX06_NAME.search(sub.attr):
+        if isinstance(sub, ast.Attribute) and name_re.search(sub.attr):
             return sub.attr
-        if isinstance(sub, ast.keyword) and sub.arg and _MX06_NAME.search(sub.arg):
+        if isinstance(sub, ast.keyword) and sub.arg and name_re.search(sub.arg):
             return sub.arg
     return None
 
 
 @rule("MX06", "wall-clock-deadline",
-      "time.time() in deadline/timeout arithmetic on the serving path: "
-      "the wall clock steps backwards under NTP and jumps on slew, so a "
+      "time.time() in deadline/timeout arithmetic on the serving path, "
+      "or in duration/cost arithmetic on the measurement plane: the "
+      "wall clock steps backwards under NTP and jumps on slew, so a "
       "deadline anchored to it can revive an expired request or expire a "
-      "live one (and, ledgered, breaks CC06 replay determinism). "
-      "Deadline/timeout computations in serve/ must use time.monotonic() "
-      "(serve/deadline.py is the reference discipline); event timestamps "
-      "that merely RECORD wall time are fine — the rule keys on the "
-      "statement also naming a deadline/timeout/expiry quantity.")
+      "live one (and, ledgered, breaks CC06 replay determinism), and a "
+      "span duration / µs-per-row figure computed from it turns every "
+      "NTP step into a phantom cost spike. serve/ deadline computations "
+      "must use time.monotonic() (serve/deadline.py is the reference "
+      "discipline); obs/ profiler and cost arithmetic must use "
+      "time.perf_counter() (tracing.Span's mono_start/mono_end "
+      "companion clock). Event timestamps that merely RECORD wall time "
+      "are fine — the rule keys on the statement also naming a "
+      "deadline/timeout/expiry (or, in obs/, duration/elapsed/pause/"
+      "latency/*_ms/*_us) quantity.")
 def wall_clock_deadline(ctx: FileContext):
     parts = ctx.path.parts
-    if "igaming_platform_tpu" not in parts or _MX06_SCOPE_PART not in parts:
+    if "igaming_platform_tpu" not in parts:
         return
+    scope = next((s for s in _MX06_SCOPES if s in parts), None)
+    if scope is None:
+        return
+    name_re = _MX06_SCOPES[scope]
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.stmt):
             continue
@@ -314,12 +352,22 @@ def wall_clock_deadline(ctx: FileContext):
                if child is not node and any(
                    _is_wall_clock_call(s) for s in ast.walk(child))):
             continue
-        hit = _mx06_deadline_mention(node)
+        # obs/ additionally requires the wall clock to participate in
+        # the arithmetic: the measurement plane legitimately RECORDS
+        # wall timestamps (`t_unix`) right next to already-computed
+        # `*_ms` fields, and those record statements must stay quiet.
+        if scope == "obs" and not _wall_clock_in_arithmetic(node):
+            continue
+        hit = _mx06_deadline_mention(node, name_re)
         if hit is not None:
+            kind, fix = (
+                ("deadline-ish", "time.monotonic() (serve/deadline.py)")
+                if scope == "serve" else
+                ("duration/cost", "time.perf_counter() "
+                 "(tracing.Span.mono_start)"))
             yield calls[0].lineno, (
-                f"time.time() feeding deadline-ish quantity `{hit}` — "
-                "wall clock steps under NTP; anchor deadlines/timeouts "
-                "to time.monotonic() (serve/deadline.py)")
+                f"time.time() feeding {kind} quantity `{hit}` — "
+                f"wall clock steps under NTP; anchor to {fix}")
 
 
 @rule("MX03", "orphan-metric",
@@ -348,3 +396,140 @@ def orphan_metric(ctx: FileContext):
                 "orphan metric: construct via Registry.counter/gauge/"
                 f"histogram (a bare {node.func.id}() never renders "
                 "on /metrics)")
+
+
+# MX08: placement of profiling hooks. The observatory (obs/hostprof.py)
+# exists precisely so that nobody ever has to reach for these:
+#
+#   * sys.setprofile/settrace + threading.setprofile/settrace install a
+#     callback on EVERY call/line bytecode event process-wide — a 2-10x
+#     interpreter tax on the scoring loop while "just measuring";
+#     tracemalloc.start() hooks the allocator the same way.
+#   * sys._current_frames() snapshots every thread's stack under the
+#     GIL; gc.callbacks run inside the collector's pause window.
+#
+# Inside a jit root the hook additionally fires at TRACE time (it
+# measures compilation, then bakes nothing into the graph); inside a
+# registered hot loop (MX04's registry / `# analysis: hot-loop`) it
+# turns the per-batch path into a profiler. The sanctioned seam is
+# obs/hostprof.py: a sampler THREAD reads frames only for threads in the
+# explicit scoring-thread registry, at a bounded HOSTPROF_HZ, and the
+# one gc.callbacks hook does O(1) bookkeeping.
+_MX08_GLOBAL_HOOKS = {
+    "sys.setprofile", "sys.settrace",
+    "threading.setprofile", "threading.settrace",
+    "tracemalloc.start",
+}
+_MX08_SAMPLING_HOOKS = {"sys._current_frames", "gc.callbacks.append"}
+_MX08_SANCTIONED_SUFFIX = "igaming_platform_tpu/obs/hostprof.py"
+# Raw-text gate: every hook's attribute tail. A file whose source never
+# mentions one of these cannot contain a hook call, so the rule skips
+# its tree walks entirely (the hooks are vanishingly rare — this keeps
+# a project-scope rule out of the <15s tier-1 analysis budget).
+_MX08_TEXT_HINTS = ("setprofile", "settrace", "tracemalloc",
+                    "_current_frames", "callbacks")
+
+
+def _mx08_may_contain(src: str) -> bool:
+    return any(hint in src for hint in _MX08_TEXT_HINTS)
+
+
+def _mx08_hook(node: ast.AST) -> str | None:
+    if not isinstance(node, ast.Call):
+        return None
+    dn = dotted_name(node.func)
+    if dn in _MX08_GLOBAL_HOOKS or dn in _MX08_SAMPLING_HOOKS:
+        return dn
+    return None
+
+
+@rule("MX08", "profiling-hook-placement",
+      "Profiling hooks never go on the scoring path. "
+      "sys.setprofile/settrace (and threading's) tax every bytecode "
+      "event process-wide; tracemalloc hooks the allocator; "
+      "sys._current_frames() snapshots all stacks under the GIL; "
+      "gc.callbacks run inside the collector's pause. Inside a jit root "
+      "they fire at trace time and measure compilation; inside a "
+      "registered hot loop they turn the per-batch path into a "
+      "profiler. Host profiling goes through obs/hostprof.py — the "
+      "registry-gated sampling thread (register_scoring_thread + "
+      "HOSTPROF_HZ) and its single GC callback — which is the one "
+      "production file sanctioned to own these hooks.",
+      scope="project")
+def profiling_hook_placement(project: ProjectContext):
+    from tools.analysis.jaxgraph import jax_graph
+
+    graph = jax_graph(project)
+    seen: set[tuple[str, int]] = set()
+
+    def fresh(ctx, lineno) -> bool:
+        key = (ctx.relpath, lineno)
+        if key in seen:
+            return False
+        seen.add(key)
+        return True
+
+    # (a) Hooks inside jit-traced code — wrong everywhere, including the
+    # sanctioned profiler module itself.
+    for info in graph.reachable.values():
+        if not _mx08_may_contain(info.ctx.src):
+            continue
+        for sub in ast.walk(info.node):
+            hook = _mx08_hook(sub)
+            if hook is not None and fresh(info.ctx, sub.lineno):
+                yield info.ctx, sub.lineno, (
+                    f"profiling hook {hook}() in jit-traced "
+                    f"`{info.qualname}` ({info.root_reason}) — it fires "
+                    "at trace time and measures compilation; sample from "
+                    "outside via obs/hostprof's scoring-thread registry")
+
+    for ctx in project.files:
+        if "igaming_platform_tpu" not in ctx.path.parts:
+            continue
+        if not _mx08_may_contain(ctx.src):
+            continue
+        registered = frozenset()
+        for suffix, quals in _HOT_LOOP_REGISTRY.items():
+            if ctx.relpath.endswith(suffix):
+                registered = quals
+                break
+        # (b) Hooks inside a hot-loop region (MX04's registry or the
+        # `# analysis: hot-loop` marker) — per-batch profiling inline in
+        # the loop, wrong even in obs/.
+        hot_hook_owner: dict[int, str] = {}
+        for qual, fn_node in _function_qualnames(ctx.tree):
+            if qual not in registered and not _has_hot_loop_marker(ctx, fn_node):
+                continue
+            for sub in ast.walk(fn_node):
+                if _mx08_hook(sub) is not None:
+                    hot_hook_owner.setdefault(id(sub), qual)
+        sanctioned = ctx.relpath.endswith(_MX08_SANCTIONED_SUFFIX)
+        for sub in ast.walk(ctx.tree):
+            hook = _mx08_hook(sub)
+            if hook is None:
+                continue
+            if id(sub) in hot_hook_owner:
+                if fresh(ctx, sub.lineno):
+                    yield ctx, sub.lineno, (
+                        f"profiling hook {hook}() in hot-loop "
+                        f"`{hot_hook_owner[id(sub)]}` — the per-batch "
+                        "path must not profile itself; the hostprof "
+                        "sampler thread observes it from outside")
+                continue
+            # (c) Placement outside jit/hot-loop: process-global hooks
+            # are banned in all production code; sampling/GC hooks are
+            # allowed only in the sanctioned observatory seam.
+            if hook in _MX08_GLOBAL_HOOKS:
+                if fresh(ctx, sub.lineno):
+                    yield ctx, sub.lineno, (
+                        f"process-global profiling hook {hook}() in "
+                        "production code — it taxes every call/alloc "
+                        "event process-wide; use the registry-gated "
+                        "sampler (obs/hostprof.py, HOSTPROF_HZ)")
+            elif not sanctioned:
+                if fresh(ctx, sub.lineno):
+                    yield ctx, sub.lineno, (
+                        f"{hook}() outside the sanctioned profiler seam "
+                        "— stack snapshots and GC callbacks belong to "
+                        "obs/hostprof.py (register_scoring_thread + "
+                        "HostProfiler), not ad hoc in production code")
