@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-fast lint lint-json lint-changed lint-sarif lint-update-baseline bench bench-all bench-fused bench-mesh bench-hostprof bench-trend bench-paced bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos soak-chaos-ledger soak-slo soak-online soak-drift soak-session soak-deadline replay-verify fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
+.PHONY: all test test-fast lint lint-json lint-changed lint-sarif lint-update-baseline ci-static bench bench-all bench-fused bench-mesh bench-hostprof bench-trend bench-paced bench-replicas drill eval native proto run-risk run-wallet dryrun clean soak soak-wire soak-chaos soak-fleet-chaos soak-chaos-ledger soak-slo soak-online soak-drift soak-session soak-deadline replay-verify fleet api-test migrate-up migrate-down migrate-status seed docker-build docker-push infra-up infra-down
 
 all: native test
 
@@ -36,6 +36,16 @@ lint-sarif:
 
 lint-update-baseline:
 	$(PY) -m tools.analysis --update-baseline
+
+# The one static gate CI calls: SARIF analyzer pass (analysis.sarif is
+# the upload artifact for inline annotation; the exit code fails the
+# target on any non-baselined finding) THEN the perf-trajectory gate
+# (tools/benchtrend.py --gate: regressions over the committed
+# *_rNN.json series are fatal). Ordered so code findings surface before
+# perf flags; either failing fails the target.
+ci-static:
+	$(PY) -m tools.analysis --format=sarif > analysis.sarif
+	$(PY) tools/benchtrend.py --gate
 
 # Headline benchmark (driver contract: one JSON line) — real device.
 bench:

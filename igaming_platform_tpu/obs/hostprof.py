@@ -212,12 +212,15 @@ class StackSampler:
         with self._lock:
             return dict(self._folded)
 
-    def top_stacks(self, n: int = 20) -> list[dict]:
-        folded = self.folded()
+    @staticmethod
+    def _rank(folded: dict[str, int], n: int) -> list[dict]:
         total = sum(folded.values()) or 1
         ranked = sorted(folded.items(), key=lambda kv: kv[1], reverse=True)
         return [{"stack": k, "samples": v, "share": round(v / total, 4)}
                 for k, v in ranked[:n]]
+
+    def top_stacks(self, n: int = 20) -> list[dict]:
+        return self._rank(self.folded(), n)
 
     def to_folded_text(self) -> str:
         """Classic collapsed-stack format (``stack count`` per line) —
@@ -260,17 +263,25 @@ class StackSampler:
         }
 
     def snapshot(self) -> dict:
+        # One lock hold for ALL sampler-written state: samples_total /
+        # threads_seen are mutated by the hostprof-sampler thread under
+        # _lock, and iterating threads_seen unlocked can raise
+        # "set changed size during iteration" mid-sample. Ranking runs
+        # on the copies outside the lock (top_stacks re-acquires it).
         with self._lock:
-            distinct = len(self._folded)
+            folded = dict(self._folded)
+            samples_total = self.samples_total
+            roles_seen = sorted(self.threads_seen)
+            last_duration_s = self.last_duration_s
         return {
             "running": self.running,
             "hz": self.hz,
-            "samples_total": self.samples_total,
-            "distinct_stacks": distinct,
-            "roles_seen": sorted(self.threads_seen),
+            "samples_total": samples_total,
+            "distinct_stacks": len(folded),
+            "roles_seen": roles_seen,
             "registered_threads": len(registered_threads()),
-            "last_duration_s": round(self.last_duration_s, 3),
-            "top_stacks": self.top_stacks(20),
+            "last_duration_s": round(last_duration_s, 3),
+            "top_stacks": self._rank(folded, 20),
         }
 
 

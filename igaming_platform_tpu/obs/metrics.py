@@ -156,7 +156,10 @@ class Histogram:
             return float("inf")
 
     def count(self, **labels: str) -> int:
-        return self._totals.get(_label_key(labels), 0)
+        # Same discipline as percentile()/render(): _totals is written
+        # under _lock from scorer threads, so read it under _lock too.
+        with self._lock:
+            return self._totals.get(_label_key(labels), 0)
 
     @staticmethod
     def _exemplar_suffix(ex: tuple[str, float, float] | None) -> str:

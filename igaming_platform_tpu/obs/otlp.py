@@ -103,6 +103,11 @@ class OtlpExporter:
         # <service>_otlp_export_failures_total counter so export loss is
         # on /metrics, not only in logs.
         self.on_failure = None  # callable(n_failed_batches: int) | None
+        # flush() runs on BOTH the exporter thread (_run) and the
+        # caller's thread (stop()'s final drain, manual flushes); the
+        # counter read-modify-writes need a guard or two concurrent
+        # flushes lose updates.
+        self._stats_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -134,7 +139,8 @@ class OtlpExporter:
             with urllib.request.urlopen(req, timeout=self.timeout_s):
                 pass
         except (urllib.error.URLError, OSError) as exc:
-            self.failed_batches += 1
+            with self._stats_lock:
+                self.failed_batches += 1
             if self.on_failure is not None:
                 try:
                     self.on_failure(1)
@@ -142,7 +148,8 @@ class OtlpExporter:
                     pass
             logger.warning("OTLP export failed (%d spans dropped): %s", len(spans), exc)
             return 0
-        self.exported_total += len(spans)
+        with self._stats_lock:
+            self.exported_total += len(spans)
         return len(spans)
 
     def _run(self) -> None:

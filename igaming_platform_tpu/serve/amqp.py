@@ -139,7 +139,7 @@ class _Reader:
 
     def u8(self) -> int:
         v = self.buf[self.pos]
-        self.pos += 1
+        self.pos += 1  # analysis: single-writer — per-frame parse cursor; a _Reader never crosses threads
         return v
 
     def u16(self) -> int:
@@ -240,7 +240,7 @@ class AmqpConnection:
                 raise AmqpConnectionClosed(f"recv failed: {exc}") from exc
             if not chunk:
                 raise AmqpConnectionClosed("connection closed by peer")
-            self._recv_buf += chunk
+            self._recv_buf += chunk  # analysis: single-writer — phased ownership: main reads during the handshake, only the consume loop after
         out, self._recv_buf = self._recv_buf[:n], self._recv_buf[n:]
         return out
 

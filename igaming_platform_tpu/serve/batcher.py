@@ -106,7 +106,7 @@ class CollectorPipeline:
             try:
                 self._process(item)
             except BaseException as exc:  # noqa: BLE001 — re-raised in put/close
-                self._errors.append(exc)
+                self._errors.append(exc)  # noqa: CC10 — append-only poison list: list.append is GIL-atomic and readers only check truthiness/[0]
 
     def put(self, item: Any) -> None:
         """Enqueue; blocks at depth (backpressure). Raises the collector's
@@ -329,7 +329,7 @@ class ContinuousBatcher:
                     try:
                         results = self._runner([it.payload for it in items])
                         if attempt:
-                            self.batches_replayed += 1
+                            self.batches_replayed += 1  # analysis: single-writer — one writer per config: inline here without a pipeline, else the collector in _finalize_batch
                         exc = None
                         break
                     except Exception as e:  # noqa: BLE001 — retry then propagate

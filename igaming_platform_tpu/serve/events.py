@@ -301,7 +301,7 @@ class Consumer:
             self._redelivery.pop(event.id, None)
         except Exception:  # noqa: BLE001 — handler failure => nack+requeue
             count = self._redelivery.get(event.id, 0) + 1
-            self._redelivery[event.id] = count
+            self._redelivery[event.id] = count  # analysis: single-writer — keyed by event id: an id is in flight on exactly one consumer thread at a time
             if count <= self.max_redelivery:
                 self.broker.requeue(qname, raw)
             else:

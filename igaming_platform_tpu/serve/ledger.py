@@ -1201,7 +1201,7 @@ class DecisionLedger:
             return {"seq": int(cur["seq"]), "offset": int(cur["offset"]),
                     "count": int(cur["count"])}
         except (OSError, ValueError, KeyError):  # noqa: CC04 — a missing/corrupt cursor file is the expected cold start: drain from the WAL head
-            return {"seq": self._segments[0][0] if self._segments else 0,
+            return {"seq": self._segments[0][0] if self._segments else 0,  # noqa: CC10 — runs in __init__ only, before the ledger-sink thread spawns
                     "offset": len(SEGMENT_MAGIC), "count": 0}
 
     def _persist_cursor(self) -> None:

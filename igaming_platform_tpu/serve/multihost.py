@@ -149,7 +149,7 @@ class _Reader:
             chunk = self._sock.recv(1 << 20)
             if not chunk:
                 raise ConnectionError("work channel closed")
-            self._buf += chunk
+            self._buf += chunk  # analysis: single-writer — per-connection read cursor; each _Reader lives on one worker thread
         out, self._buf = self._buf[:n], self._buf[n:]
         return out
 

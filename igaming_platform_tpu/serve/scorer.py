@@ -909,7 +909,7 @@ class TPUScoringEngine:
                         np.int32(0))
                     jax.block_until_ready(res[0])
                     mgr.adopt(res[1], res[2], res[3])
-        self._fused_ready.add((family, sketch, shadow))
+        self._fused_ready.add((family, sketch, shadow))  # noqa: CC10 — publish-once GIL-atomic set: each key added by exactly one warm thread, after every shape compiled
         return ffn
 
     def _select_fused(self, family: str):
@@ -928,9 +928,9 @@ class TPUScoringEngine:
             sstate = shadow.active_state()
             if (sstate is not None
                     and (family, sketch, True) in self._fused_ready):
-                return self._fused_fns[(family, sketch, True)], sketch, sstate
+                return self._fused_fns[(family, sketch, True)], sketch, sstate  # noqa: CC10 — lock-free launch path: keys are publish-once under _fused_lock, read only after _fused_ready
         if sketch and (family, True, False) in self._fused_ready:
-            return self._fused_fns[(family, True, False)], True, None
+            return self._fused_fns[(family, True, False)], True, None  # noqa: CC10 — lock-free launch path: keys are publish-once under _fused_lock, read only after _fused_ready
         return None
 
     def _on_shadow_candidate(self, shadow) -> None:

@@ -142,13 +142,22 @@ class ShadowScorer:
 
     def _try_enqueue(self, item: tuple, n: int) -> bool:
         """Bounded O(1) enqueue shared by every submit flavor: full
-        queue / stopped / no candidate drops (counted), never blocks."""
+        queue / stopped / no candidate drops (counted), never blocks.
+
+        The generation tag (item[1]) is stamped HERE, under ``_cv``,
+        when the caller passes None: reading ``self._generation``
+        outside the lock raced ``set_candidate`` on the online-loop
+        thread, and tagging under the same lock hold that checks
+        ``_candidate`` ties the tag to the candidate actually present
+        at enqueue time."""
         with self._cv:
             if (self._stopping or self._candidate is None
                     or self._pending_rows + n > self.queue_max_rows):
                 self.rows_dropped += n
                 dropped = True
             else:
+                if item[1] is None:
+                    item = (item[0], self._generation) + item[2:]
                 self._pending.append(item)
                 self._pending_rows += n
                 dropped = False
@@ -171,7 +180,7 @@ class ShadowScorer:
                 return False
             thresholds = np.asarray(self._engine._thresholds, dtype=np.int32)
             return self._try_enqueue(
-                ("xhost", self._generation, out, x, bl, n, thresholds), n)
+                ("xhost", None, out, x, bl, n, thresholds), n)
         except Exception:  # noqa: CC04 — the shadow must never fail scoring; drops are visible in its own report
             logger.warning("shadow submit failed", exc_info=True)
             return False
@@ -184,8 +193,7 @@ class ShadowScorer:
         diffs. O(1); never raises."""
         try:
             return self._try_enqueue(
-                ("scored", self._generation if gen is None else gen,
-                 prod_out, cand_out, n), n)
+                ("scored", gen, prod_out, cand_out, n), n)
         except Exception:  # noqa: CC04 — the shadow must never fail scoring; drops are visible in its own report
             logger.warning("shadow submit_scored failed", exc_info=True)
             return False
@@ -201,7 +209,7 @@ class ShadowScorer:
         raises."""
         try:
             taken = self._try_enqueue(
-                ("echo", self._generation, prod_out, echo, blp, n,
+                ("echo", None, prod_out, echo, blp, n,
                  thresholds, hold), n)
             return taken
         except Exception:  # noqa: CC04 — the shadow must never fail scoring; drops are visible in its own report

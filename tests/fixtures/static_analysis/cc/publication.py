@@ -1,0 +1,112 @@
+"""Seeded CC11 violations: unsafe publication across thread starts.
+
+Two shapes: check-then-act lazy init outside any lock in a function two
+roles may run (both threads see the unset value and both initialize),
+and an attribute first assigned AFTER the thread that reads it has
+started. The compliant siblings are the double-checked-locking form and
+publish-before-start.
+"""
+
+import threading
+
+
+class LazyTable:
+    """Lazy init with no lock: the refresh thread and callers both run
+    ``resolve_rule`` and can both build the table."""
+
+    def __init__(self):
+        self._thread = None
+        self._table = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._refresh, name="table-refresh", daemon=True)
+        self._thread.start()
+
+    def _refresh(self):
+        self.resolve_rule("refresh")
+
+    def resolve_rule(self, key):
+        if self._table is None:  # expect: CC11
+            self._table = self._build()
+        return self._table.get(key)
+
+    def _build(self):
+        return {}
+
+
+def serve_rule_request(table, key):
+    """Caller-thread entry: gives ``resolve_rule`` its second role."""
+    return table.resolve_rule(key)
+
+
+class PublishAfterStart:
+    """``batch_size`` is assigned after the drain thread — which reads
+    it — has already started."""
+
+    def __init__(self):
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._drain, name="drain-loop", daemon=True)
+        self._thread.start()
+        self.batch_size = 64  # expect: CC11
+
+    def _drain(self):
+        return self.batch_size
+
+
+# ---------------------------------------------------------------------------
+# Compliant siblings.
+
+
+class DoubleChecked:
+    """The whole check-and-assign runs under the lock: quiet."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = None
+        self._cache = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._warm, name="cache-warm", daemon=True)
+        self._thread.start()
+
+    def _warm(self):
+        self.lookup_cached("warm")
+
+    def lookup_cached(self, key):
+        cached = self._cache
+        if cached is None:
+            with self._lock:
+                if self._cache is None:
+                    self._cache = self._build_cache()
+                cached = self._cache
+        return cached.get(key)
+
+    def _build_cache(self):
+        return {}
+
+
+def serve_cache_request(cache, key):
+    """Caller-thread entry: ``lookup_cached`` runs on two roles too."""
+    return cache.lookup_cached(key)
+
+
+class PublishBeforeStart:
+    """Everything the reader needs is assigned before ``.start()``."""
+
+    def __init__(self):
+        self._thread = None
+        self.window = 32
+
+    def start(self):
+        self.window = 64
+        self._thread = threading.Thread(
+            target=self._tick, name="window-loop", daemon=True)
+        self._thread.start()
+
+    def _tick(self):
+        return self.window

@@ -1,0 +1,204 @@
+"""Seeded CC10 violations: shared state written from two thread roles.
+
+The racy shapes: a counter bumped by both a spawned loop and callers
+with no common lock (write-write), a guarded counter read outside the
+writers' lock (unlocked read), a module global mutated by a ticker and
+callers, and a callback handed through a queue to a consumer thread
+(the hand-off edge). The compliant siblings cover every quiet idiom:
+locked on both sides, single-role state, ``__init__``-before-spawn
+publication, the atomic-swap rebind, and an annotated single-writer.
+"""
+
+import queue
+import threading
+
+
+class TelemetryAggregator:
+    """Write-write race: the flush loop and callers both bump ``events``
+    with no lock anywhere."""
+
+    def __init__(self):
+        self._thread = None
+        self.events = 0
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._flush_loop, name="telemetry-flush", daemon=True)
+        self._thread.start()
+
+    def _flush_loop(self):
+        self.events += 1  # expect: CC10
+
+    def record(self):
+        self.events += 1
+
+
+class GuardedStats:
+    """Unlocked read: every write holds ``_lock`` but ``snapshot`` reads
+    outside it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = None
+        self.rows = 0
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._stats_loop, name="stats-worker", daemon=True)
+        self._thread.start()
+
+    def _stats_loop(self):
+        with self._lock:
+            self.rows += 1
+
+    def bump(self):
+        with self._lock:
+            self.rows += 1
+
+    def snapshot(self):
+        return self.rows  # expect: CC10
+
+
+class HandoffPipeline:
+    """Hand-off edge: ``_on_flush`` rides the queue to the drain thread,
+    so it races ``flush_now`` on the caller thread."""
+
+    def __init__(self):
+        self._q = queue.Queue()
+        self._thread = None
+        self.flushed = 0
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._drain_queue, name="handoff-drain", daemon=True)
+        self._thread.start()
+
+    def _drain_queue(self):
+        fn = self._q.get()
+        fn()
+
+    def schedule_flush(self):
+        self._q.put(self._on_flush)
+
+    def _on_flush(self):
+        self.flushed += 1  # expect: CC10
+
+    def flush_now(self):
+        self.flushed += 1
+
+
+sampler_ticks = 0
+
+
+def _ticker_loop():
+    global sampler_ticks
+    sampler_ticks += 1  # expect: CC10
+
+
+def start_ticker():
+    t = threading.Timer(5.0, _ticker_loop)
+    t.start()
+    return t
+
+
+def bump_ticks():
+    global sampler_ticks
+    sampler_ticks += 1
+
+
+# ---------------------------------------------------------------------------
+# Compliant siblings: every quiet idiom the rule must respect.
+
+
+class LockedCounter:
+    """Both roles write under the same lock; reads hold it too."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = None
+        self.total = 0
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._count_loop, name="locked-counter", daemon=True)
+        self._thread.start()
+
+    def _count_loop(self):
+        with self._lock:
+            self.total += 1
+
+    def add(self):
+        with self._lock:
+            self.total += 1
+
+    def value(self):
+        with self._lock:
+            return self.total
+
+
+class WorkerOnly:
+    """Single-role state: only the spawned worker ever writes."""
+
+    def __init__(self):
+        self._thread = None
+        self.processed = 0
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._work, name="worker-only", daemon=True)
+        self._thread.start()
+
+    def _work(self):
+        self.processed += 1
+
+
+class InitPublished:
+    """``__init__``-before-spawn publication: the loop only reads what
+    the constructor wrote before the thread existed."""
+
+    def __init__(self):
+        self.limit = 128
+        self._thread = threading.Thread(
+            target=self._limit_loop, name="limit-loop", daemon=True)
+        self._thread.start()
+
+    def _limit_loop(self):
+        return self.limit
+
+
+class SwapTable:
+    """Atomic swap: every mutation is a plain rebind of a fresh value."""
+
+    def __init__(self):
+        self._thread = None
+        self.table = {}
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._refresh_loop, name="swap-refresh", daemon=True)
+        self._thread.start()
+
+    def _refresh_loop(self):
+        self.table = {"refreshed": True}
+
+    def install(self, table):
+        self.table = dict(table)
+
+
+class AnnotatedCounter:
+    """Deliberate single-writer field, annotated at the write site."""
+
+    def __init__(self):
+        self._thread = None
+        self.ticks = 0
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._tick_loop, name="tick-loop", daemon=True)
+        self._thread.start()
+
+    def _tick_loop(self):
+        self.ticks += 1  # analysis: single-writer — only the tick loop writes after spawn
+
+    def reset_for_tests(self):
+        self.ticks = 0

@@ -1,0 +1,48 @@
+"""Seeded CC12 violations: role-contract drift over scoring seams.
+
+The module-literal ``ANALYSIS_ROLE_CONTRACT`` is the explicit-path-mode
+analog of ``REPO_CONFIG["role_contracts"]`` (the same dual-mode idiom as
+CC09's seam contracts). Seeded here: a caller role the contract does not
+allow, a contract entry naming a callee that no longer exists, and one
+naming a role no spawn site declares — both drift findings anchor at the
+contract assignment line.
+"""
+
+import threading
+
+ANALYSIS_ROLE_CONTRACT = {  # expect: CC12
+    # Only the ledger-writer role may append decisions.
+    "note_risk_decisions": ("risk-writer",),
+    # Drift: this seam was deleted long ago (unknown callee).
+    "vanished_seam": ("risk-writer",),
+    # Drift: no spawn site or thread_roles entry declares "ghost-role".
+    "note_audit_rows": ("ghost-role",),
+}
+
+
+def note_risk_decisions(rows):
+    return len(rows)
+
+
+def note_audit_rows(rows):
+    return len(rows)
+
+
+class RiskWriter:
+    """The allowed role: its loop calling the seam is compliant."""
+
+    def __init__(self):
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._write_loop, name="risk-writer", daemon=True)
+        self._thread.start()
+
+    def _write_loop(self):
+        note_risk_decisions([])
+
+
+def rogue_flush(rows):
+    """Runs on the caller thread — a role the contract does not allow."""
+    return note_risk_decisions(rows)  # expect: CC12

@@ -190,3 +190,32 @@ def test_gate_exit_codes(tmp_path, capsys):
     _write(clean, "E_r01.json", {"e2e_txns_per_sec": 100000.0})
     assert main([f"--root={clean}", "--gate"]) == 0
     capsys.readouterr()
+
+
+def test_waived_flags_do_not_trip_the_gate(tmp_path, capsys):
+    """TREND_WAIVERS.json absorbs accepted historical regressions: a
+    waived flag is still reported (tagged, with its reason in --json)
+    but only UNWAIVED flags make --gate fatal — and a waiver for one
+    metric never quiets a different series."""
+    _write(tmp_path, "F_r01.json",
+           {"e2e_txns_per_sec": 100000.0, "e2e_rpc_p99_ms": 10.0})
+    _write(tmp_path, "F_r02.json",
+           {"e2e_txns_per_sec": 40000.0, "e2e_rpc_p99_ms": 10.0})
+    _write(tmp_path, "TREND_WAIVERS.json",
+           [{"file": "F_r02.json", "metric": "flat_out_txns_per_sec",
+             "reason": "accepted in the r02 PR"}])
+    assert main([f"--root={tmp_path}", "--gate"]) == 0
+    assert "[waived]" in capsys.readouterr().out
+    # The waived flag is still in the machine output, reason attached.
+    assert main([f"--root={tmp_path}", "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert [f["waived"] for f in out["regressions"]] == [
+        "accepted in the r02 PR"]
+    # A NEW regression in an unwaived series still gates red.
+    _write(tmp_path, "F_r03.json",
+           {"e2e_txns_per_sec": 40000.0, "e2e_rpc_p99_ms": 30.0})
+    assert main([f"--root={tmp_path}", "--gate"]) == 1
+    capsys.readouterr()
+    # The repo's own waiver file covers exactly the committed flags.
+    assert main([f"--root={REPO}", "--gate"]) == 0
+    capsys.readouterr()

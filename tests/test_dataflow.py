@@ -459,6 +459,36 @@ def test_sarif_is_deterministic_and_wellformed(capsys):
     assert keys == sorted(keys)
 
 
+def test_race_rule_multisite_findings_are_deterministic(capsys):
+    """CC10/CC11/CC12 messages cite SEVERAL sites each (both write
+    sites, assign + start + target read, contract anchor) assembled
+    from set/dict-shaped graphs — two runs over the race fixtures must
+    render byte-identical, and each message must carry its second
+    site's file:line."""
+    cc = REPO / "tests" / "fixtures" / "static_analysis" / "cc"
+    cli_main([str(cc), "--format=json"])
+    first = json.loads(capsys.readouterr().out)
+    cli_main([str(cc), "--format=json"])
+    second = json.loads(capsys.readouterr().out)
+    for doc in (first, second):  # wall time is the one legitimate delta
+        doc.pop("elapsed_s", None)
+        doc.pop("rule_timings_ms", None)
+    assert first == second
+    findings = first["findings"]
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f["rule"], []).append(f)
+    ww = next(f for f in by_rule["CC10"]
+              if f["path"] == "races.py" and "TelemetryAggregator" in f["message"])
+    assert "races.py:30" in ww["message"] and "races.py:33" in ww["message"]
+    pub = next(f for f in by_rule["CC11"]
+               if "PublishAfterStart" in f["message"])
+    # assign site (finding line), start site, and the target's read site
+    assert "publication.py:53" in pub["message"]
+    assert "publication.py:57" in pub["message"]
+    assert any("rogue_flush" in f["message"] for f in by_rule["CC12"])
+
+
 # ---------------------------------------------------------------------------
 # Output ordering (the registration-order bugfix)
 

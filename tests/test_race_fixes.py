@@ -1,0 +1,172 @@
+"""Regression tests for the unguarded-shared-state fixes the CC10 race
+analyzer surfaced (PR 18):
+
+- ``StackSampler.snapshot`` read ``samples_total``/``threads_seen``
+  outside ``_lock`` while the sampler thread mutates them under it —
+  iterating the set mid-sample raises ``RuntimeError: set changed size
+  during iteration``;
+- ``OtlpExporter.flush`` bumped its counters with bare ``+=`` from both
+  the exporter thread and ``stop()``'s final drain (lost updates);
+- ``Histogram.count`` was the one ``_totals`` reader that skipped the
+  lock every writer holds;
+- ``ShadowScorer`` tagged enqueued batches with ``self._generation``
+  read OUTSIDE ``_cv``, racing ``set_candidate`` on the online-loop
+  thread — the tag is now stamped inside ``_try_enqueue``'s lock hold.
+
+The lock-discipline tests use instrumented primitives (a set that
+asserts the lock is held while iterated; a lock that counts
+acquisitions) so the race is checked deterministically, not
+probabilistically.
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.error
+from collections import deque
+
+from igaming_platform_tpu.obs import otlp as otlp_mod
+from igaming_platform_tpu.obs.hostprof import StackSampler
+from igaming_platform_tpu.obs.metrics import Histogram
+from igaming_platform_tpu.serve.shadow import ShadowScorer
+
+
+class _LockCheckedSet(set):
+    """Raises if iterated while the guarding lock is NOT held — the
+    deterministic stand-in for 'set changed size during iteration'."""
+
+    def __init__(self, lock: threading.Lock, items):
+        super().__init__(items)
+        self._guard = lock
+
+    def __iter__(self):
+        assert self._guard.locked(), (
+            "threads_seen iterated without StackSampler._lock held")
+        return super().__iter__()
+
+
+class _CountingLock:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.acquisitions = 0
+
+    def __enter__(self):
+        self._lock.acquire()
+        self.acquisitions += 1
+        return self
+
+    def __exit__(self, *exc):
+        self._lock.release()
+
+    def locked(self):
+        return self._lock.locked()
+
+
+def test_stack_sampler_snapshot_reads_under_lock():
+    sampler = StackSampler()
+    sampler.samples_total = 7
+    sampler.threads_seen = _LockCheckedSet(
+        sampler._lock, {"grpc-handler", "batcher"})
+    sampler._folded["grpc-handler;span:score;frame 42"] = 7
+    snap = sampler.snapshot()
+    assert snap["samples_total"] == 7
+    assert snap["roles_seen"] == ["batcher", "grpc-handler"]
+    assert snap["distinct_stacks"] == 1
+    assert snap["top_stacks"][0]["samples"] == 7
+
+
+def test_stack_sampler_top_stacks_unchanged_by_refactor():
+    sampler = StackSampler()
+    sampler._folded.update({"a;x": 3, "b;y": 1})
+    top = sampler.top_stacks(1)
+    assert top == [{"stack": "a;x", "samples": 3, "share": 0.75}]
+
+
+class _FakeCollector:
+    def __init__(self, spans):
+        self._spans = spans
+
+    def drain(self):
+        out, self._spans = self._spans, []
+        return out
+
+
+def _exporter(spans, monkeypatch, *, fail: bool):
+    exp = otlp_mod.OtlpExporter(
+        "http://jaeger:4318", "svc", collector=_FakeCollector(spans))
+    exp._stats_lock = _CountingLock()
+    monkeypatch.setattr(otlp_mod, "encode_spans", lambda s, n: {"n": len(s)})
+
+    class _Resp:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    def fake_urlopen(req, timeout=None):
+        if fail:
+            raise urllib.error.URLError("jaeger down")
+        return _Resp()
+
+    monkeypatch.setattr(otlp_mod.urllib.request, "urlopen", fake_urlopen)
+    return exp
+
+
+def test_otlp_flush_increments_export_counter_under_lock(monkeypatch):
+    exp = _exporter(["s1", "s2", "s3"], monkeypatch, fail=False)
+    assert exp.flush() == 3
+    assert exp.exported_total == 3
+    assert exp.failed_batches == 0
+    assert exp._stats_lock.acquisitions == 1
+
+
+def test_otlp_flush_increments_failure_counter_under_lock(monkeypatch):
+    exp = _exporter(["s1"], monkeypatch, fail=True)
+    assert exp.flush() == 0
+    assert exp.failed_batches == 1
+    assert exp.exported_total == 0
+    assert exp._stats_lock.acquisitions == 1
+
+
+def test_histogram_count_takes_the_writers_lock():
+    h = Histogram("t_ms", "test")
+    h.observe(1.0, route="a")
+    h._lock = _CountingLock()
+    assert h.count(route="a") == 1
+    assert h._lock.acquisitions == 1
+
+
+def _bare_shadow(generation: int) -> ShadowScorer:
+    """A ShadowScorer with only the enqueue-path state (the full ctor
+    compiles a jit program; _try_enqueue needs none of that)."""
+    s = ShadowScorer.__new__(ShadowScorer)
+    s._cv = threading.Condition()
+    s._pending = deque()
+    s._pending_rows = 0
+    s._stopping = False
+    s._candidate = object()
+    s.queue_max_rows = 1024
+    s.rows_dropped = 0
+    s._metrics = None
+    s._generation = generation
+    return s
+
+
+def test_shadow_enqueue_stamps_generation_under_cv():
+    s = _bare_shadow(5)
+    assert s._try_enqueue(("scored", None, "prod", "cand", 8), 8)
+    assert s._pending[-1][1] == 5
+    # A generation bump between building the item and enqueueing it can
+    # no longer produce a stale tag: the stamp happens inside the lock.
+    s._generation = 6
+    assert s._try_enqueue(("echo", None, "prod", "echo", None, 4, None, None), 4)
+    assert s._pending[-1][1] == 6
+
+
+def test_shadow_enqueue_preserves_explicit_generation_tag():
+    # submit_scored's fused path captures the generation WITH the params
+    # it actually used — an explicit tag must never be restamped.
+    s = _bare_shadow(9)
+    assert s._try_enqueue(("scored", 3, "prod", "cand", 8), 8)
+    assert s._pending[-1][1] == 3

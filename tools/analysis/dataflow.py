@@ -493,7 +493,7 @@ class DonationRegistry:
         return set(self.attr_donors) | {n for _, n in self.name_donors}
 
     def _scan(self, ctx: FileContext) -> None:
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
                 call = node.value
                 ctor = dotted_name(call.func)
@@ -537,7 +537,7 @@ class DonationRegistry:
             # when it is a function defined in the same file.
             tname = call.args[0].id if isinstance(call.args[0], ast.Name) else None
             if tname is not None:
-                for sub in ast.walk(ctx.tree):
+                for sub in ctx.walk():
                     if (isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
                             and sub.name == tname):
                         target_fn = sub
@@ -656,7 +656,7 @@ class CallGraph:
     def _index_imports(self, ctx: FileContext) -> None:
         froms: dict[str, tuple[str, str]] = {}
         aliases: dict[str, str] = {}
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if isinstance(node, ast.ImportFrom) and node.module:
                 for alias in node.names:
                     if alias.name != "*":

@@ -122,6 +122,31 @@ REPO_CONFIG = {
         ),
         "terminal_calls": ("encode_score_batch", "ScoreResponse"),
     },
+    # CC10-CC12 thread-role model (rules/races.py over threadroles.py).
+    # thread_roles: hand-offs static spawn discovery cannot see — the
+    # engine's dispatch/collect callbacks are injected into the batcher
+    # as plain callables, so the roles those threads lend them are
+    # declared here (same config-extension idiom as seam_contracts).
+    "thread_roles": {
+        "continuous-batcher": (
+            "igaming_platform_tpu/serve/scorer.py::TPUScoringEngine._dispatch_requests",
+        ),
+        "batch-collector": (
+            "igaming_platform_tpu/serve/scorer.py::TPUScoringEngine._collect_requests",
+        ),
+    },
+    # CC12 role contracts: which roles may call each scoring-path seam.
+    # A call from an undeclared role fails loudly (a thread quietly
+    # joined the scoring path); an entry naming a vanished role or
+    # callee fails as drift, like CC09's seam table.
+    "role_contracts": {
+        # Decisions enter the ledger from request threads and the two
+        # batcher-side callback roles declared above — nothing else.
+        "note_decisions": ("main", "continuous-batcher", "batch-collector"),
+        # The sampler registry is read by the hostprof sampler and by
+        # snapshot()/export endpoints on caller threads only.
+        "registered_threads": ("main", "hostprof-sampler"),
+    },
 }
 
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
@@ -135,6 +160,10 @@ class Report:
     stale: list[dict]
     syntax_errors: list[Finding]
     elapsed_s: float = 0.0
+    # Per-rule wall time (ms). Shared graphs are cached, so their build
+    # cost lands on whichever rule touches them first — attribution,
+    # not isolated cost (see engine.run_rules).
+    rule_timings_ms: dict[str, float] = field(default_factory=dict)
 
     @property
     def failed(self) -> bool:
@@ -231,7 +260,9 @@ def run_analysis(paths: list[Path] | None = None,
     if no_baseline:
         entries = []
     project, syntax_errors = build_project(discovery, cfg)
-    findings = run_rules(project, file_rule_paths=changed_only)
+    rule_timings: dict[str, float] = {}
+    findings = run_rules(project, file_rule_paths=changed_only,
+                         rule_timings=rule_timings)
     if changed_only is not None:
         findings = [f for f in findings if f.path in changed_only]
         syntax_errors = [f for f in syntax_errors if f.path in changed_only]
@@ -243,7 +274,9 @@ def run_analysis(paths: list[Path] | None = None,
         baselined=matched.baselined,
         stale=[] if changed_only is not None else matched.stale,
         syntax_errors=syntax_errors,
-        elapsed_s=time.perf_counter() - t0)
+        elapsed_s=time.perf_counter() - t0,
+        rule_timings_ms={rid: round(s * 1000, 2)
+                         for rid, s in sorted(rule_timings.items())})
 
 
 def changed_files(ref: str | None = None) -> set[str]:
@@ -302,6 +335,7 @@ def _render_json(report: Report) -> str:
     return json.dumps({
         "files": report.files,
         "elapsed_s": round(report.elapsed_s, 3),
+        "rule_timings_ms": report.rule_timings_ms,
         "findings": [f.to_json() for f in sorted(
             report.syntax_errors + report.new, key=_finding_order)],
         "baselined": [f.to_json() for f in sorted(

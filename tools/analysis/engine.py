@@ -81,6 +81,10 @@ def parse_suppressions(src: str) -> tuple[dict[int, frozenset[str] | None], set[
 
     suppressions: dict[int, frozenset[str] | None] = {}
     bare: set[int] = set()
+    if "noqa" not in src:
+        # Tokenizing every file cost more than every rule combined;
+        # without the substring no COMMENT can match.
+        return suppressions, bare
     try:
         tokens = list(tokenize.generate_tokens(io.StringIO(src).readline))
     except (tokenize.TokenError, IndentationError, SyntaxError):
@@ -120,6 +124,39 @@ class FileContext:
     suppressions: dict[int, frozenset[str] | None]
     bare_noqa_lines: set[int]
 
+    def walk(self, node: ast.AST | None = None) -> tuple[ast.AST, ...]:
+        """Flat pre-order node list, computed once per (sub)tree per run.
+
+        ``ast.walk`` re-traverses the tree on every call; with ~20 rules
+        each sweeping every file that traversal dominated the run. A
+        cached flat tuple turns each sweep into a plain list iteration.
+        With ``node`` given, the same cache covers a subtree — rules
+        walking the same function body repeatedly (JX06, the MX family,
+        CC10) hit the cache after the first pass. Keying by ``id`` is
+        sound because this context owns ``tree`` and keeps every node
+        alive for its own lifetime."""
+        if node is None:
+            nodes = self.__dict__.get("_nodes")
+            if nodes is None:
+                nodes = tuple(ast.walk(self.tree))
+                self.__dict__["_nodes"] = nodes
+            return nodes
+        cache = self.__dict__.get("_subtree_nodes")
+        if cache is None:
+            cache = self.__dict__["_subtree_nodes"] = {}
+        nodes = cache.get(id(node))
+        if nodes is None:
+            nodes = cache[id(node)] = tuple(ast.walk(node))
+        return nodes
+
+    def lines(self) -> list[str]:
+        """``src.splitlines()``, computed once — marker scans are per
+        function, and re-splitting the file for each was measurable."""
+        lines = self.__dict__.get("_lines")
+        if lines is None:
+            lines = self.__dict__["_lines"] = self.src.splitlines()
+        return lines
+
     def is_suppressed(self, rule: "Rule", line: int) -> bool:
         codes = self.suppressions.get(line, ...)
         if codes is ...:
@@ -149,15 +186,23 @@ class ProjectContext:
 
     def resolve_module(self, dotted: str) -> FileContext | None:
         """Resolve an imported dotted path to an in-project file, tolerant
-        of the scan root not being the package root (suffix match)."""
+        of the scan root not being the package root (suffix match).
+
+        Memoized: call-graph construction resolves the same few dotted
+        paths thousands of times, and the miss path is a linear scan."""
+        cache = self.caches.setdefault("_resolve_module", {})
+        if dotted in cache:
+            return cache[dotted]
         mods = self.by_module()
-        if dotted in mods:
-            return mods[dotted]
-        suffix = "." + dotted
-        for name, ctx in mods.items():
-            if name.endswith(suffix) or ("." + name).endswith(suffix):
-                return ctx
-        return None
+        result = mods.get(dotted)
+        if result is None:
+            suffix = "." + dotted
+            for name, ctx in mods.items():
+                if name.endswith(suffix) or ("." + name).endswith(suffix):
+                    result = ctx
+                    break
+        cache[dotted] = result
+        return result
 
 
 # ---------------------------------------------------------------------------
@@ -202,16 +247,27 @@ def rule(id: str, name: str, rationale: str, scope: str = "file",
 
 
 def run_rules(project: ProjectContext,
-              file_rule_paths: set[str] | None = None) -> list[Finding]:
+              file_rule_paths: set[str] | None = None,
+              rule_timings: dict[str, float] | None = None) -> list[Finding]:
     """Run every registered rule; returns non-suppressed findings in a
     TOTAL order — (path, line, rule, message) — so output never depends
     on rule registration order (the PR 13 ordering bugfix).
 
     ``file_rule_paths`` (incremental mode) restricts file-scoped rules
     to those relpaths; project-scoped rules always see the whole parse
-    forest (their graphs must stay complete to be sound)."""
+    forest (their graphs must stay complete to be sound).
+
+    ``rule_timings`` (optional, rule id -> seconds) records per-rule
+    wall time so the next rule author can see what each check costs.
+    Shared graphs (lock graph, call graph, role graph) are built lazily
+    and cached in ``project.caches``, so their construction cost lands
+    on whichever rule touches them FIRST in registration order — read
+    the table as attribution, not as isolated cost."""
+    import time
+
     findings: list[Finding] = []
     for r in RULES.values():
+        t0 = time.perf_counter()
         if r.scope == "file":
             for ctx in project.files:
                 if (file_rule_paths is not None
@@ -224,6 +280,9 @@ def run_rules(project: ProjectContext,
             for ctx, line, msg in r.check(project):
                 if not ctx.is_suppressed(r, line):
                     findings.append(Finding(r.id, ctx.relpath, line, msg))
+        if rule_timings is not None:
+            rule_timings[r.id] = (
+                rule_timings.get(r.id, 0.0) + time.perf_counter() - t0)
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return findings
 

@@ -157,7 +157,7 @@ class JaxGraph:
 
         visit(ctx.tree, "")
         del parents
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
                 for alias in node.names:
                     if alias.name != "*":
@@ -178,7 +178,7 @@ class JaxGraph:
 
     def _find_roots(self, ctx: FileContext) -> None:
         idx = self._index[ctx.relpath]
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 for dec in node.decorator_list:
                     static: set[str] = set()

@@ -37,6 +37,25 @@ _EVENT_CTORS = {"Event"}
 # every entry stalls the calling thread on an external event for an
 # unbounded/configured time while other threads pile up on the lock.
 _SLEEP_DOTTED = {"time.sleep"}
+
+# In-place container mutators: `self.x.append(v)` is a compound mutation
+# of `x` (CC10 input), unlike the atomic rebind `self.x = fresh`.
+_MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "add", "update", "insert",
+    "setdefault", "pop", "popitem", "popleft", "remove", "discard",
+    "clear",
+}
+
+
+def _mentions_self_attr(expr: ast.AST, attr: str) -> bool:
+    """True when ``expr`` reads ``self.<attr>`` — `self.x = self.x + 1`
+    is a compound read-modify-write, not an atomic swap."""
+    for sub in ast.walk(expr):
+        if (isinstance(sub, ast.Attribute) and sub.attr == attr
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id == "self"):
+            return True
+    return False
 _SOCKET_METHODS = {"recv", "recv_into", "accept", "connect", "sendall",
                    "makefile"}
 _FUTURE_METHODS = {"result"}
@@ -89,6 +108,18 @@ class _FuncRecord:
     # (kind: self|name|attr, name, line, held lock ids)
     blocking: list[tuple[int, str, frozenset[str]]] = field(default_factory=list)
     writes: list[tuple[str, int, frozenset[str]]] = field(default_factory=list)
+    # CC10 substrate (PR 18). `writes` above is CC03's input (own-class
+    # lock ids only) and keeps its exact shape; the race detector needs
+    # more: every self-attribute READ, every MUTATION (assign, augment,
+    # subscript store, mutator-method call) with the FULL held-lock-id
+    # set (module locks included), and whether the mutation is compound
+    # (read-modify-write — an atomic rebind `self.x = fresh` is not).
+    reads: list[tuple[str, int, frozenset[str]]] = field(default_factory=list)
+    mutations: list[tuple[str, int, frozenset[str], bool]] = field(
+        default_factory=list)  # (attr, line, held ids, compound)
+    global_writes: list[tuple[str, int, frozenset[str], bool]] = field(
+        default_factory=list)  # module-global name writes under `global`
+    global_decls: set[str] = field(default_factory=set)
 
 
 @dataclass
@@ -152,7 +183,7 @@ class LockGraph:
                         self.locks[lock.id] = lock
         self.module_locks[ctx.relpath] = mod_locks
         imports: dict[str, tuple[str, str]] = {}
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if isinstance(node, ast.ImportFrom) and node.module:
                 for alias in node.names:
                     if alias.name != "*":
@@ -160,7 +191,7 @@ class LockGraph:
                             node.module, alias.name)
         self._from_imports[ctx.relpath] = imports
 
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not isinstance(node, ast.ClassDef):
                 continue
             rec = _ClassRecord(node.name, ctx, node)
@@ -325,29 +356,76 @@ class LockGraph:
             self._walk_block(stmt.orelse, rec, held, cls, mod_locks)
             self._walk_block(stmt.finalbody, rec, held, cls, mod_locks)
             return
-        # Attribute writes (CC03 input).
+        if isinstance(stmt, ast.Global):
+            rec.global_decls.update(stmt.names)
+            return
+        # Attribute writes (CC03 input) + mutation sites (CC10 input).
         if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
             targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            held_all = frozenset(lk.id for lk in held)
+            value = getattr(stmt, "value", None)
             for t in targets:
                 if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
                         and t.value.id == "self" and cls is not None):
                     own = frozenset(lk.id for lk in held
                                     if lk.id in {l.id for l in cls.locks.values()})
                     rec.writes.append((t.attr, stmt.lineno, own))
-            value = getattr(stmt, "value", None)
+                    compound = (isinstance(stmt, ast.AugAssign)
+                                or (value is not None
+                                    and _mentions_self_attr(value, t.attr)))
+                    rec.mutations.append((t.attr, stmt.lineno, held_all, compound))
+                elif (isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Attribute)
+                        and isinstance(t.value.value, ast.Name)
+                        and t.value.value.id == "self" and cls is not None):
+                    # `self.x[k] = v` mutates the container in place.
+                    rec.mutations.append(
+                        (t.value.attr, stmt.lineno, held_all, True))
+                    self._scan_expr(t, rec, held, cls, mod_locks)
+                elif (isinstance(t, ast.Name)
+                        and t.id in rec.global_decls):
+                    rec.global_writes.append(
+                        (t.id, stmt.lineno, held_all,
+                         isinstance(stmt, ast.AugAssign)))
+                else:
+                    self._scan_expr(t, rec, held, cls, mod_locks)
             if value is not None:
                 self._scan_expr(value, rec, held, cls, mod_locks)
             return
-        # Everything else: scan contained expressions for calls.
-        for child in ast.walk(stmt):
-            if isinstance(child, ast.Call):
-                self._record_call(child, rec, held, cls, mod_locks)
+        # Everything else: scan contained expressions for calls and
+        # attribute reads (simple statements only — compound statements
+        # were all handled above, so this never crosses a block).
+        self._scan_expr(stmt, rec, held, cls, mod_locks)
 
     def _scan_expr(self, expr: ast.AST, rec: _FuncRecord,
                    held: list[LockDef], cls, mod_locks) -> None:
+        held_all = frozenset(lk.id for lk in held)
+        callee_exprs: set[int] = set()
+        pending_reads: list[tuple[str, int, int]] = []
         for child in ast.walk(expr):
             if isinstance(child, ast.Call):
+                callee_exprs.add(id(child.func))
                 self._record_call(child, rec, held, cls, mod_locks)
+                # Mutator-method call on a self attribute is a compound
+                # in-place mutation of the container (CC10 input).
+                fn = child.func
+                if (cls is not None and isinstance(fn, ast.Attribute)
+                        and fn.attr in _MUTATOR_METHODS
+                        and isinstance(fn.value, ast.Attribute)
+                        and isinstance(fn.value.value, ast.Name)
+                        and fn.value.value.id == "self"):
+                    rec.mutations.append(
+                        (fn.value.attr, child.lineno, held_all, True))
+            elif (cls is not None and isinstance(child, ast.Attribute)
+                    and isinstance(child.ctx, ast.Load)
+                    and isinstance(child.value, ast.Name)
+                    and child.value.id == "self"):
+                # Deferred: a `self.m(...)` callee Attribute may be
+                # walked before its Call parent registers it.
+                pending_reads.append((child.attr, child.lineno, id(child)))
+        for attr, line, node_id in pending_reads:
+            if node_id not in callee_exprs:
+                rec.reads.append((attr, line, held_all))
 
     def _record_call(self, call: ast.Call, rec: _FuncRecord,
                      held: list[LockDef], cls, mod_locks) -> None:

@@ -14,6 +14,7 @@ from tools.analysis.rules import jax_sharding as _jax_sharding  # noqa: PY01
 from tools.analysis.rules import locks as _locks  # noqa: PY01
 from tools.analysis.rules import metrics as _metrics  # noqa: PY01
 from tools.analysis.rules import paramswap as _paramswap  # noqa: PY01
+from tools.analysis.rules import races as _races  # noqa: PY01
 from tools.analysis.rules import replaydet as _replaydet  # noqa: PY01
 from tools.analysis.rules import robustness as _robustness  # noqa: PY01
 from tools.analysis.rules import seams as _seams  # noqa: PY01

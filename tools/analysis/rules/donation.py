@@ -174,7 +174,7 @@ def use_after_donate(project: ProjectContext):
 def _may_donate(fn_node: ast.AST, ctx: FileContext, reg) -> bool:
     """Cheap prefilter: only build a CFG when the function contains a
     donating call or an arena release."""
-    for sub in ast.walk(fn_node):
+    for sub in ctx.walk(fn_node):
         if not isinstance(sub, ast.Call):
             continue
         info = reg.lookup(sub, ctx.relpath)
@@ -337,7 +337,7 @@ def _per_candidate_retrace(project: ProjectContext):
         if rec.key[0] not in scoped:
             continue
         lines = []
-        for sub in ast.walk(rec.node):
+        for sub in rec.ctx.walk(rec.node):
             if (isinstance(sub, ast.Call)
                     and (name := dotted_name(sub.func)) is not None
                     and name.split(".")[-1] in _JIT_CTORS):
@@ -385,7 +385,7 @@ def _per_candidate_retrace(project: ProjectContext):
             fctx = ctx_by_path.get(frec.key[0])
             if fctx is None or frec.key[0] not in scoped:
                 continue
-            for sub in ast.walk(frec.node):
+            for sub in fctx.walk(frec.node):
                 if not (isinstance(sub, (ast.Assign, ast.AugAssign))
                         and isinstance(
                             getattr(sub, "targets", [None])[0]

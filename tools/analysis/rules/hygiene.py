@@ -27,9 +27,9 @@ def _imported_names(node: ast.AST):
                 yield name, name, node.lineno
 
 
-def _used_names(tree: ast.Module) -> set[str]:
+def _used_names(ctx) -> set[str]:
     used: set[str] = set()
-    for node in ast.walk(tree):
+    for node in ctx.walk():
         if isinstance(node, ast.Name):
             used.add(node.id)
         elif isinstance(node, ast.Attribute):
@@ -65,7 +65,7 @@ def unused_import(ctx: FileContext):
     # exempt wholesale.
     if ctx.path.name == "__init__.py":
         return
-    used = _used_names(ctx.tree)
+    used = _used_names(ctx)
     exports = _exports(ctx.tree)
     for node in ctx.tree.body:
         for name, _key, lineno in _imported_names(node):
@@ -92,7 +92,7 @@ def duplicate_import(ctx: FileContext):
       "Exception (or narrower) instead.",
       aliases=("E722",))
 def bare_except(ctx: FileContext):
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if isinstance(node, ast.ExceptHandler) and node.type is None:
             yield node.lineno, "bare `except:`"
 
@@ -102,7 +102,7 @@ def bare_except(ctx: FileContext):
       "checks must use ``is None``.",
       aliases=("E711",))
 def none_comparison(ctx: FileContext):
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if isinstance(node, ast.Compare):
             for op, comp in zip(node.ops, node.comparators):
                 if (isinstance(op, (ast.Eq, ast.NotEq))
@@ -116,7 +116,7 @@ def none_comparison(ctx: FileContext):
       "function; use None and construct inside.",
       aliases=("B006",))
 def mutable_default(ctx: FileContext):
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             for default in list(node.args.defaults) + [
                     d for d in node.args.kw_defaults if d is not None]:

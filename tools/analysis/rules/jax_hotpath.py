@@ -39,14 +39,14 @@ def _walk_scope(info: FuncInfo):
     body = info.node.body
     if isinstance(body, list):
         for stmt in body:
-            yield from ast.walk(stmt)
+            yield from info.ctx.walk(stmt)
     else:  # Lambda body is a single expression
-        yield from ast.walk(body)
+        yield from info.ctx.walk(body)
 
 
 def _from_time_imports(ctx) -> set[str]:
     names: set[str] = set()
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if isinstance(node, ast.ImportFrom) and node.module == "time":
             for alias in node.names:
                 if alias.name in _CLOCK_BARE | {"time"}:
@@ -66,7 +66,7 @@ def _each_reachable(project: ProjectContext):
     infos = list(graph.reachable.values())
     nested: set[int] = set()
     for info in infos:
-        for node in ast.walk(info.node):
+        for node in info.ctx.walk(info.node):
             if node is not info.node and id(node) in graph.reachable:
                 nested.add(id(node))
     for info in infos:

@@ -63,7 +63,7 @@ def _local_defs(ctx: FileContext) -> dict[str, ast.AST]:
     """name -> nearest def/lambda assignment in the file (jit targets
     resolve file-locally; a miss costs a finding, not a false one)."""
     out: dict[str, ast.AST] = {}
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             out.setdefault(node.name, node)
         elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Lambda):
@@ -133,7 +133,7 @@ def _captures(fn: ast.AST):
 def _jit_targets(ctx: FileContext, defs: dict[str, ast.AST]):
     """Every (wrapped function, jit site line) in the file: decorator
     and wrap-call forms, named defs and inline lambdas."""
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             for dec in node.decorator_list:
                 callee = dec.func if isinstance(dec, ast.Call) else dec

@@ -45,17 +45,24 @@ _NP_ALLOCATORS = {"zeros", "empty", "ones", "full", "zeros_like",
                   "empty_like", "ones_like", "ascontiguousarray"}
 
 
-def _scope_calls(body: list[ast.stmt]):
-    """Yield Call nodes in ``body`` WITHOUT descending into nested
-    function definitions (each function is its own timing scope)."""
-    stack = list(body)
-    while stack:
-        node = stack.pop()
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if isinstance(node, ast.Call):
-            yield node
-        stack.extend(ast.iter_child_nodes(node))
+def _calls_by_scope(tree: ast.Module) -> dict[int, list[ast.Call]]:
+    """Call nodes grouped by enclosing scope (module = ``id(tree)``,
+    else the innermost enclosing def) in ONE traversal — each function
+    is its own timing scope, so nested defs start a new group."""
+    scopes: dict[int, list[ast.Call]] = {id(tree): []}
+
+    def visit(node: ast.AST, scope: int) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.setdefault(id(child), [])
+                visit(child, id(child))
+                continue
+            if isinstance(child, ast.Call):
+                scopes[scope].append(child)
+            visit(child, scope)
+
+    visit(tree, id(tree))
+    return scopes
 
 
 @rule("MX01", "timed-block-until-ready",
@@ -66,14 +73,12 @@ def _scope_calls(body: list[ast.stmt]):
 def timed_block_until_ready(ctx: FileContext):
     if ctx.path.name == "perfmodel.py" and ctx.path.parent.name == "obs":
         return
-    scopes: list[list[ast.stmt]] = [ctx.tree.body]
-    for node in ast.walk(ctx.tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            scopes.append(node.body)
-    for body in scopes:
+    if "block_until_ready" not in ctx.src:
+        return  # cheap text prescreen before the scope traversal
+    for calls in _calls_by_scope(ctx.tree).values():
         clock_lines: list[int] = []
         bur_lines: list[int] = []
-        for call in _scope_calls(body):
+        for call in calls:
             name = call_name(call)
             if name in _CLOCK_CALLS:
                 clock_lines.append(call.lineno)
@@ -112,7 +117,7 @@ def _help_argument(node: ast.Call) -> ast.AST | None:
 def metric_help_text(ctx: FileContext):
     if ctx.path.name == "metrics.py" and ctx.path.parent.name == "obs":
         return
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if not isinstance(node, ast.Call):
             continue
         fn = node.func
@@ -132,12 +137,27 @@ def metric_help_text(ctx: FileContext):
                 "description so the series is readable on /metrics")
 
 
-def _function_qualnames(tree: ast.Module):
-    """Yield (qualname, FunctionDef) for every function, with class
-    nesting reflected dotted (`Cls.method`, `Cls.method.inner`)."""
+def _function_qualnames(ctx: FileContext):
+    """(qualname, FunctionDef) for every function, with class nesting
+    reflected dotted (`Cls.method`, `Cls.method.inner`) — computed once
+    per file (MX04 and MX08 both consume it)."""
+    cached = ctx.__dict__.get("_func_quals")
+    if cached is not None:
+        return cached
+
+    # Defs only ever appear in statement positions, so descend through
+    # statement-body fields and skip expression subtrees entirely — the
+    # bulk of the node count.
+    def child_stmts(node):
+        for name in ("body", "orelse", "finalbody"):
+            yield from getattr(node, name, ())
+        for handler in getattr(node, "handlers", ()):
+            yield from handler.body
+        for case in getattr(node, "cases", ()):
+            yield from case.body
 
     def walk(node, prefix):
-        for child in ast.iter_child_nodes(node):
+        for child in child_stmts(node):
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 qual = f"{prefix}{child.name}"
                 yield qual, child
@@ -147,11 +167,13 @@ def _function_qualnames(tree: ast.Module):
             else:
                 yield from walk(child, prefix)
 
-    yield from walk(tree, "")
+    cached = tuple(walk(ctx.tree, ""))
+    ctx.__dict__["_func_quals"] = cached
+    return cached
 
 
 def _has_hot_loop_marker(ctx: FileContext, node: ast.AST) -> bool:
-    lines = ctx.src.splitlines()
+    lines = ctx.lines()
     for lineno in (node.lineno, node.lineno - 1):
         if 1 <= lineno <= len(lines) and _HOT_LOOP_MARKER in lines[lineno - 1]:
             return True
@@ -172,10 +194,10 @@ def hot_loop_alloc(ctx: FileContext):
         if ctx.relpath.endswith(suffix):
             registered = quals
             break
-    for qual, node in _function_qualnames(ctx.tree):
+    for qual, node in _function_qualnames(ctx):
         if qual not in registered and not _has_hot_loop_marker(ctx, node):
             continue
-        for sub in ast.walk(node):
+        for sub in ctx.walk(node):
             if not isinstance(sub, ast.Call):
                 continue
             fn = sub.func
@@ -226,7 +248,7 @@ def _unbounded_mention(node: ast.AST) -> str | None:
 def metric_label_cardinality(ctx: FileContext):
     if "igaming_platform_tpu" not in ctx.path.parts:
         return
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if not isinstance(node, ast.Call):
             continue
         fn = node.func
@@ -333,11 +355,13 @@ def wall_clock_deadline(ctx: FileContext):
     parts = ctx.path.parts
     if "igaming_platform_tpu" not in parts:
         return
+    if "time.time" not in ctx.src:
+        return  # the rule keys on time.time() only — cheap prescreen
     scope = next((s for s in _MX06_SCOPES if s in parts), None)
     if scope is None:
         return
     name_re = _MX06_SCOPES[scope]
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if not isinstance(node, ast.stmt):
             continue
         calls = [sub for sub in ast.walk(node)
@@ -381,7 +405,7 @@ def orphan_metric(ctx: FileContext):
     if "igaming_platform_tpu" not in ctx.path.parts:
         return
     metric_imports: set[str] = set()
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if (isinstance(node, ast.ImportFrom) and node.module
                 and node.module.endswith("obs.metrics")):
             for alias in node.names:
@@ -389,7 +413,7 @@ def orphan_metric(ctx: FileContext):
                     metric_imports.add(alias.asname or alias.name)
     if not metric_imports:
         return
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
                 and node.func.id in metric_imports):
             yield node.lineno, (
@@ -474,7 +498,7 @@ def profiling_hook_placement(project: ProjectContext):
     for info in graph.reachable.values():
         if not _mx08_may_contain(info.ctx.src):
             continue
-        for sub in ast.walk(info.node):
+        for sub in info.ctx.walk(info.node):
             hook = _mx08_hook(sub)
             if hook is not None and fresh(info.ctx, sub.lineno):
                 yield info.ctx, sub.lineno, (
@@ -497,14 +521,14 @@ def profiling_hook_placement(project: ProjectContext):
         # `# analysis: hot-loop` marker) — per-batch profiling inline in
         # the loop, wrong even in obs/.
         hot_hook_owner: dict[int, str] = {}
-        for qual, fn_node in _function_qualnames(ctx.tree):
+        for qual, fn_node in _function_qualnames(ctx):
             if qual not in registered and not _has_hot_loop_marker(ctx, fn_node):
                 continue
-            for sub in ast.walk(fn_node):
+            for sub in ctx.walk(fn_node):
                 if _mx08_hook(sub) is not None:
                     hot_hook_owner.setdefault(id(sub), qual)
         sanctioned = ctx.relpath.endswith(_MX08_SANCTIONED_SUFFIX)
-        for sub in ast.walk(ctx.tree):
+        for sub in ctx.walk():
             hook = _mx08_hook(sub)
             if hook is None:
                 continue

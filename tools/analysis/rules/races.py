@@ -1,0 +1,431 @@
+"""CC10/CC11/CC12 — thread-role race detection over the host plane.
+
+Built on two graphs: the lock graph (which lock ids are held at every
+attribute read/mutation — ``tools/analysis/lockgraph``) and the thread
+role graph (which spawned threads may execute every function —
+``tools/analysis/threadroles``). The three rules:
+
+- **CC10 lock-set races**: for every ``self._x`` (and module-global
+  written under ``global``), intersect the held-lock sets over all
+  mutation sites. An attribute mutated from >=2 roles with an EMPTY
+  common lock set and at least one compound mutation (``+=``, in-place
+  container mutation, ``self.x = self.x + ...``) is a data race; an
+  attribute whose writers DO share a lock but that is read from outside
+  it can observe torn multi-field state. Quiet by design: single-role
+  state, ``__init__`` writes (pre-publication), the atomic-swap idiom
+  (every mutation a plain rebind), and fields annotated
+  ``# analysis: single-writer`` at a write site;
+
+- **CC11 safe publication**: check-then-act lazy init (``if self._x is
+  None: self._x = build()``) outside any lock in a function that >=2
+  roles may run — both threads see None and both initialize (the
+  double-checked idiom, re-checking under the lock, stays quiet
+  because the assign site is locked); and attributes first published
+  AFTER the thread that reads them has started — the target can run
+  before the assign and read the pre-start value. Assigning in
+  ``__init__`` (before any spawn) is the compliant shape;
+
+- **CC12 role contracts**: ``REPO_CONFIG["role_contracts"]`` (or a
+  module-literal ``ANALYSIS_ROLE_CONTRACT`` in explicit-path mode, like
+  CC09's seam table) declares which roles may call scoring-path seams.
+  A call from an undeclared role — and a contract entry naming a role
+  or callee that no longer exists — fails loudly, the way CC09 treats
+  seam-table drift.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.analysis.dataflow import call_graph
+from tools.analysis.engine import FileContext, ProjectContext, rule
+from tools.analysis.lockgraph import lock_graph
+from tools.analysis.rules.locks import _scoped_files
+from tools.analysis.threadroles import role_graph
+
+_CONTRACT_NAME = "ANALYSIS_ROLE_CONTRACT"
+_SINGLE_WRITER = re.compile(r"#\s*analysis:\s*single-writer")
+_SPAWNISH_CTORS = {"Thread", "Timer", "ThreadPoolExecutor"}
+
+
+def _graphs(project: ProjectContext):
+    return (lock_graph(project, _scoped_files(project)), role_graph(project))
+
+
+def _annotated_lines(ctx: FileContext) -> set[int]:
+    cached = ctx.__dict__.setdefault("_single_writer_lines", None)
+    if cached is None:
+        cached = {i for i, line in enumerate(ctx.src.splitlines(), start=1)
+                  if _SINGLE_WRITER.search(line)}
+        ctx.__dict__["_single_writer_lines"] = cached
+    return cached
+
+
+def _inherited_guards(cls) -> dict[str, frozenset[str]]:
+    """CC03's inherited-guard idiom: a private helper whose every
+    in-class call site holds a common subset of the class's locks is
+    guarded by that subset."""
+    own_lock_ids = {lk.id for lk in cls.locks.values()}
+    contexts: dict[str, list[frozenset[str]]] = {}
+    for m in cls.methods.values():
+        for kind, name, _line, held in m.calls:
+            if kind == "self" and name in cls.methods:
+                contexts.setdefault(name, []).append(
+                    frozenset(held & own_lock_ids))
+    out: dict[str, frozenset[str]] = {}
+    for name, ctxs in contexts.items():
+        if name.startswith("_") and not name.startswith("__") and ctxs:
+            common = frozenset.intersection(*ctxs)
+            if common:
+                out[name] = common
+    return out
+
+
+def _exempt_attrs(cls) -> set[str]:
+    """Synchronization primitives and thread/pool handles are not data:
+    a Lock/Event/Queue attribute is itself the guard, and Thread /
+    ThreadPoolExecutor objects are internally synchronized."""
+    out = set(cls.locks) | set(cls.queues) | set(cls.events)
+    for sub in cls.ctx.walk(cls.node):
+        value = getattr(sub, "value", None)
+        if not isinstance(value, ast.Call):
+            continue
+        fn = value.func
+        last = (fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else None)
+        if last not in _SPAWNISH_CTORS:
+            continue
+        targets = (sub.targets if isinstance(sub, ast.Assign)
+                   else [sub.target] if isinstance(sub, ast.AnnAssign) else [])
+        for t in targets:
+            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                out.add(t.attr)
+    return out
+
+
+def _fmt_roles(roles) -> str:
+    return "/".join(sorted(roles))
+
+
+def _site(rec, line: int) -> str:
+    return f"{rec.ctx.relpath}:{line} in `{rec.key[1]}`"
+
+
+@rule("CC10", "lock-set-race",
+      "Shared state mutated from two thread roles with no common lock "
+      "is a data race the lock-cycle rules can never see: a racy "
+      "counter or torn multi-field update silently breaks the "
+      "bit-exact replay the audit trail depends on. Guard every write "
+      "site with one lock, hand the state off through a queue, or "
+      "annotate a deliberately single-writer field with "
+      "`# analysis: single-writer` and a justification.",
+      scope="project")
+def lock_set_race(project: ProjectContext):
+    lg, rg = _graphs(project)
+    for cls in lg.classes:
+        inherited = _inherited_guards(cls)
+        exempt = _exempt_attrs(cls)
+        annotated = _annotated_lines(cls.ctx)
+        writes: dict[str, list] = {}  # attr -> [(rec, line, held, compound, roles)]
+        reads: dict[str, list] = {}
+        attr_annotated: set[str] = set()
+        for mname, m in cls.methods.items():
+            extra = inherited.get(mname, frozenset())
+            for attr, line, held, compound in m.mutations:
+                if line in annotated:
+                    attr_annotated.add(attr)
+                if m.node.name == "__init__" or attr in exempt:
+                    continue
+                writes.setdefault(attr, []).append(
+                    (m, line, held | extra, compound, rg.roles_of(m.key)))
+            if m.node.name == "__init__":
+                continue
+            for attr, line, held in m.reads:
+                if attr in exempt or attr in cls.methods:
+                    continue
+                reads.setdefault(attr, []).append((m, line, held | extra))
+        for attr, sites in sorted(writes.items()):
+            if attr in attr_annotated:
+                continue
+            role_union = frozenset().union(*(s[4] for s in sites))
+            if len(role_union) < 2:
+                continue
+            if not any(s[3] for s in sites):
+                continue  # every mutation a plain rebind: atomic swap
+            sites = sorted(sites, key=lambda s: (s[0].ctx.relpath, s[1]))
+            common = frozenset.intersection(*(frozenset(s[2]) for s in sites))
+            if not common:
+                a = next(s for s in sites if s[3])
+                b = next((s for s in sites if s[4] != a[4]), None) \
+                    or next((s for s in sites if s is not a), None)
+                cited = (f" and {_fmt_roles(b[4])} ({_site(b[0], b[1])})"
+                         if b is not None else
+                         " (one site, reachable from every role listed)")
+                yield a[0].ctx, a[1], (
+                    f"`{cls.name}.{attr}` is mutated from roles "
+                    f"{_fmt_roles(a[4])} ({_site(a[0], a[1])}){cited} "
+                    "with no common lock — a lost update needs only two "
+                    "threads; guard every write with one lock or "
+                    "annotate `# analysis: single-writer`")
+                continue
+            lock_labels = "/".join(sorted(
+                lg.locks[i].label for i in common if i in lg.locks))
+            # Double-checked locking: a function that re-reads the
+            # attribute UNDER the common lock treats its unlocked read
+            # as an advisory fast path (the locked re-check decides) —
+            # the same idiom CC11 exempts at the assign site.
+            dcl_funcs = {id(r[0]) for r in reads.get(attr, [])
+                         if common <= frozenset(r[2])}
+            seen_lines: set[int] = set()
+            for rrec, rline, rheld in sorted(
+                    reads.get(attr, []), key=lambda s: (s[0].ctx.relpath, s[1])):
+                if rheld & common or rline in seen_lines \
+                        or id(rrec) in dcl_funcs:
+                    continue
+                seen_lines.add(rline)
+                w = sites[0]
+                yield rrec.ctx, rline, (
+                    f"`{cls.name}.{attr}` is written from roles "
+                    f"{_fmt_roles(role_union)} under {lock_labels} "
+                    f"({_site(w[0], w[1])}) but read here without it — "
+                    "the read can observe a torn update; take the lock "
+                    "or snapshot the value under it")
+    # Module globals written under `global` from >=2 roles.
+    seen_globals: set[tuple[str, str]] = set()
+    by_name: dict[tuple[str, str], list] = {}
+    for key, rec in lg.funcs.items():
+        for name, line, held, compound in rec.global_writes:
+            by_name.setdefault((key[0], name), []).append(
+                (rec, line, held, compound, rg.roles_of(key)))
+    for (relpath, name), sites in sorted(by_name.items()):
+        if (relpath, name) in seen_globals:
+            continue
+        seen_globals.add((relpath, name))
+        annotated = _annotated_lines(sites[0][0].ctx)
+        if any(s[1] in annotated for s in sites):
+            continue
+        role_union = frozenset().union(*(s[4] for s in sites))
+        if len(role_union) < 2 or not any(s[3] for s in sites):
+            continue
+        sites = sorted(sites, key=lambda s: s[1])
+        common = frozenset.intersection(*(frozenset(s[2]) for s in sites))
+        if common:
+            continue
+        a = next(s for s in sites if s[3])
+        b = next((s for s in sites if s[4] != a[4]), None) \
+            or next((s for s in sites if s is not a), None)
+        cited = (f" and {_fmt_roles(b[4])} ({_site(b[0], b[1])})"
+                 if b is not None else
+                 " (one site, reachable from every role listed)")
+        yield a[0].ctx, a[1], (
+            f"module global `{name}` is mutated from roles "
+            f"{_fmt_roles(a[4])} ({_site(a[0], a[1])}){cited} with no "
+            "common lock — guard every write with one module lock or "
+            "annotate `# analysis: single-writer`")
+
+
+def _lazy_test_attr(test: ast.AST) -> str | None:
+    """``self.X is None`` / ``self.X == None`` / ``not self.X`` -> X."""
+    if (isinstance(test, ast.Compare) and len(test.ops) == 1
+            and isinstance(test.ops[0], (ast.Is, ast.Eq))
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None):
+        target = test.left
+    elif isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        target = test.operand
+    else:
+        return None
+    if (isinstance(target, ast.Attribute) and isinstance(target.value, ast.Name)
+            and target.value.id == "self"):
+        return target.attr
+    return None
+
+
+@rule("CC11", "unsafe-publication",
+      "Check-then-act lazy init outside a lock lets two threads both "
+      "see None and both initialize (half the work is silently thrown "
+      "away, or worse, both results are used); publishing an attribute "
+      "AFTER the thread that reads it has started lets the target run "
+      "against the pre-start value. Initialize in __init__, publish "
+      "before .start(), or do the whole check-and-assign under a lock.",
+      scope="project")
+def unsafe_publication(project: ProjectContext):
+    lg, rg = _graphs(project)
+    graph = call_graph(project)
+    for cls in lg.classes:
+        exempt = _exempt_attrs(cls)
+        init_attrs = {a for m in cls.methods.values()
+                      if m.node.name == "__init__"
+                      for a, _l, _h, _c in m.mutations}
+        for mname, m in cls.methods.items():
+            if m.node.name == "__init__":
+                continue
+            roles = rg.roles_of(m.key)
+            # (a) check-then-act lazy init outside any lock.
+            if len(roles) >= 2:
+                held_at = {(a, l): h for a, l, h, _c in m.mutations}
+                inherited = _inherited_guards(cls).get(mname, frozenset())
+                for node in m.ctx.walk(m.node):
+                    if not isinstance(node, ast.If):
+                        continue
+                    attr = _lazy_test_attr(node.test)
+                    if attr is None or attr in exempt:
+                        continue
+                    assigns = [
+                        s for body_stmt in node.body
+                        for s in m.ctx.walk(body_stmt)
+                        if isinstance(s, (ast.Assign, ast.AugAssign))
+                        and any(isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self" and t.attr == attr
+                                for t in (s.targets
+                                          if isinstance(s, ast.Assign)
+                                          else [s.target]))]
+                    for s in assigns:
+                        held = held_at.get((attr, s.lineno), frozenset())
+                        if held | inherited:
+                            continue  # double-checked: assign is locked
+                        yield m.ctx, node.test.lineno, (
+                            f"check-then-act lazy init of "
+                            f"`{cls.name}.{attr}` outside any lock in "
+                            f"`{m.key[1]}` (may run on roles "
+                            f"{_fmt_roles(roles)}): two threads can both "
+                            f"see the unset value and both initialize "
+                            f"(assign at {m.ctx.relpath}:{s.lineno}) — "
+                            "initialize in __init__ or guard the whole "
+                            "check-and-assign")
+                        break
+            # (b) publish-after-start within this function.
+            spawns = [s for s in rg.spawns
+                      if s.func == m.key and s.kind in ("thread", "timer")]
+            if not spawns:
+                continue
+            starts = [c.lineno for c in m.ctx.walk(m.node)
+                      if isinstance(c, ast.Call)
+                      and isinstance(c.func, ast.Attribute)
+                      and c.func.attr == "start"]
+            for spawn in spawns:
+                start_lines = [l for l in starts if l >= spawn.line]
+                if not start_lines:
+                    continue
+                start_line = min(start_lines)
+                target_reads: dict[str, int] = {}
+                for key in graph.reachable_from([spawn.target]):
+                    lrec = lg.funcs.get(key)
+                    if lrec is None or lrec.cls is not cls:
+                        continue
+                    for attr, line, _held in lrec.reads:
+                        target_reads.setdefault(attr, line)
+                for attr, line, _held, _c in sorted(
+                        m.mutations, key=lambda x: x[1]):
+                    if line <= start_line or attr in exempt:
+                        continue
+                    if attr in init_attrs or attr not in target_reads:
+                        continue
+                    if any(a == attr and l < start_line
+                           for a, l, _h, _cc in m.mutations):
+                        continue  # also published before the start
+                    tgt = graph.funcs[spawn.target]
+                    yield m.ctx, line, (
+                        f"`{cls.name}.{attr}` is published after the "
+                        f"`{spawn.role}` thread starts "
+                        f"({m.ctx.relpath}:{start_line}) and its target "
+                        f"`{tgt.key[1]}` reads it "
+                        f"({tgt.ctx.relpath}:{target_reads[attr]}) — the "
+                        "thread can run before this assign; publish "
+                        "before .start() or initialize in __init__")
+                    break
+
+
+def _role_contracts(project: ProjectContext):
+    """[(table, declaring ctx|None, lineno)] — repo config table plus
+    module-literal ANALYSIS_ROLE_CONTRACT tables (fixture mode)."""
+    cached = project.caches.get("role_contracts_parsed")
+    if cached is not None:
+        return cached
+    out = []
+    config = project.caches.get("config", {})
+    table = config.get("role_contracts")
+    if table:
+        out.append((table, None, 0))
+    for ctx in project.files:
+        for node in ctx.tree.body:
+            if not (isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == _CONTRACT_NAME
+                    for t in node.targets)):
+                continue
+            try:
+                literal = ast.literal_eval(node.value)
+            except (ValueError, SyntaxError):
+                continue
+            if isinstance(literal, dict):
+                out.append((literal, ctx, node.lineno))
+    project.caches["role_contracts_parsed"] = out
+    return out
+
+
+@rule("CC12", "role-contract",
+      "The role-contract table declares which thread roles may call "
+      "each scoring-path seam (only registered scoring threads reach "
+      "`note_decisions`; only the hostprof seam touches the sampler "
+      "registry). A call from an undeclared role means a new thread "
+      "quietly joined the scoring path without anyone auditing its "
+      "locking — and a contract entry naming a vanished role or callee "
+      "fails loudly, like CC09's seam-table drift.",
+      scope="project")
+def role_contract(project: ProjectContext):
+    graph = call_graph(project)
+    rg = role_graph(project)
+    config = project.caches.get("config", {})
+    prefixes = config.get("cc_scope")
+    for table, decl_ctx, decl_line in _role_contracts(project):
+        for callee, allowed in sorted(table.items()):
+            allowed = frozenset(allowed)
+            defs = [k for k in graph.funcs
+                    if k[1].rsplit(".", 1)[-1] == callee]
+            anchor: tuple[FileContext, int] | None
+            if decl_ctx is not None:
+                anchor = (decl_ctx, decl_line)
+            elif defs:
+                d = sorted(defs)[0]
+                anchor = (graph.funcs[d].ctx, graph.funcs[d].node.lineno)
+            else:
+                anchor = None
+            if not defs:
+                if anchor is None and project.files:
+                    anchor = (sorted(project.files,
+                                     key=lambda c: c.relpath)[0], 1)
+                if anchor is not None:
+                    yield anchor[0], anchor[1], (
+                        f"role contract names unknown callee `{callee}` "
+                        "— the table has drifted from the code; fix the "
+                        "entry so the contract still means something")
+                continue
+            for role in sorted(allowed - rg.role_names):
+                yield anchor[0], anchor[1], (
+                    f"role contract for `{callee}` names unknown role "
+                    f"`{role}` — no spawn site or thread_roles entry "
+                    "declares it; the table has drifted from the code")
+            for key in sorted(graph.funcs):
+                rec = graph.funcs[key]
+                if prefixes and not any(key[0].startswith(p)
+                                        for p in prefixes):
+                    continue
+                if callee not in rec.called_names:
+                    continue
+                if key[1].rsplit(".", 1)[-1] == callee:
+                    continue  # recursion / the seam itself
+                bad = rg.roles_of(key) - allowed
+                if not bad:
+                    continue
+                line = next((l for _k, n, _m, l in rec.calls if n == callee),
+                            rec.node.lineno)
+                yield rec.ctx, line, (
+                    f"role {_fmt_roles(bad)} calls seam `{callee}` from "
+                    f"`{key[1]}` but the role contract allows only "
+                    f"{_fmt_roles(allowed)} — a thread joined the "
+                    "scoring path without a contract update; extend the "
+                    "role_contracts table or route the call through an "
+                    "allowed role")

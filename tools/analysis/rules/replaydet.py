@@ -62,7 +62,7 @@ def _exempt_ranges(ctx: FileContext, seam_lines: set[int]):
     """(start, end) line ranges of functions marked as the clock seam —
     the marker sits on the ``def`` line (or a decorator line)."""
     ranges = []
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         marker_lines = {node.lineno} | {
@@ -115,7 +115,7 @@ def replay_determinism(ctx: FileContext):
     def exempted(lineno: int) -> bool:
         return any(start <= lineno <= end for start, end in exempt)
 
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if not isinstance(node, ast.Call):
             continue
         problem = _flagged(node)

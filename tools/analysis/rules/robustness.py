@@ -125,7 +125,7 @@ def _is_loud(node: ast.ExceptHandler) -> bool:
       scope="project", aliases=("BLE001",))
 def silent_exception_swallow(project: ProjectContext):
     for ctx in _scoped_files(project):
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not isinstance(node, ast.ExceptHandler):
                 continue
             broad = _BROAD_TYPES & set(_handler_types(node))
@@ -232,7 +232,7 @@ def _is_while_true(loop: ast.AST) -> bool:
       scope="project")
 def retry_backoff_discipline(project: ProjectContext):
     for ctx in _scoped_files(project):
-        for fn in ast.walk(ctx.tree):
+        for fn in ctx.walk():
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             assignments = None

@@ -228,7 +228,7 @@ class _Receivers:
                     for t in _assign_targets(node):
                         if isinstance(t, ast.Name):
                             self.known[(ctx.relpath, None, t.id)] = kb
-            for node in ast.walk(ctx.tree):
+            for node in ctx.walk():
                 if not isinstance(node, ast.ClassDef):
                     continue
                 for sub in ast.walk(node):
@@ -396,7 +396,7 @@ def bounded_handoff(project: ProjectContext):
         relpath = rec.key[0]
         if prefixes and not any(relpath.startswith(p) for p in prefixes):
             continue
-        local = _local_receivers(rec.node)
+        local = _local_receivers(rec.ctx, rec.node)
         parents = _parents(rec.node)
         for call in _own_calls(rec.node):
             fn = call.func
@@ -457,9 +457,9 @@ def _own_calls(fn_node: ast.AST):
     yield from walk(fn_node)
 
 
-def _local_receivers(fn_node: ast.AST) -> dict[str, tuple[str, bool]]:
+def _local_receivers(ctx, fn_node: ast.AST) -> dict[str, tuple[str, bool]]:
     out: dict[str, tuple[str, bool]] = {}
-    for sub in ast.walk(fn_node):
+    for sub in ctx.walk(fn_node):
         kb = _ctor_kind_bounded(getattr(sub, "value", None))
         if kb is not None:
             for t in _assign_targets(sub):

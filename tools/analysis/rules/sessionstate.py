@@ -52,7 +52,7 @@ def _seam_ranges(ctx: FileContext) -> list[tuple[int, int]]:
     if not seam_lines:
         return []
     ranges = []
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         marker_lines = {node.lineno} | {d.lineno for d in node.decorator_list}
@@ -64,7 +64,7 @@ def _seam_ranges(ctx: FileContext) -> list[tuple[int, int]]:
 def _init_self_ranges(ctx: FileContext) -> list[tuple[int, int]]:
     return [
         (node.lineno, node.end_lineno or node.lineno)
-        for node in ast.walk(ctx.tree)
+        for node in ctx.walk()
         if isinstance(node, ast.FunctionDef) and node.name == "__init__"
     ]
 
@@ -102,7 +102,7 @@ def session_state_mutation_discipline(project: ProjectContext):
         def _in(ranges: list[tuple[int, int]], lineno: int) -> bool:
             return any(lo <= lineno <= hi for lo, hi in ranges)
 
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             for attr, is_self in _session_targets(node):
                 if _in(seam, attr.lineno):
                     continue

@@ -1,0 +1,425 @@
+"""Thread inventory and role propagation (CC10/CC11/CC12 substrate).
+
+The host plane spawns threads in 20+ places — batcher loop, pipeline
+stage/readback workers, ledger writer, shadow/drift workers, hostprof
+sampler, supervisor rebuild, fleetview ticker — and the lock rules
+(CC01–CC03) are blind to the question that matters for races: *which
+threads can execute this function concurrently?* This module answers it
+statically:
+
+- **spawn-site discovery**: every ``threading.Thread(target=...)``,
+  ``threading.Timer(...)`` and ``executor.submit(fn)`` call site names a
+  *role*. Thread roles come from the ``name=`` kwarg when it is a string
+  literal (``name="shadow-scorer"`` -> role ``shadow-scorer``),
+  otherwise from the target function's bare name; executor roles come
+  from the pool's ``thread_name_prefix`` when the pool is a same-class
+  attribute with a literal prefix, otherwise ``pool:<receiver>``.
+  Role seeds are config-extensible the same way CC09's seam contracts
+  are: ``REPO_CONFIG["thread_roles"]`` maps extra role names to member
+  specs (``"file.py::Class.method"``), and fixture/unit-test modules may
+  declare a literal ``ANALYSIS_THREAD_ROLES = {...}`` table resolved
+  within the declaring file;
+
+- **role propagation** over the PR 13 call graph: a function inherits
+  the roles of every caller, so each function ends with a *may-run-on*
+  role set. Propagation uses exact edges only (``self.m()``, plain
+  names, ``from``-imports, module-alias calls, nested defs) plus
+  attribute calls whose method name is unique project-wide — the
+  name-based any-method fallback that is fine for lock-order edges
+  would smear roles across unrelated classes;
+
+- **the ``main`` role**: functions not exclusively reached from spawn
+  targets run on caller threads (gRPC handlers, tests, the REPL) and
+  get the implicit role ``main``. In repo mode the seeding of ``main``
+  is restricted to the configured ``cc_scope`` so a unit test poking a
+  private worker method doesn't fabricate a cross-thread caller;
+
+- **queue hand-off edges** through the bounded-queue idiom (the MX07
+  recognizers): a function reference enqueued onto a class queue/deque
+  (``self._q.put((row, callback))``) is *executed by the consumer*, so
+  the callback inherits the roles of the functions that ``get()`` /
+  ``popleft()`` from that attribute — the consumer role, not the
+  producer's.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.analysis.dataflow import CallGraph, call_graph
+from tools.analysis.engine import FileContext, ProjectContext, dotted_name
+
+ROLE_MAIN = "main"
+
+_ROLES_NAME = "ANALYSIS_THREAD_ROLES"
+_SPAWN_CTORS = {"Thread", "Timer"}
+_QUEUEISH_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+                   "deque"}
+_CONSUME_METHODS = {"get", "get_nowait", "popleft", "pop"}
+
+
+@dataclass(frozen=True)
+class SpawnSite:
+    ctx: FileContext
+    line: int
+    role: str
+    target: tuple[str, str]  # call-graph key of the spawned function
+    kind: str  # "thread" | "timer" | "submit" | "config"
+    func: tuple[str, str] | None  # enclosing function key (None: config)
+
+
+@dataclass
+class _QueueUse:
+    consumers: set[tuple[str, str]] = field(default_factory=set)
+    handed_off: list[tuple[tuple[str, str], int]] = field(
+        default_factory=list)  # (enqueued function key, line)
+
+
+class RoleGraph:
+    """May-run-on role sets for every function in the project."""
+
+    def __init__(self, project: ProjectContext):
+        self.project = project
+        self.graph: CallGraph = call_graph(project)
+        self.spawns: list[SpawnSite] = []
+        self.roles: dict[tuple[str, str], set[str]] = {}
+        self.role_names: set[str] = {ROLE_MAIN}
+        # (relpath, cls, attr) -> consumer/hand-off record
+        self._queues: dict[tuple[str, str | None, str], _QueueUse] = {}
+        self._pool_prefixes: dict[tuple[str, str, str], str] = {}
+        self._queue_attrs: set[tuple[str, str | None, str]] = set()
+        self._edge_cache: dict[tuple[str, str],
+                               list[tuple[str, str]]] | None = None
+        # Spawn discovery must see EVERY production file, not just
+        # cc_scope: a training-loop thread spawned in train/ calls
+        # straight into serve/ (set_candidate), and scoping the scan to
+        # cc_scope silently turned those writes single-role. Only test
+        # files are excluded in repo mode — a thread a TEST spawns is
+        # not a production role.
+        config = project.caches.get("config", {})
+        if config.get("cc_scope"):
+            self._scan_files = [f for f in project.files
+                                if not _is_test_file(f.relpath)]
+        else:
+            self._scan_files = list(project.files)
+        self._scan_paths = {f.relpath for f in self._scan_files}
+        self._inventory_containers()
+        self._discover_spawns()
+        self._config_roles()
+        self._propagate()
+
+    # -- inventory -----------------------------------------------------------
+
+    def _inventory_containers(self) -> None:
+        """Queue/deque class attributes (hand-off receivers) and executor
+        pools with a literal ``thread_name_prefix``."""
+        for ctx in self._scan_files:
+            for node in ctx.walk():
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                for sub in ast.walk(node):
+                    value = getattr(sub, "value", None)
+                    if not isinstance(value, ast.Call):
+                        continue
+                    name = dotted_name(value.func)
+                    last = (name or "").split(".")[-1]
+                    targets = (sub.targets if isinstance(sub, ast.Assign)
+                               else [sub.target]
+                               if isinstance(sub, ast.AnnAssign) else [])
+                    for t in targets:
+                        if not (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            continue
+                        if last in _QUEUEISH_CTORS:
+                            self._queue_attrs.add(
+                                (ctx.relpath, node.name, t.attr))
+                        elif last == "ThreadPoolExecutor":
+                            for kw in value.keywords:
+                                if (kw.arg == "thread_name_prefix"
+                                        and isinstance(kw.value, ast.Constant)
+                                        and isinstance(kw.value.value, str)):
+                                    self._pool_prefixes[
+                                        (ctx.relpath, node.name, t.attr)
+                                    ] = kw.value.value
+
+    # -- spawn-site discovery ------------------------------------------------
+
+    def _discover_spawns(self) -> None:
+        for key, rec in self.graph.funcs.items():
+            if key[0] not in self._scan_paths:
+                continue
+            for call in _own_calls(rec.node):
+                self._classify_call(rec, call)
+
+    def _classify_call(self, rec, call: ast.Call) -> None:
+        fn = call.func
+        name = dotted_name(fn)
+        last = (name or "").split(".")[-1] if name else (
+            fn.attr if isinstance(fn, ast.Attribute) else None)
+        if last in _SPAWN_CTORS:
+            self._spawn_from_ctor(rec, call, last)
+        elif isinstance(fn, ast.Attribute) and fn.attr == "submit":
+            self._spawn_from_submit(rec, call)
+        elif (isinstance(fn, ast.Attribute)
+                and fn.attr in _CONSUME_METHODS | {"put", "put_nowait",
+                                                   "append", "appendleft"}):
+            self._note_queue_use(rec, call, fn)
+
+    def _spawn_from_ctor(self, rec, call: ast.Call, ctor: str) -> None:
+        target_expr = None
+        role = None
+        if ctor == "Thread":
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    target_expr = kw.value
+                elif (kw.arg == "name" and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)):
+                    role = kw.value.value
+        else:  # Timer(interval, fn)
+            if len(call.args) >= 2:
+                target_expr = call.args[1]
+        target = self._resolve_fn_ref(rec, target_expr)
+        if target is None:
+            return
+        if role is None:
+            role = target[1].rsplit(".", 1)[-1]
+        self._seed(SpawnSite(rec.ctx, call.lineno, role, target,
+                             "thread" if ctor == "Thread" else "timer",
+                             rec.key))
+
+    def _spawn_from_submit(self, rec, call: ast.Call) -> None:
+        if not call.args:
+            return
+        target = self._resolve_fn_ref(rec, call.args[0])
+        if target is None:
+            return
+        recv = call.func.value
+        role = None
+        if (isinstance(recv, ast.Attribute) and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self" and rec.cls_name is not None):
+            role = self._pool_prefixes.get(
+                (rec.key[0], rec.cls_name, recv.attr))
+            if role is None:
+                role = f"pool:{recv.attr}"
+        else:
+            role = f"pool:{dotted_name(recv) or 'executor'}"
+        self._seed(SpawnSite(rec.ctx, call.lineno, role, target,
+                             "submit", rec.key))
+
+    def _note_queue_use(self, rec, call: ast.Call, fn: ast.Attribute) -> None:
+        recv = fn.value
+        if not (isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self" and rec.cls_name is not None):
+            return
+        qkey = (rec.key[0], rec.cls_name, recv.attr)
+        if qkey not in self._queue_attrs:
+            return
+        use = self._queues.setdefault(qkey, _QueueUse())
+        if fn.attr in _CONSUME_METHODS:
+            use.consumers.add(rec.key)
+            return
+        # put/append: any function reference in the payload is executed
+        # by whichever thread drains the queue — the hand-off edge.
+        for arg in call.args:
+            for node in ast.walk(arg):
+                if isinstance(node, ast.Call):
+                    continue
+                ref = self._resolve_fn_ref(rec, node)
+                if ref is not None:
+                    use.handed_off.append((ref, call.lineno))
+
+    def _resolve_fn_ref(self, rec, expr: ast.AST | None
+                        ) -> tuple[str, str] | None:
+        """A function *reference* (not a call): ``self._run``, a plain
+        name, or a ``from``-imported in-project function."""
+        if expr is None:
+            return None
+        if (isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and rec.cls_name is not None):
+            key = (rec.key[0], f"{rec.cls_name}.{expr.attr}")
+            return key if key in self.graph.funcs else None
+        if isinstance(expr, ast.Name):
+            hits = self.graph.resolve(rec, "name", expr.id, None)
+            return hits[0] if hits else None
+        return None
+
+    def _seed(self, site: SpawnSite) -> None:
+        self.spawns.append(site)
+        self.role_names.add(site.role)
+        self.roles.setdefault(site.target, set()).add(site.role)
+
+    # -- config / fixture-literal roles --------------------------------------
+
+    def _config_roles(self) -> None:
+        config = self.project.caches.get("config", {})
+        tables: list[tuple[dict, FileContext | None]] = []
+        declared = config.get("thread_roles")
+        if declared:
+            tables.append((declared, None))
+        for ctx in self.project.files:
+            for node in ctx.tree.body:
+                if not (isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == _ROLES_NAME
+                        for t in node.targets)):
+                    continue
+                try:
+                    literal = ast.literal_eval(node.value)
+                except (ValueError, SyntaxError):
+                    continue
+                if isinstance(literal, dict):
+                    tables.append((literal, ctx))
+        for table, ctx in tables:
+            for role, specs in table.items():
+                self.role_names.add(role)
+                for spec in specs:
+                    if "::" in spec:
+                        suffix, qual = spec.split("::", 1)
+                    elif ctx is not None:
+                        suffix, qual = ctx.relpath, spec
+                    else:
+                        continue
+                    key = self.graph.lookup(suffix, qual)
+                    if key is not None:
+                        self.roles.setdefault(key, set()).add(role)
+                        self.spawns.append(SpawnSite(
+                            self.graph.funcs[key].ctx,
+                            self.graph.funcs[key].node.lineno,
+                            role, key, "config", None))
+
+    # -- propagation ---------------------------------------------------------
+
+    def _resolve_precise(self, rec, kind: str, name: str,
+                         module: str | None) -> list[tuple[str, str]]:
+        """Exact edges plus attribute calls with a project-unique method
+        name; the any-method fallback would smear roles across classes."""
+        if kind == "attr" or (kind == "self" and rec.cls_name is not None
+                              and (rec.key[0], f"{rec.cls_name}.{name}")
+                              not in self.graph.funcs):
+            hits = self.graph.resolve(rec, "attr", name, None)
+            return hits if len(hits) == 1 else []
+        return self.graph.resolve(rec, kind, name, module)
+
+    def _edges(self) -> dict[tuple[str, str], list[tuple[str, str]]]:
+        """Precise out-edges, resolved ONCE — propagation runs several
+        worklist passes and re-resolving every call each pass dominated
+        the rule budget."""
+        if self._edge_cache is None:
+            edges: dict[tuple[str, str], list[tuple[str, str]]] = {}
+            for key, rec in self.graph.funcs.items():
+                nxt = list(rec.children)
+                seen_calls: set[tuple[str, str, str | None]] = set()
+                for kind, name, module, _line in rec.calls:
+                    sig = (kind, name, module)
+                    if sig in seen_calls:
+                        continue
+                    seen_calls.add(sig)
+                    nxt.extend(self._resolve_precise(rec, kind, name, module))
+                edges[key] = [k for k in dict.fromkeys(nxt)
+                              if k in self.graph.funcs]
+            self._edge_cache = edges
+        return self._edge_cache
+
+    def _propagate(self) -> None:
+        # Two passes: spawn roles first, then hand-off edges can look up
+        # consumer roles, then one re-propagation for the callbacks.
+        for _round in range(2):
+            self._fixpoint(self.roles)
+            changed = False
+            for qkey, use in self._queues.items():
+                consumer_roles: set[str] = set()
+                for ckey in use.consumers:
+                    consumer_roles |= self.roles.get(ckey, set())
+                if not consumer_roles:
+                    continue
+                for ref, _line in use.handed_off:
+                    have = self.roles.setdefault(ref, set())
+                    if not consumer_roles <= have:
+                        have |= consumer_roles
+                        changed = True
+            if not changed:
+                break
+        # `main`: every function not exclusively reached from spawn
+        # targets may run on a caller thread. Seed from non-spawn-reach
+        # functions (restricted to cc_scope in repo mode) and propagate.
+        edges = self._edges()
+        spawn_reach = set(self.roles)
+        work = list(self.roles)
+        while work:
+            key = work.pop()
+            for callee in edges.get(key, ()):
+                if callee not in spawn_reach:
+                    spawn_reach.add(callee)
+                    work.append(callee)
+        config = self.project.caches.get("config", {})
+        prefixes = config.get("cc_scope")
+        main_seeds: dict[tuple[str, str], set[str]] = {}
+        for key in self.graph.funcs:
+            if key in spawn_reach:
+                continue
+            if prefixes and not any(key[0].startswith(p) for p in prefixes):
+                continue
+            main_seeds[key] = {ROLE_MAIN}
+        self._fixpoint(main_seeds)
+        for key, extra in main_seeds.items():
+            if ROLE_MAIN in extra:
+                self.roles.setdefault(key, set()).add(ROLE_MAIN)
+
+    def _fixpoint(self, roles: dict[tuple[str, str], set[str]]) -> None:
+        edges = self._edges()
+        work = list(roles)
+        while work:
+            key = work.pop()
+            mine = roles.get(key, set())
+            if not mine:
+                continue
+            for callee in edges.get(key, ()):
+                have = roles.setdefault(callee, set())
+                if not mine <= have:
+                    have |= mine
+                    work.append(callee)
+
+    # -- queries -------------------------------------------------------------
+
+    def roles_of(self, key: tuple[str, str]) -> frozenset[str]:
+        got = self.roles.get(key)
+        if got:
+            return frozenset(got)
+        return frozenset((ROLE_MAIN,))
+
+    def spawn_for_role(self, role: str) -> SpawnSite | None:
+        for site in self.spawns:
+            if site.role == role:
+                return site
+        return None
+
+
+def _is_test_file(relpath: str) -> bool:
+    parts = relpath.split("/")
+    return "tests" in parts[:-1] or parts[-1].startswith("test_")
+
+
+def _own_calls(fn_node: ast.AST):
+    """Calls lexically in this function, excluding nested defs (those
+    have their own graph records)."""
+
+    def walk(node: ast.AST):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            if isinstance(child, ast.Call):
+                yield child
+            yield from walk(child)
+
+    yield from walk(fn_node)
+
+
+def role_graph(project: ProjectContext) -> RoleGraph:
+    rg = project.caches.get("rolegraph")
+    if rg is None:
+        rg = RoleGraph(project)
+        project.caches["rolegraph"] = rg
+    return rg

@@ -22,7 +22,10 @@ Usage:
     python tools/benchtrend.py [--root DIR] [--noise 0.15] [--json]
 
 Exit status is 0 even when regressions are flagged (``--gate`` makes
-flags fatal — the trend gate CI mode).
+flags fatal — the trend gate CI mode). Accepted historical regressions
+live in ``TREND_WAIVERS.json`` next to the artifacts: waived flags are
+still reported, but only NEW (unwaived) flags trip the gate — the gate
+exists to catch this PR's regression, not to re-litigate r05.
 """
 
 from __future__ import annotations
@@ -253,6 +256,21 @@ def flag_regressions(rows: list[dict], noise: float = 0.15) -> list[dict]:
     return flags
 
 
+WAIVERS_FILE = "TREND_WAIVERS.json"
+
+
+def load_waivers(root: str) -> dict[tuple[str, str], str]:
+    """Accepted historical regressions: {(artifact file, metric): reason}.
+    Each entry must name the exact flag it absorbs — a waiver for one
+    metric of one artifact never quiets a different series."""
+    path = os.path.join(root, WAIVERS_FILE)
+    if not os.path.exists(path):
+        return {}
+    with open(path) as fh:
+        entries = json.load(fh)
+    return {(e["file"], e["metric"]): e.get("reason", "") for e in entries}
+
+
 def render_table(rows: list[dict]) -> str:
     """Fixed-width text table of the trajectory (the human face; --json
     is the machine one)."""
@@ -293,6 +311,11 @@ def main(argv: list[str] | None = None) -> int:
                 "usage: benchtrend.py [--root=DIR] [--noise=F] [--json] [--gate]")
     rows = build_trajectory(root)
     flags = flag_regressions(rows, noise)
+    waivers = load_waivers(root)
+    for f in flags:
+        if (f["file"], f["metric"]) in waivers:
+            f["waived"] = waivers[(f["file"], f["metric"])] or True
+    fatal = [f for f in flags if "waived" not in f]
     if as_json:
         print(json.dumps({"trajectory": rows, "regressions": flags,
                           "noise": noise}, indent=2))
@@ -302,12 +325,13 @@ def main(argv: list[str] | None = None) -> int:
             print(f"\nREGRESSIONS (beyond {noise:.0%} of best-so-far, "
                   "same-source series only):")
             for f in flags:
+                tag = " [waived]" if "waived" in f else ""
                 print(f"  {f['file']} {f['metric']} [{f['source']}]: "
                       f"{f['value']:,.1f} vs best {f['best_so_far']:,.1f} "
-                      f"({f['best_file']}) {f['delta_pct']:+.1f}%")
+                      f"({f['best_file']}) {f['delta_pct']:+.1f}%{tag}")
         else:
             print(f"\nno regressions beyond the {noise:.0%} noise band")
-    if gate and flags:
+    if gate and fatal:
         return 1
     return 0
 
